@@ -1,0 +1,28 @@
+"""mxnet_tpu: a TPU-native deep-learning framework with the capabilities
+of Apache MXNet v0.9.5 (mixed imperative/symbolic, Module training API,
+KVStore-style distribution) re-architected for TPUs: XLA/jax.jit replaces
+the NNVM graph executor, Pallas replaces hand-rolled CUDA kernels, and
+sharding over the ICI/DCN device mesh replaces the ps-lite parameter
+server. See SURVEY.md at the repo root for the full blueprint.
+"""
+
+from . import base
+from .base import MXNetError
+from .context import (
+    Context,
+    cpu,
+    gpu,
+    tpu,
+    cpu_pinned,
+    current_context,
+    default_context,
+    num_devices,
+)
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import random
+from . import autograd
+
+__version__ = "0.1.0"

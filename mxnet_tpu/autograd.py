@@ -1,0 +1,208 @@
+"""Imperative autograd.
+
+Analog of the reference AutogradRuntime (src/ndarray/autograd.{h,cc}):
+imperative op calls are recorded on a tape while a training scope is
+active; `compute_gradient` replays the tape as a pure jax function of the
+marked variables and pulls gradients out with jax.vjp — the TPU-native
+version of "build an nnvm graph from AGNodes and run a throwaway
+GraphExecutor" (autograd.cc:132-170). Heads get ones as cotangents, so
+loss ops' custom_vjp semantics (ops/nn.py) reproduce reference backward
+behavior.
+
+User-facing API mirrors python/mxnet/contrib/autograd.py:
+`train_section`/`test_section` scopes, `mark_variables`,
+`compute_gradient`, `grad_and_loss`, `grad`.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+        _state.tape = []
+        _state.marked = {}  # id(chunk) -> (ndarray, grad_ndarray)
+    return _state
+
+
+@dataclass
+class TapeEntry:
+    opdef: Any
+    params: dict
+    inputs: list  # NDArray refs
+    outputs: list  # NDArray refs
+    input_values: list  # jax arrays at record time
+    rng: Any = None
+    extra_kwargs: dict = field(default_factory=dict)
+
+
+def is_recording() -> bool:
+    return _st().recording
+
+
+def is_training() -> bool:
+    return _st().training
+
+
+def set_is_training(train: bool) -> bool:
+    st = _st()
+    prev = st.training
+    if train and not prev:
+        # entering an outermost train scope: drop any tape left over from
+        # a previous scope that never called compute_gradient, so stale
+        # entries can't leak memory or corrupt the next replay.
+        st.tape = []
+    st.training = train
+    st.recording = train
+    return prev
+
+
+class _Scope:
+    def __init__(self, train):
+        self._train = train
+
+    def __enter__(self):
+        self._prev = set_is_training(self._train)
+
+    def __exit__(self, *_):
+        set_is_training(self._prev)
+
+
+def train_section():
+    return _Scope(True)
+
+
+def test_section():
+    return _Scope(False)
+
+
+# aliases matching newer mxnet naming
+record = train_section
+pause = test_section
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    st = _st()
+    for var, grad in zip(variables, gradients):
+        st.marked[id(var._chunk)] = (var, grad)
+
+
+def record_op(opdef, params, inputs, outputs, rng=None, extra_kwargs=None,
+              input_values=None):
+    """Append an executed op to the tape. `input_values` must be the
+    inputs *as seen by the op* (pre any aux write-back) — callers pass the
+    values they actually fed the kernel."""
+    st = _st()
+    st.tape.append(
+        TapeEntry(
+            opdef=opdef,
+            params=params,
+            inputs=list(inputs),
+            outputs=list(outputs),
+            input_values=(
+                list(input_values)
+                if input_values is not None
+                else [x._data for x in inputs]
+            ),
+            rng=rng,
+            extra_kwargs=dict(extra_kwargs or {}),
+        )
+    )
+
+
+def _replay(tape, heads, var_chunks):
+    """Build f(var_values) -> head_values by replaying the tape."""
+
+    head_ids = [id(h._chunk) for h in heads]
+
+    def fn(var_values):
+        env = dict(zip(var_chunks, var_values))
+        for entry in tape:
+            in_vals = [
+                env.get(id(x._chunk), rec)
+                for x, rec in zip(entry.inputs, entry.input_values)
+            ]
+            kwargs = dict(entry.params)
+            kwargs.update(entry.extra_kwargs)
+            if entry.opdef.needs_rng:
+                kwargs["rng"] = entry.rng
+            if entry.opdef.needs_mode:
+                kwargs["is_train"] = True
+            res = entry.opdef.fn(*in_vals, **kwargs)
+            if not isinstance(res, tuple):
+                res = (res,)
+            for out_nd, val in zip(entry.outputs, res):
+                env[id(out_nd._chunk)] = val
+        return [env[hid] for hid in head_ids]
+
+    return fn
+
+
+def compute_gradient(outputs):
+    """Compute gradients of `outputs` w.r.t. marked variables and write
+    them into the marked gradient buffers (contrib/autograd.py:109)."""
+    st = _st()
+    if not st.marked:
+        raise MXNetError("no variables marked for gradient")
+    var_nds = [v for v, _ in st.marked.values()]
+    grad_nds = [g for _, g in st.marked.values()]
+    var_chunks = [id(v._chunk) for v in var_nds]
+    fn = _replay(st.tape, outputs, var_chunks)
+    var_values = [v._data for v in var_nds]
+    _, vjp_fn = jax.vjp(fn, var_values)
+    ones = [jnp.ones_like(h._data) for h in outputs]
+    (grads,) = vjp_fn(ones)
+    for g_nd, g_val in zip(grad_nds, grads):
+        g_nd._set_data(g_val)
+    st.tape = []
+
+
+def backward(outputs, out_grads=None):
+    compute_gradient(outputs)
+
+
+def grad_and_loss(func, argnum=None):
+    """Decorator returning (gradients, loss) of func w.r.t. its ndarray
+    inputs (contrib/autograd.py:141)."""
+
+    @functools.wraps(func)
+    def wrapped(*args):
+        from . import ndarray as nd
+
+        argnums = argnum
+        if argnums is None:
+            argnums = list(range(len(args)))
+        elif isinstance(argnums, int):
+            argnums = [argnums]
+        variables = [args[i] for i in argnums]
+        grads = [nd.zeros_like(v) for v in variables]
+        mark_variables(variables, grads)
+        with train_section():
+            outputs = func(*args)
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        compute_gradient(list(outs))
+        return grads, outputs
+
+    return wrapped
+
+
+def grad(func, argnum=None):
+    wrapped = grad_and_loss(func, argnum)
+
+    @functools.wraps(func)
+    def only_grad(*args):
+        return wrapped(*args)[0]
+
+    return only_grad

@@ -1,0 +1,81 @@
+"""Base utilities shared across the framework.
+
+TPU-native analog of the reference's ctypes bridge + dmlc helpers
+(reference: python/mxnet/base.py, include/mxnet/base.h). There is no C ABI
+boundary for the compute path here — jax/XLA is invoked in-process — so
+"base" reduces to error types, name managers and small coercion helpers
+used by the parameter system (analog of dmlc::Parameter,
+reference src/operator/*-inl.h DMLC_DECLARE_PARAMETER blocks).
+"""
+from __future__ import annotations
+
+import re
+import threading
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (analog of reference MXNetError,
+    python/mxnet/base.py:34)."""
+
+
+_name_lock = threading.Lock()
+_name_counters: dict[str, int] = {}
+
+
+def _auto_name(prefix: str) -> str:
+    """Generate a unique name like `convolution3` (analog of
+    python/mxnet/name.py NameManager)."""
+    with _name_lock:
+        idx = _name_counters.get(prefix, 0)
+        _name_counters[prefix] = idx + 1
+    return f"{prefix}{idx}"
+
+
+_TRUE = frozenset(("1", "true", "True", "TRUE"))
+_FALSE = frozenset(("0", "false", "False", "FALSE", "none", "None"))
+
+
+def coerce_bool(v) -> bool:
+    if isinstance(v, str):
+        if v in _TRUE:
+            return True
+        if v in _FALSE:
+            return False
+        raise MXNetError(f"cannot interpret {v!r} as bool")
+    return bool(v)
+
+
+def coerce_int(v) -> int:
+    return int(v)
+
+
+def coerce_float(v) -> float:
+    return float(v)
+
+
+def coerce_tuple(v, n=None, typ=int):
+    """Parse '(2, 2)' / '[2,2]' / 2 / (2,2) into a tuple of `typ`.
+
+    Analog of mshadow::TShape parsing used by dmlc parameter structs so
+    symbols deserialized from JSON (string attrs) behave like natively
+    constructed ones.
+    """
+    if isinstance(v, str):
+        s = v.strip()
+        if s.startswith(("(", "[")):
+            s = s[1:-1]
+        items = [x for x in re.split(r"[,\s]+", s) if x]
+        out = tuple(typ(x) for x in items)
+    elif isinstance(v, (tuple, list)):
+        out = tuple(typ(x) for x in v)
+    else:
+        out = (typ(v),) if n is None else (typ(v),) * n
+    if n is not None and len(out) == 1 and n > 1:
+        out = out * n
+    if n is not None and len(out) != n:
+        raise MXNetError(f"expected tuple of length {n}, got {v!r}")
+    return out
+
+
+def coerce_str(v) -> str:
+    return str(v)

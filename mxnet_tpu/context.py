@@ -1,0 +1,116 @@
+"""Device context.
+
+Analog of the reference `Context` (include/mxnet/base.h:116-207) with a
+first-class `tpu` device type beside cpu/gpu/cpu_pinned. A Context maps to
+a concrete `jax.Device`; when the requested platform is absent (e.g. tests
+on a CPU host mesh) the context degrades to the default jax backend so the
+same user code runs everywhere — mirroring how the reference falls back
+when built without CUDA.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+from .base import MXNetError
+
+
+class Context:
+    devtype2str = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 4: "tpu"}
+    devstr2type = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "tpu": 4}
+
+    _default_ctx = threading.local()
+
+    def __init__(self, device_type, device_id: int = 0):
+        if isinstance(device_type, Context):
+            self.device_typeid = device_type.device_typeid
+            self.device_id = device_type.device_id
+        else:
+            if device_type not in Context.devstr2type:
+                raise MXNetError(f"unknown device type {device_type!r}")
+            self.device_typeid = Context.devstr2type[device_type]
+            self.device_id = device_id
+
+    @property
+    def device_type(self) -> str:
+        return Context.devtype2str[self.device_typeid]
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Context)
+            and self.device_typeid == other.device_typeid
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_typeid, self.device_id))
+
+    def __repr__(self):
+        return f"{self.device_type}({self.device_id})"
+
+    # -- jax device resolution ------------------------------------------
+    def jax_device(self):
+        """Resolve to a concrete jax.Device, degrading gracefully."""
+        want = {"cpu": "cpu", "cpu_pinned": "cpu", "gpu": "gpu", "tpu": "tpu"}[
+            self.device_type
+        ]
+        devs = _devices_for_platform(want)
+        if not devs:
+            devs = jax.devices()  # fall back to default backend
+        return devs[self.device_id % len(devs)]
+
+    def __enter__(self):
+        if not hasattr(Context._default_ctx, "stack"):
+            Context._default_ctx.stack = []
+        Context._default_ctx.stack.append(self)
+        return self
+
+    def __exit__(self, *_):
+        Context._default_ctx.stack.pop()
+
+
+def _devices_for_platform(platform: str):
+    try:
+        return jax.devices(platform)
+    except RuntimeError:
+        # Experimental TPU tunnels may register under a different platform
+        # name; treat any non-cpu accelerator as satisfying 'tpu'.
+        if platform == "tpu":
+            accel = [d for d in jax.devices() if d.platform != "cpu"]
+            return accel
+        return []
+
+
+def cpu(device_id: int = 0) -> Context:
+    return Context("cpu", device_id)
+
+
+def gpu(device_id: int = 0) -> Context:
+    return Context("gpu", device_id)
+
+
+def tpu(device_id: int = 0) -> Context:
+    return Context("tpu", device_id)
+
+
+def cpu_pinned(device_id: int = 0) -> Context:
+    return Context("cpu_pinned", device_id)
+
+
+def current_context() -> Context:
+    stack = getattr(Context._default_ctx, "stack", None)
+    if stack:
+        return stack[-1]
+    return default_context()
+
+
+def default_context() -> Context:
+    """Default = tpu when an accelerator is visible, else cpu."""
+    accel = [d for d in jax.devices() if d.platform != "cpu"]
+    return Context("tpu", 0) if accel else Context("cpu", 0)
+
+
+def num_devices(device_type: str = "tpu") -> int:
+    devs = _devices_for_platform(device_type)
+    return len(devs)

@@ -1,0 +1,14 @@
+"""Operator library: one registry, jax lowerings.
+
+Importing this package registers every op (the analog of static
+registration in the reference's src/operator/*.cc files).
+"""
+from . import registry  # noqa: F401
+from . import elemwise  # noqa: F401
+from . import broadcast_reduce  # noqa: F401
+from . import matrix  # noqa: F401
+from . import indexing  # noqa: F401
+from . import init_sample  # noqa: F401
+from . import ordering  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer_ops  # noqa: F401

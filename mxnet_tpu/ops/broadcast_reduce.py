@@ -1,0 +1,106 @@
+"""Reductions and broadcasting ops.
+
+Covers reference src/operator/tensor/broadcast_reduce_op.{h,cc,cu} (sum,
+mean, prod, max, min, argmax, argmin, norm, broadcast_to/axis). The
+reference hand-writes tiled reduction kernels
+(broadcast_reduce-inl.{h,cuh}); on TPU these lower to XLA `reduce`, which
+tiles onto the VPU natively.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+from ..base import coerce_bool, coerce_int, coerce_tuple
+
+
+def _norm_axis(axis, ndim, exclude=False):
+    if axis is None or axis == () or axis == "":
+        ax = None
+    elif isinstance(axis, int):
+        ax = (axis % ndim,)
+    else:
+        ax = tuple(a % ndim for a in axis)
+    if exclude:
+        keep = set(ax or ())
+        ax = tuple(i for i in range(ndim) if i not in keep)
+    return ax
+
+
+_REDUCE_COERCE = {
+    "axis": lambda v: None if v in (None, "None", "") else coerce_tuple(v),
+    "keepdims": coerce_bool,
+    "exclude": coerce_bool,
+}
+
+
+def _reduce(name, fn, aliases=()):
+    @register(name, arg_names=["data"], coerce=_REDUCE_COERCE, aliases=aliases)
+    def _impl(data, axis=None, keepdims=False, exclude=False, _fn=fn):
+        ax = _norm_axis(axis, data.ndim, exclude)
+        return _fn(data, axis=ax, keepdims=keepdims)
+
+
+_reduce("sum", jnp.sum, aliases=("sum_axis",))
+_reduce("mean", jnp.mean)
+_reduce("prod", jnp.prod)
+_reduce("nansum", jnp.nansum)
+_reduce("nanprod", jnp.nanprod)
+_reduce("max", jnp.max, aliases=("max_axis",))
+_reduce("min", jnp.min, aliases=("min_axis",))
+
+
+@register("norm", arg_names=["data"])
+def norm(data):
+    # Reference norm is the flat L2 norm returning shape (1,)
+    # (broadcast_reduce_op.h L2 norm registration).
+    return jnp.sqrt(jnp.sum(jnp.square(data))).reshape((1,))
+
+
+_ARG_COERCE = {
+    "axis": lambda v: None if v in (None, "None", "") else coerce_int(v),
+    "keepdims": coerce_bool,
+}
+
+
+@register("argmax", arg_names=["data"], coerce=_ARG_COERCE)
+def argmax(data, axis=None, keepdims=False):
+    out = jnp.argmax(data, axis=axis, keepdims=keepdims)
+    return out.astype(jnp.float32)
+
+
+@register("argmin", arg_names=["data"], coerce=_ARG_COERCE)
+def argmin(data, axis=None, keepdims=False):
+    out = jnp.argmin(data, axis=axis, keepdims=keepdims)
+    return out.astype(jnp.float32)
+
+
+@register("argmax_channel", arg_names=["data"])
+def argmax_channel(data):
+    return jnp.argmax(data, axis=-1).astype(jnp.float32)
+
+
+@register(
+    "broadcast_to",
+    arg_names=["data"],
+    coerce={"shape": lambda v: coerce_tuple(v)},
+)
+def broadcast_to(data, shape=()):
+    # Zeros in target shape mean "keep source dim" (matrix_op-inl.h).
+    tgt = tuple(
+        s if s != 0 else data.shape[i] for i, s in enumerate(shape)
+    )
+    return jnp.broadcast_to(data, tgt)
+
+
+@register(
+    "broadcast_axis",
+    arg_names=["data"],
+    coerce={"axis": coerce_tuple, "size": coerce_tuple},
+    aliases=("broadcast_axes",),
+)
+def broadcast_axis(data, axis=(), size=()):
+    tgt = list(data.shape)
+    for a, s in zip(axis, size):
+        tgt[a % data.ndim] = s
+    return jnp.broadcast_to(data, tuple(tgt))

@@ -1,0 +1,244 @@
+"""Elementwise unary/binary ops (+scalar, +logic, +broadcast variants).
+
+Covers the reference's src/operator/tensor/elemwise_*op*.{h,cc,cu} and the
+scalar functor zoo in src/operator/mshadow_op.h. One jax expression per
+op; XLA fuses chains of these into single kernels, which replaces the
+reference's Kernel<OP,xpu>::Launch machinery (src/operator/mxnet_op.h).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from ..base import coerce_bool, coerce_float
+
+# ---------------------------------------------------------------- unary
+
+
+def _unary(name, fn, aliases=()):
+    register(name, arg_names=["data"], aliases=aliases)(
+        lambda data, _fn=fn: _fn(data)
+    )
+
+
+_unary("relu", lambda x: jnp.maximum(x, 0), aliases=("Relu",))
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("tanh", jnp.tanh)
+_unary("exp", jnp.exp)
+_unary("log", jnp.log)
+_unary("log2", jnp.log2)
+_unary("log10", jnp.log10)
+_unary("log1p", jnp.log1p)
+_unary("expm1", jnp.expm1)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", lambda x: jax.lax.rsqrt(x))
+_unary("cbrt", jnp.cbrt)
+_unary("rcbrt", lambda x: 1.0 / jnp.cbrt(x))
+_unary("square", jnp.square)
+_unary("abs", jnp.abs)
+_unary("sign", jnp.sign)
+_unary("round", jnp.round)
+_unary("rint", jnp.rint)
+_unary("ceil", jnp.ceil)
+_unary("floor", jnp.floor)
+_unary("fix", jnp.trunc)
+_unary("trunc", jnp.trunc)
+_unary("negative", jnp.negative)
+_unary("reciprocal", jnp.reciprocal)
+_unary("gamma", lambda x: jnp.exp(jax.lax.lgamma(x)))
+_unary("gammaln", jax.lax.lgamma)
+_unary("degrees", jnp.degrees)
+_unary("radians", jnp.radians)
+_unary("sin", jnp.sin)
+_unary("cos", jnp.cos)
+_unary("tan", jnp.tan)
+_unary("arcsin", jnp.arcsin)
+_unary("arccos", jnp.arccos)
+_unary("arctan", jnp.arctan)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+_unary("arcsinh", jnp.arcsinh)
+_unary("arccosh", jnp.arccosh)
+_unary("arctanh", jnp.arctanh)
+_unary("softsign", jax.nn.soft_sign)
+_unary("erf", jax.lax.erf)
+_unary("erfinv", jax.lax.erf_inv)
+_unary("logical_not", lambda x: (x == 0).astype(x.dtype))
+_unary("_copy", lambda x: x)
+_unary("identity", lambda x: x)
+
+
+@register("_identity_with_attr_like_rhs", arg_names=["lhs", "rhs"])
+def _identity_with_attr_like_rhs(lhs, rhs):
+    return lhs
+
+
+@register("cast", arg_names=["data"], aliases=("Cast",))
+def cast(data, dtype="float32"):
+    return data.astype(jnp.dtype(dtype))
+
+
+@register(
+    "BlockGrad", arg_names=["data"], aliases=("stop_gradient", "block_grad")
+)
+def block_grad(data):
+    return jax.lax.stop_gradient(data)
+
+
+# ---------------------------------------------------------------- binary
+# Reference elemwise binary ops require identical shapes
+# (elemwise_op_common.h); jax broadcasting is a strict superset, which the
+# Python frontend historically allowed via broadcast_* anyway.
+
+
+def _binary(name, fn, aliases=()):
+    register(name, arg_names=["lhs", "rhs"], aliases=aliases)(
+        lambda lhs, rhs, _fn=fn: _fn(lhs, rhs)
+    )
+
+
+_binary("elemwise_add", jnp.add, aliases=("_plus", "_Plus"))
+_binary("elemwise_sub", jnp.subtract, aliases=("_minus", "_Minus"))
+_binary("elemwise_mul", jnp.multiply, aliases=("_mul", "_Mul"))
+_binary("elemwise_div", jnp.divide, aliases=("_div", "_Div"))
+_binary("_power", jnp.power, aliases=("_Power", "pow"))
+_binary("_maximum", jnp.maximum, aliases=("_Maximum",))
+_binary("_minimum", jnp.minimum, aliases=("_Minimum",))
+_binary("_mod", jnp.mod, aliases=("_Mod",))
+_binary("_hypot", jnp.hypot, aliases=("_Hypot",))
+
+
+def _logic(name, fn, aliases=()):
+    # Reference logic ops return same-dtype 0/1 tensors (mshadow_op.h).
+    register(name, arg_names=["lhs", "rhs"], aliases=aliases)(
+        lambda lhs, rhs, _fn=fn: _fn(lhs, rhs).astype(
+            jnp.result_type(lhs, rhs)
+        )
+    )
+
+
+_logic("_equal", jnp.equal, aliases=("_Equal",))
+_logic("_not_equal", jnp.not_equal, aliases=("_Not_Equal",))
+_logic("_greater", jnp.greater, aliases=("_Greater",))
+_logic("_greater_equal", jnp.greater_equal, aliases=("_Greater_Equal",))
+_logic("_lesser", jnp.less, aliases=("_Lesser",))
+_logic("_lesser_equal", jnp.less_equal, aliases=("_Lesser_Equal",))
+
+# ------------------------------------------------------- broadcast binary
+
+for _name, _fn in [
+    ("broadcast_add", jnp.add),
+    ("broadcast_sub", jnp.subtract),
+    ("broadcast_mul", jnp.multiply),
+    ("broadcast_div", jnp.divide),
+    ("broadcast_power", jnp.power),
+    ("broadcast_maximum", jnp.maximum),
+    ("broadcast_minimum", jnp.minimum),
+    ("broadcast_mod", jnp.mod),
+    ("broadcast_hypot", jnp.hypot),
+]:
+    _binary(_name, _fn)
+
+for _name, _fn in [
+    ("broadcast_equal", jnp.equal),
+    ("broadcast_not_equal", jnp.not_equal),
+    ("broadcast_greater", jnp.greater),
+    ("broadcast_greater_equal", jnp.greater_equal),
+    ("broadcast_lesser", jnp.less),
+    ("broadcast_lesser_equal", jnp.less_equal),
+]:
+    _logic(_name, _fn)
+
+# --------------------------------------------------------------- scalar
+
+_SCALAR_COERCE = {"scalar": coerce_float}
+
+
+def _scalar_op(name, fn, aliases=()):
+    register(
+        name, arg_names=["data"], coerce=_SCALAR_COERCE, aliases=aliases
+    )(lambda data, scalar=0.0, _fn=fn: _fn(data, scalar))
+
+
+_scalar_op("_plus_scalar", lambda x, s: x + s, aliases=("_PlusScalar",))
+_scalar_op("_minus_scalar", lambda x, s: x - s, aliases=("_MinusScalar",))
+_scalar_op(
+    "_rminus_scalar", lambda x, s: s - x, aliases=("_RMinusScalar",)
+)
+_scalar_op("_mul_scalar", lambda x, s: x * s, aliases=("_MulScalar",))
+_scalar_op("_div_scalar", lambda x, s: x / s, aliases=("_DivScalar",))
+_scalar_op("_rdiv_scalar", lambda x, s: s / x, aliases=("_RDivScalar",))
+_scalar_op(
+    "_power_scalar", lambda x, s: jnp.power(x, s), aliases=("_PowerScalar",)
+)
+_scalar_op(
+    "_rpower_scalar",
+    lambda x, s: jnp.power(s, x),
+    aliases=("_RPowerScalar",),
+)
+_scalar_op(
+    "_maximum_scalar",
+    lambda x, s: jnp.maximum(x, s),
+    aliases=("_MaximumScalar",),
+)
+_scalar_op(
+    "_minimum_scalar",
+    lambda x, s: jnp.minimum(x, s),
+    aliases=("_MinimumScalar",),
+)
+_scalar_op("_mod_scalar", lambda x, s: jnp.mod(x, s), aliases=("_ModScalar",))
+_scalar_op(
+    "_rmod_scalar", lambda x, s: jnp.mod(s, x), aliases=("_RModScalar",)
+)
+_scalar_op(
+    "_hypot_scalar",
+    lambda x, s: jnp.hypot(x, s),
+    aliases=("_HypotScalar",),
+)
+
+
+def _scalar_logic(name, fn, aliases=()):
+    register(
+        name, arg_names=["data"], coerce=_SCALAR_COERCE, aliases=aliases
+    )(lambda data, scalar=0.0, _fn=fn: _fn(data, scalar).astype(data.dtype))
+
+
+_scalar_logic("_equal_scalar", jnp.equal, aliases=("_EqualScalar",))
+_scalar_logic(
+    "_not_equal_scalar", jnp.not_equal, aliases=("_NotEqualScalar",)
+)
+_scalar_logic("_greater_scalar", jnp.greater, aliases=("_GreaterScalar",))
+_scalar_logic(
+    "_greater_equal_scalar",
+    jnp.greater_equal,
+    aliases=("_GreaterEqualScalar",),
+)
+_scalar_logic("_lesser_scalar", jnp.less, aliases=("_LesserScalar",))
+_scalar_logic(
+    "_lesser_equal_scalar", jnp.less_equal, aliases=("_LesserEqualScalar",)
+)
+
+# ------------------------------------------------------------- variadic
+
+
+@register("add_n", aliases=("ElementWiseSum", "element_wise_sum"))
+def add_n(*args):
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+@register(
+    "smooth_l1",
+    arg_names=["data"],
+    coerce=_SCALAR_COERCE,
+    defaults={"scalar": 1.0},
+)
+def smooth_l1(data, scalar=1.0):
+    s2 = scalar * scalar
+    absx = jnp.abs(data)
+    return jnp.where(
+        absx < 1.0 / s2, 0.5 * s2 * jnp.square(data), absx - 0.5 / s2
+    )

@@ -1,0 +1,99 @@
+"""Indexing ops: Embedding, take, batch_take, one_hot, pick.
+
+Covers reference src/operator/tensor/indexing_op.{h,cc,cu}. Gathers lower
+to XLA gather; the Embedding backward becomes a scatter-add XLA emits from
+the vjp — no hand-written AddTakeGrad kernel needed.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+from ..base import coerce_float, coerce_int
+
+
+@register(
+    "Embedding",
+    arg_names=["data", "weight"],
+    coerce={"input_dim": coerce_int, "output_dim": coerce_int},
+    no_grad_inputs=("data",),
+)
+def embedding(data, weight, input_dim=0, output_dim=0, dtype="float32"):
+    idx = data.astype(jnp.int32)
+    return jnp.take(weight, idx, axis=0)
+
+
+@register(
+    "take",
+    arg_names=["a", "indices"],
+    coerce={"axis": coerce_int},
+    defaults={"axis": 0, "mode": "clip"},
+    no_grad_inputs=("indices",),
+)
+def take(a, indices, axis=0, mode="clip"):
+    idx = indices.astype(jnp.int32)
+    if mode == "clip":
+        idx = jnp.clip(idx, 0, a.shape[axis] - 1)
+    elif mode == "wrap":
+        idx = jnp.mod(idx, a.shape[axis])
+    return jnp.take(a, idx, axis=axis)
+
+
+@register("batch_take", arg_names=["a", "indices"], no_grad_inputs=("indices",))
+def batch_take(a, indices):
+    idx = indices.astype(jnp.int32)
+    return jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
+
+
+@register(
+    "one_hot",
+    arg_names=["indices"],
+    coerce={
+        "depth": coerce_int,
+        "on_value": coerce_float,
+        "off_value": coerce_float,
+    },
+    defaults={"on_value": 1.0, "off_value": 0.0, "dtype": "float32"},
+    no_grad_inputs=("indices",),
+)
+def one_hot(indices, depth=0, on_value=1.0, off_value=0.0, dtype="float32"):
+    idx = indices.astype(jnp.int32)
+    eye = jnp.arange(depth, dtype=jnp.int32)
+    hot = (idx[..., None] == eye).astype(jnp.dtype(dtype))
+    return hot * on_value + (1.0 - hot) * off_value
+
+
+@register(
+    "pick",
+    arg_names=["data", "index"],
+    coerce={
+        "axis": lambda v: None if v in (None, "None", "") else coerce_int(v),
+        "keepdims": lambda v: v in (True, "1", "true", "True"),
+    },
+    defaults={"axis": -1, "keepdims": False},
+    no_grad_inputs=("index",),
+)
+def pick(data, index, axis=-1, keepdims=False):
+    if axis is None:
+        flat = data.reshape(-1)
+        out = jnp.take(flat, index.astype(jnp.int32))
+        return out
+    idx = jnp.expand_dims(index.astype(jnp.int32), axis=axis)
+    out = jnp.take_along_axis(data, idx, axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register(
+    "where",
+    arg_names=["condition", "x", "y"],
+    no_grad_inputs=("condition",),
+)
+def where(condition, x, y):
+    cond = condition
+    if cond.shape != x.shape and cond.ndim == 1:
+        # reference allows a batch-length condition vector
+        # (control_flow_op.h)
+        cond = cond.reshape((-1,) + (1,) * (x.ndim - 1))
+    return jnp.where(cond != 0, x, y)

@@ -1,0 +1,167 @@
+"""Init ops (zeros/ones/arange) and random samplers.
+
+Covers reference src/operator/tensor/init_op.{h,cc} and sample_op.{h,cc}.
+Random ops consume an explicit jax PRNG key (`rng` kwarg threaded by the
+imperative layer / executor) instead of the reference's per-device mshadow
+Random resource (include/mxnet/resource.h kRandom) — counter-based PRNG is
+the TPU-native idiom: reproducible across replicas and shardable.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from ..base import coerce_float, coerce_int, coerce_tuple
+
+_SHAPE_DTYPE = {
+    "shape": coerce_tuple,
+}
+
+
+@register("_zeros", coerce=_SHAPE_DTYPE, defaults={"dtype": "float32"})
+def _zeros(shape=(), dtype="float32", ctx=None):
+    return jnp.zeros(shape, dtype=jnp.dtype(dtype))
+
+
+@register("_ones", coerce=_SHAPE_DTYPE, defaults={"dtype": "float32"})
+def _ones(shape=(), dtype="float32", ctx=None):
+    return jnp.ones(shape, dtype=jnp.dtype(dtype))
+
+
+@register(
+    "_full",
+    coerce={"shape": coerce_tuple, "value": coerce_float},
+    defaults={"dtype": "float32"},
+)
+def _full(shape=(), value=0.0, dtype="float32", ctx=None):
+    return jnp.full(shape, value, dtype=jnp.dtype(dtype))
+
+
+@register(
+    "_arange",
+    coerce={
+        "start": coerce_float,
+        "stop": lambda v: None if v in (None, "None", "") else float(v),
+        "step": coerce_float,
+        "repeat": coerce_int,
+    },
+    defaults={"start": 0.0, "stop": None, "step": 1.0, "repeat": 1,
+              "dtype": "float32"},
+)
+def _arange(start=0.0, stop=None, step=1.0, repeat=1, dtype="float32",
+            ctx=None, infer_range=False):
+    if stop is None:
+        start, stop = 0.0, start
+    out = jnp.arange(start, stop, step, dtype=jnp.dtype(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register(
+    "ones_like",
+    arg_names=["data"],
+    no_grad_inputs=("data",),
+)
+def ones_like(data):
+    return jnp.ones_like(data)
+
+
+@register(
+    "zeros_like",
+    arg_names=["data"],
+    no_grad_inputs=("data",),
+)
+def zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+# ------------------------------------------------------------- samplers
+
+_SAMPLE_COERCE = {
+    "shape": coerce_tuple,
+    "low": coerce_float,
+    "high": coerce_float,
+    "loc": coerce_float,
+    "scale": coerce_float,
+    "lam": coerce_float,
+    "alpha": coerce_float,
+    "beta": coerce_float,
+    "k": coerce_float,
+    "p": coerce_float,
+    "mu": coerce_float,
+    "sigma": coerce_float,
+}
+
+
+def _sample(name, aliases=()):
+    def deco(fn):
+        return register(
+            name,
+            coerce=_SAMPLE_COERCE,
+            defaults={"dtype": "float32"},
+            needs_rng=True,
+            aliases=aliases,
+        )(fn)
+
+    return deco
+
+
+@_sample("_random_uniform", aliases=("_sample_uniform", "uniform"))
+def random_uniform(low=0.0, high=1.0, shape=(), dtype="float32", ctx=None,
+                   rng=None):
+    return jax.random.uniform(
+        rng, shape, jnp.dtype(dtype), minval=low, maxval=high
+    )
+
+
+@_sample("_random_normal", aliases=("_sample_normal", "normal"))
+def random_normal(loc=0.0, scale=1.0, shape=(), dtype="float32", ctx=None,
+                  rng=None, mu=None, sigma=None):
+    if mu is not None:
+        loc = mu
+    if sigma is not None:
+        scale = sigma
+    return loc + scale * jax.random.normal(rng, shape, jnp.dtype(dtype))
+
+
+@_sample("_random_gamma", aliases=("_sample_gamma",))
+def random_gamma(alpha=1.0, beta=1.0, shape=(), dtype="float32", ctx=None,
+                 rng=None):
+    return beta * jax.random.gamma(rng, alpha, shape, jnp.dtype(dtype))
+
+
+@_sample("_random_exponential", aliases=("_sample_exponential",))
+def random_exponential(lam=1.0, shape=(), dtype="float32", ctx=None,
+                       rng=None):
+    return jax.random.exponential(rng, shape, jnp.dtype(dtype)) / lam
+
+
+@_sample("_random_poisson", aliases=("_sample_poisson",))
+def random_poisson(lam=1.0, shape=(), dtype="float32", ctx=None, rng=None):
+    return jax.random.poisson(rng, lam, shape).astype(jnp.dtype(dtype))
+
+
+@_sample(
+    "_random_negative_binomial", aliases=("_sample_negative_binomial",)
+)
+def random_negative_binomial(k=1.0, p=1.0, shape=(), dtype="float32",
+                             ctx=None, rng=None):
+    # NB(k, p) == Poisson(Gamma(k, (1-p)/p)) (sample_op.h semantics)
+    k1, k2 = jax.random.split(rng)
+    lam = jax.random.gamma(k1, k, shape) * ((1.0 - p) / p)
+    return jax.random.poisson(k2, lam, shape).astype(jnp.dtype(dtype))
+
+
+@_sample(
+    "_random_generalized_negative_binomial",
+    aliases=("_sample_generalized_negative_binomial",),
+)
+def random_gen_negative_binomial(mu=1.0, alpha=1.0, shape=(),
+                                 dtype="float32", ctx=None, rng=None):
+    k = 1.0 / alpha
+    p = k / (k + mu)
+    k1, k2 = jax.random.split(rng)
+    lam = jax.random.gamma(k1, k, shape) * ((1.0 - p) / p)
+    return jax.random.poisson(k2, lam, shape).astype(jnp.dtype(dtype))

@@ -1,0 +1,294 @@
+"""Matrix/shape-manipulation ops.
+
+Covers reference src/operator/tensor/matrix_op-inl.h (1733 LoC): dot,
+batch_dot, transpose, reshape, flatten, slice, slice_axis, flip, clip,
+repeat, tile, expand_dims, swapaxes, pad, crop. dot/batch_dot lower to
+XLA dot_general — the MXU path; everything else is layout work XLA folds
+into neighboring kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from ..base import MXNetError, coerce_bool, coerce_float, coerce_int, coerce_tuple
+
+_TT = {"transpose_a": coerce_bool, "transpose_b": coerce_bool}
+
+
+@register("dot", arg_names=["lhs", "rhs"], coerce=_TT)
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    a = lhs.T if transpose_a else lhs
+    b = rhs.T if transpose_b else rhs
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b).reshape((1,))
+    # Reference dot contracts last axis of a with first of b for any rank
+    # (matrix_op-inl.h DotForward).
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register("batch_dot", arg_names=["lhs", "rhs"], coerce=_TT)
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jnp.matmul(a, b)
+
+
+def _infer_reshape(shape, src_shape, reverse=False):
+    """MXNet reshape semantics: 0 copies input dim, -1 infers, -2 copies
+    all remaining, -3 merges two dims, -4 splits a dim
+    (matrix_op-inl.h ReshapeInferShape)."""
+    if reverse:
+        src = list(reversed(src_shape))
+        out = _infer_reshape(list(reversed(list(shape))), src, False)
+        return tuple(reversed(out))
+    src = list(src_shape)
+    out = []
+    src_idx = 0
+    i = 0
+    shape = list(shape)
+    while i < len(shape):
+        s = shape[i]
+        if s > 0:
+            out.append(s)
+            src_idx += 1
+        elif s == 0:
+            out.append(src[src_idx])
+            src_idx += 1
+        elif s == -1:
+            out.append(-1)
+            src_idx += 1
+        elif s == -2:
+            out.extend(src[src_idx:])
+            src_idx = len(src)
+        elif s == -3:
+            out.append(src[src_idx] * src[src_idx + 1])
+            src_idx += 2
+        elif s == -4:
+            d1, d2 = shape[i + 1], shape[i + 2]
+            cur = src[src_idx]
+            if d1 == -1:
+                d1 = cur // d2
+            if d2 == -1:
+                d2 = cur // d1
+            out.extend([d1, d2])
+            src_idx += 1
+            i += 2
+        else:
+            raise MXNetError(f"bad reshape token {s}")
+        i += 1
+    if out.count(-1) > 1:
+        raise MXNetError("reshape can infer at most one dim")
+    return tuple(out)
+
+
+@register(
+    "reshape",
+    arg_names=["data"],
+    coerce={"shape": coerce_tuple, "reverse": coerce_bool},
+    aliases=("Reshape",),
+)
+def reshape(data, shape=(), reverse=False, target_shape=None, keep_highest=False):
+    if target_shape is not None and not shape:
+        # legacy target_shape param (matrix_op-inl.h ReshapeParam)
+        tgt = coerce_tuple(target_shape)
+        if keep_highest:
+            tgt = (data.shape[0],) + tuple(tgt)[1:]
+        return jnp.reshape(data, tgt)
+    out = _infer_reshape(shape, data.shape, reverse)
+    return jnp.reshape(data, out)
+
+
+@register("flatten", arg_names=["data"], aliases=("Flatten",))
+def flatten(data):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register(
+    "transpose",
+    arg_names=["data"],
+    coerce={"axes": coerce_tuple},
+)
+def transpose(data, axes=()):
+    return jnp.transpose(data, axes or None)
+
+
+@register(
+    "expand_dims", arg_names=["data"], coerce={"axis": coerce_int}
+)
+def expand_dims(data, axis=0):
+    return jnp.expand_dims(data, axis)
+
+
+@register(
+    "SwapAxis",
+    arg_names=["data"],
+    coerce={"dim1": coerce_int, "dim2": coerce_int},
+    aliases=("swapaxes",),
+)
+def swapaxes(data, dim1=0, dim2=0):
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+def _coerce_idx_tuple(v):
+    if v in (None, "None", ""):
+        return None
+    return coerce_tuple(
+        v, typ=lambda x: None if str(x) in ("None", "") else int(x)
+    )
+
+
+@register(
+    "slice",
+    arg_names=["data"],
+    coerce={"begin": _coerce_idx_tuple, "end": _coerce_idx_tuple},
+    aliases=("crop",),
+)
+def slice_op(data, begin=(), end=()):
+    idx = tuple(
+        _slice(b, e)
+        for b, e in zip(begin, end)
+    )
+    return data[idx]
+
+
+def _slice(b, e):
+    return slice(b, e)
+
+
+@register(
+    "slice_axis",
+    arg_names=["data"],
+    coerce={
+        "axis": coerce_int,
+        "begin": coerce_int,
+        "end": lambda v: None if v in (None, "None", "") else coerce_int(v),
+    },
+)
+def slice_axis(data, axis=0, begin=0, end=None):
+    idx = [slice(None)] * data.ndim
+    idx[axis] = slice(begin, end)
+    return data[tuple(idx)]
+
+
+@register("flip", arg_names=["data"], coerce={"axis": coerce_tuple},
+          aliases=("reverse",))
+def flip(data, axis=()):
+    return jnp.flip(data, axis)
+
+
+@register(
+    "clip",
+    arg_names=["data"],
+    coerce={"a_min": coerce_float, "a_max": coerce_float},
+)
+def clip(data, a_min=None, a_max=None):
+    if a_min is None or a_max is None:
+        # required dmlc params in the reference (matrix_op-inl.h ClipParam)
+        raise MXNetError("clip requires both a_min and a_max")
+    return jnp.clip(data, a_min, a_max)
+
+
+@register(
+    "repeat",
+    arg_names=["data"],
+    coerce={
+        "repeats": coerce_int,
+        "axis": lambda v: None if v in (None, "None", "") else coerce_int(v),
+    },
+)
+def repeat(data, repeats=1, axis=None):
+    return jnp.repeat(data, repeats, axis=axis)
+
+
+@register("tile", arg_names=["data"], coerce={"reps": coerce_tuple})
+def tile(data, reps=()):
+    return jnp.tile(data, reps)
+
+
+@register(
+    "Concat",
+    coerce={"dim": coerce_int, "num_args": coerce_int},
+    defaults={"dim": 1},
+    aliases=("concat",),
+)
+def concat(*args, dim=1, num_args=None):
+    return jnp.concatenate(args, axis=dim)
+
+
+@register(
+    "SliceChannel",
+    arg_names=["data"],
+    coerce={
+        "num_outputs": coerce_int,
+        "axis": coerce_int,
+        "squeeze_axis": coerce_bool,
+    },
+    defaults={"axis": 1, "squeeze_axis": False},
+    aliases=("slice_channel", "split"),
+    num_outputs_fn=lambda p: int(p.get("num_outputs", 1)),
+)
+def slice_channel(data, num_outputs=1, axis=1, squeeze_axis=False):
+    parts = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register(
+    "stack",
+    coerce={"axis": coerce_int, "num_args": coerce_int},
+    defaults={"axis": 0},
+)
+def stack(*args, axis=0, num_args=None):
+    return jnp.stack(args, axis=axis)
+
+
+@register(
+    "Pad",
+    arg_names=["data"],
+    coerce={
+        "pad_width": coerce_tuple,
+        "constant_value": coerce_float,
+    },
+    defaults={"mode": "constant", "constant_value": 0.0},
+    aliases=("pad",),
+)
+def pad(data, mode="constant", pad_width=(), constant_value=0.0):
+    pw = [
+        (pad_width[2 * i], pad_width[2 * i + 1]) for i in range(data.ndim)
+    ]
+    if mode == "constant":
+        return jnp.pad(data, pw, constant_values=constant_value)
+    if mode == "edge":
+        return jnp.pad(data, pw, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(data, pw, mode="reflect")
+    raise MXNetError(f"unknown pad mode {mode!r}")
+
+
+@register(
+    "Crop",
+    coerce={
+        "num_args": coerce_int,
+        "offset": coerce_tuple,
+        "h_w": coerce_tuple,
+        "center_crop": coerce_bool,
+    },
+    defaults={"offset": (0, 0), "h_w": (0, 0), "center_crop": False},
+)
+def crop_like(*args, num_args=None, offset=(0, 0), h_w=(0, 0), center_crop=False):
+    """Crop op (src/operator/crop-inl.h): crop first input spatially to
+    h_w, or to the size of a second reference input."""
+    data = args[0]
+    if len(args) == 2:
+        th, tw = args[1].shape[2], args[1].shape[3]
+    else:
+        th, tw = h_w
+    H, W = data.shape[2], data.shape[3]
+    if center_crop:
+        y0, x0 = (H - th) // 2, (W - tw) // 2
+    else:
+        y0, x0 = offset
+    return data[:, :, y0 : y0 + th, x0 : x0 + tw]

@@ -1,0 +1,126 @@
+"""Fused optimizer-update ops.
+
+Covers reference src/operator/optimizer_op-inl.h (sgd_update,
+sgd_mom_update, adam_update, rmsprop_update, rmspropalex_update). Each is
+one fused XLA computation — weight/state update in a single kernel, the
+analog of the reference's fused mshadow expressions. Executors and the
+Optimizer classes both route through these.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+from ..base import coerce_float
+
+_F = {
+    "lr": coerce_float,
+    "wd": coerce_float,
+    "rescale_grad": coerce_float,
+    "clip_gradient": coerce_float,
+    "momentum": coerce_float,
+    "beta1": coerce_float,
+    "beta2": coerce_float,
+    "epsilon": coerce_float,
+    "gamma1": coerce_float,
+    "gamma2": coerce_float,
+    "clip_weights": coerce_float,
+}
+
+
+def _prep_grad(grad, rescale_grad, clip_gradient):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g
+
+
+@register(
+    "sgd_update",
+    arg_names=["weight", "grad"],
+    coerce=_F,
+    defaults={"wd": 0.0, "rescale_grad": 1.0, "clip_gradient": -1.0},
+)
+def sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient)
+    return weight - lr * (g + wd * weight)
+
+
+@register(
+    "sgd_mom_update",
+    arg_names=["weight", "grad", "mom"],
+    num_outputs=2,
+    coerce=_F,
+    defaults={"momentum": 0.0, "wd": 0.0, "rescale_grad": 1.0,
+              "clip_gradient": -1.0},
+)
+def sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    """Returns (weight', mom') — reference mutates mom in place; the
+    functional form returns both (optimizer_op-inl.h:64-100)."""
+    g = _prep_grad(grad, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * (g + wd * weight)
+    return weight + new_mom, new_mom
+
+
+@register(
+    "adam_update",
+    arg_names=["weight", "grad", "mean", "var"],
+    num_outputs=3,
+    coerce=_F,
+    defaults={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8, "wd": 0.0,
+              "rescale_grad": 1.0, "clip_gradient": -1.0},
+)
+def adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient) + wd * weight
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    new_weight = weight - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+    return new_weight, new_mean, new_var
+
+
+@register(
+    "rmsprop_update",
+    arg_names=["weight", "grad", "n"],
+    num_outputs=2,
+    coerce=_F,
+    defaults={"gamma1": 0.95, "epsilon": 1e-8, "wd": 0.0,
+              "rescale_grad": 1.0, "clip_gradient": -1.0,
+              "clip_weights": -1.0},
+)
+def rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.95, epsilon=1e-8,
+                   wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                   clip_weights=-1.0):
+    g = _prep_grad(grad, rescale_grad, clip_gradient) + wd * weight
+    new_n = (1 - gamma1) * jnp.square(g) + gamma1 * n
+    new_weight = weight - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        new_weight = jnp.clip(new_weight, -clip_weights, clip_weights)
+    return new_weight, new_n
+
+
+@register(
+    "rmspropalex_update",
+    arg_names=["weight", "grad", "n", "g", "delta"],
+    num_outputs=4,
+    coerce=_F,
+    defaults={"gamma1": 0.95, "gamma2": 0.9, "epsilon": 1e-8, "wd": 0.0,
+              "rescale_grad": 1.0, "clip_gradient": -1.0,
+              "clip_weights": -1.0},
+)
+def rmspropalex_update(weight, grad, n, g, delta, lr=0.001, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0):
+    """Graves-style RMSProp (optimizer_op-inl.h rmspropalex)."""
+    gr = _prep_grad(grad, rescale_grad, clip_gradient) + wd * weight
+    new_n = (1 - gamma1) * jnp.square(gr) + gamma1 * n
+    new_g = (1 - gamma1) * gr + gamma1 * g
+    new_delta = gamma2 * delta - lr * gr / jnp.sqrt(
+        new_n - jnp.square(new_g) + epsilon
+    )
+    new_weight = weight + new_delta
+    if clip_weights is not None and clip_weights > 0:
+        new_weight = jnp.clip(new_weight, -clip_weights, clip_weights)
+    return new_weight, new_n, new_g, new_delta

@@ -1,0 +1,89 @@
+"""Ordering ops: sort, argsort, topk.
+
+Covers reference src/operator/tensor/ordering_op-inl.h + sort_op.h (which
+wrap thrust/cub device sorts). XLA's sort/top_k lower natively on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from ..base import MXNetError, coerce_bool, coerce_int
+
+
+_AXIS = lambda v: None if v in (None, "None", "") else coerce_int(v)
+
+
+@register(
+    "sort",
+    arg_names=["data"],
+    coerce={"axis": _AXIS, "is_ascend": coerce_bool},
+    defaults={"axis": -1, "is_ascend": True},
+)
+def sort(data, axis=-1, is_ascend=True):
+    if axis is None:
+        out = jnp.sort(data.reshape(-1), axis=0)
+    else:
+        out = jnp.sort(data, axis=axis)
+        axis_ = axis
+    if not is_ascend:
+        out = jnp.flip(out, axis=0 if axis is None else axis)
+    return out
+
+
+@register(
+    "argsort",
+    arg_names=["data"],
+    coerce={"axis": _AXIS, "is_ascend": coerce_bool},
+    defaults={"axis": -1, "is_ascend": True},
+    no_grad_inputs=("data",),
+)
+def argsort(data, axis=-1, is_ascend=True):
+    if axis is None:
+        data = data.reshape(-1)
+        axis = 0
+    out = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(jnp.float32)
+
+
+def _topk_num_outputs(params):
+    ret = params.get("ret_typ", "indices")
+    return 2 if ret == "both" else 1
+
+
+@register(
+    "topk",
+    arg_names=["data"],
+    coerce={"axis": _AXIS, "k": coerce_int, "is_ascend": coerce_bool},
+    defaults={"axis": -1, "k": 1, "ret_typ": "indices", "is_ascend": False},
+    num_outputs_fn=_topk_num_outputs,
+)
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+    if axis is None:
+        data = data.reshape(-1)
+        axis = 0
+    axis = axis % data.ndim
+    moved = jnp.moveaxis(data, axis, -1)
+    if is_ascend:
+        vals, idx = jax.lax.top_k(-moved, k)
+        vals = -vals
+    else:
+        vals, idx = jax.lax.top_k(moved, k)
+    vals = jnp.moveaxis(vals, -1, axis)
+    idxf = jnp.moveaxis(idx, -1, axis).astype(jnp.float32)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "indices":
+        return idxf
+    if ret_typ == "both":
+        return vals, idxf
+    if ret_typ == "mask":
+        mask = jnp.zeros_like(moved)
+        mask = jnp.put_along_axis(
+            mask, idx, 1.0, axis=-1, inplace=False
+        )
+        return jnp.moveaxis(mask, -1, axis)
+    raise MXNetError(f"unknown ret_typ {ret_typ!r}")

@@ -1,0 +1,133 @@
+"""Single operator registry.
+
+The reference has TWO registries (legacy `OperatorProperty`,
+include/mxnet/operator.h:166, bridged by src/nnvm/legacy_op_util.cc, plus
+new-style `NNVM_REGISTER_OP` FCompute ops, include/mxnet/op_attr_types.h).
+The TPU-native design collapses them into one: an op is a **pure jax
+function** plus metadata. Shape/type inference is NOT hand-written per op
+(the reference's InferShape/InferType attributes) — it falls out of
+`jax.eval_shape` abstract evaluation, and gradients fall out of `jax.vjp`
+instead of per-op Backward kernels. Ops whose reference semantics differ
+from the mathematical vjp (SoftmaxOutput, MakeLoss, BlockGrad, ...) wrap
+their fn in `jax.custom_vjp`.
+
+Conventions for the registered fn:
+  fn(*inputs, **params) -> jax.Array | tuple[jax.Array, ...]
+  - `params` are already-coerced python values (see `coerce` map).
+  - ops with `needs_rng` receive a `rng` kwarg (jax PRNG key).
+  - ops with `needs_mode` receive an `is_train` kwarg (python bool --
+    static under jit; executors trace train/eval variants separately).
+  - ops with `aux_names` take the aux arrays as trailing inputs and,
+    when `is_train=True`, return extra trailing outputs: the updated aux
+    values (the functional replacement for the reference's mutable
+    aux_states, e.g. BatchNorm moving mean/var).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+from ..base import MXNetError
+
+
+@dataclass
+class OpDef:
+    name: str
+    fn: Callable
+    num_outputs: int = 1
+    # Named inputs for Symbol composition (e.g. ['data','weight','bias']).
+    # None => variadic (*args), e.g. Concat / add_n.
+    arg_names: Optional[Sequence[str]] = None
+    aux_names: Sequence[str] = ()
+    coerce: dict = field(default_factory=dict)
+    defaults: dict = field(default_factory=dict)
+    needs_rng: bool = False
+    needs_mode: bool = False
+    # Aliases under which the op is also exposed (reference registers many
+    # ops under both CamelCase and snake_case names).
+    aliases: Sequence[str] = ()
+    # Which num_outputs to expose when params are known (e.g. SliceChannel's
+    # num_outputs depends on its params); callable(params)->int.
+    num_outputs_fn: Optional[Callable] = None
+    # Optional list of input names whose gradient is always zero
+    # (e.g. labels); purely informational for executors.
+    no_grad_inputs: Sequence[str] = ()
+
+    def resolved_num_outputs(self, params) -> int:
+        if self.num_outputs_fn is not None:
+            return self.num_outputs_fn(params)
+        return self.num_outputs
+
+    def normalize_params(self, kwargs: dict) -> dict:
+        out = dict(self.defaults)
+        for k, v in kwargs.items():
+            if v is None:
+                continue
+            fn = self.coerce.get(k)
+            out[k] = fn(v) if fn is not None else v
+        return out
+
+
+_REGISTRY: dict[str, OpDef] = {}
+
+
+def register(
+    name,
+    num_outputs=1,
+    arg_names=None,
+    aux_names=(),
+    coerce=None,
+    defaults=None,
+    needs_rng=False,
+    needs_mode=False,
+    aliases=(),
+    num_outputs_fn=None,
+    no_grad_inputs=(),
+):
+    """Decorator registering a jax function as a framework op."""
+
+    def deco(fn):
+        op = OpDef(
+            name=name,
+            fn=fn,
+            num_outputs=num_outputs,
+            arg_names=arg_names,
+            aux_names=tuple(aux_names),
+            coerce=coerce or {},
+            defaults=defaults or {},
+            needs_rng=needs_rng,
+            needs_mode=needs_mode,
+            aliases=tuple(aliases),
+            num_outputs_fn=num_outputs_fn,
+            no_grad_inputs=tuple(no_grad_inputs),
+        )
+        if name in _REGISTRY:
+            raise MXNetError(f"op {name!r} registered twice")
+        _REGISTRY[name] = op
+        for alias in op.aliases:
+            if alias in _REGISTRY:
+                raise MXNetError(f"op alias {alias!r} registered twice")
+            _REGISTRY[alias] = op
+        return fn
+
+    return deco
+
+
+def get(name: str) -> OpDef:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise MXNetError(f"unknown op {name!r}") from None
+
+
+def exists(name: str) -> bool:
+    return name in _REGISTRY
+
+
+def list_ops() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def canonical_ops() -> dict[str, OpDef]:
+    """name -> OpDef for canonical names only (aliases collapsed)."""
+    return {name: op for name, op in _REGISTRY.items() if op.name == name}

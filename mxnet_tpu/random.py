@@ -1,0 +1,36 @@
+"""Global PRNG state.
+
+Analog of the reference's per-device mshadow Random resource seeded by
+`mx.random.seed` (src/resource.cc SeedRandom). TPU-native: a single
+counter-based jax PRNG key chain; every random op draws a fresh split.
+Keys are recorded on the autograd tape so replay is deterministic.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_state = threading.local()
+_DEFAULT_SEED = 0
+
+
+def _key():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(_DEFAULT_SEED)
+    return _state.key
+
+
+def seed(seed_state: int):
+    """Seed the global PRNG (analog of MXRandomSeed)."""
+    _state.key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    k = _key()
+    _state.key, out = jax.random.split(k)
+    return out
+
+
+# Sampler front-ends (python/mxnet/random.py) are generated onto the
+# ndarray module from the op registry; `uniform`/`normal` re-exported there.

@@ -1,0 +1,15 @@
+"""Test configuration: run the whole suite on a virtual 8-device CPU mesh
+so multi-chip sharding semantics are exercised without TPU hardware
+(analog of the reference testing multi-device semantics with
+mx.cpu(0)/mx.cpu(1), tests/python/unittest/test_model_parallel.py).
+Must set flags before jax is imported anywhere.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,56 @@
+"""Imperative autograd tests (model: reference tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def test_grad_and_loss():
+    @autograd.grad_and_loss
+    def f(x):
+        return x * x + 2 * x
+
+    x = nd.array([1.0, 2.0, 3.0])
+    grads, loss = f(x)
+    np.testing.assert_allclose(grads[0].asnumpy(), [4.0, 6.0, 8.0])
+    np.testing.assert_allclose(loss.asnumpy(), [3.0, 8.0, 15.0])
+
+
+def test_compute_gradient_chain():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    gx = nd.zeros((2, 2))
+    autograd.mark_variables([x], [gx])
+    with autograd.train_section():
+        y = nd.exp(x)
+        z = y * y
+    autograd.compute_gradient([z])
+    np.testing.assert_allclose(
+        gx.asnumpy(), 2 * np.exp(2 * x.asnumpy()), rtol=1e-5
+    )
+
+
+def test_training_mode_dropout():
+    x = nd.ones((100, 100))
+    with autograd.train_section():
+        y = nd.Dropout(x, p=0.5)
+    frac_zero = (y.asnumpy() == 0).mean()
+    assert 0.3 < frac_zero < 0.7
+    # eval mode: identity
+    z = nd.Dropout(x, p=0.5)
+    np.testing.assert_array_equal(z.asnumpy(), x.asnumpy())
+
+
+def test_softmax_output_grad():
+    # loss-op custom backward: grad = (softmax - onehot)
+    data = nd.array([[1.0, 2.0, 3.0], [1.0, 1.0, 1.0]])
+    label = nd.array([2.0, 0.0])
+    g = nd.zeros((2, 3))
+    autograd.mark_variables([data], [g])
+    with autograd.train_section():
+        out = nd.SoftmaxOutput(data, label)
+    autograd.compute_gradient([out])
+    p = np.exp(data.asnumpy()) / np.exp(data.asnumpy()).sum(1, keepdims=True)
+    expect = p.copy()
+    expect[0, 2] -= 1
+    expect[1, 0] -= 1
+    np.testing.assert_allclose(g.asnumpy(), expect, rtol=1e-5)

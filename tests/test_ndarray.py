@@ -1,0 +1,220 @@
+"""NDArray unit tests (model: reference tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_creation():
+    a = nd.zeros((3, 4))
+    assert a.shape == (3, 4)
+    assert a.dtype == np.float32
+    np.testing.assert_array_equal(a.asnumpy(), np.zeros((3, 4), np.float32))
+    b = nd.ones((2, 2), dtype=np.float16)
+    assert b.dtype == np.float16
+    c = nd.full((2,), 3.5)
+    np.testing.assert_allclose(c.asnumpy(), [3.5, 3.5])
+    d = nd.array([[1, 2], [3, 4]])
+    assert d.shape == (2, 2)
+    e = nd.arange(0, 10, 2)
+    np.testing.assert_allclose(e.asnumpy(), [0, 2, 4, 6, 8])
+
+
+def test_elementwise():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[10.0, 20.0], [30.0, 40.0]])
+    np.testing.assert_allclose((a + b).asnumpy(), [[11, 22], [33, 44]])
+    np.testing.assert_allclose((b - a).asnumpy(), [[9, 18], [27, 36]])
+    np.testing.assert_allclose((a * b).asnumpy(), [[10, 40], [90, 160]])
+    np.testing.assert_allclose((b / a).asnumpy(), [[10, 10], [10, 10]])
+    np.testing.assert_allclose((a + 1).asnumpy(), [[2, 3], [4, 5]])
+    np.testing.assert_allclose((1 - a).asnumpy(), [[0, -1], [-2, -3]])
+    np.testing.assert_allclose((2 / a).asnumpy(), [[2, 1], [2 / 3, 0.5]],
+                               rtol=1e-6)
+    np.testing.assert_allclose((a ** 2).asnumpy(), [[1, 4], [9, 16]],
+                               rtol=1e-5)
+    np.testing.assert_allclose((-a).asnumpy(), [[-1, -2], [-3, -4]])
+
+
+def test_inplace():
+    a = nd.ones((2, 2))
+    a += 1
+    np.testing.assert_allclose(a.asnumpy(), np.full((2, 2), 2.0))
+    a *= 3
+    np.testing.assert_allclose(a.asnumpy(), np.full((2, 2), 6.0))
+
+
+def test_comparison():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    np.testing.assert_allclose((a > b).asnumpy(), [0, 0, 1])
+    np.testing.assert_allclose((a == b).asnumpy(), [0, 1, 0])
+    np.testing.assert_allclose((a <= 2).asnumpy(), [1, 1, 0])
+
+
+def test_views_write_through():
+    a = nd.zeros((4, 3))
+    row = a[1]
+    row[:] = 7.0
+    assert a.asnumpy()[1].tolist() == [7, 7, 7]
+    assert a.asnumpy()[0].tolist() == [0, 0, 0]
+    sl = a[2:4]
+    sl[:] = 1.0
+    np.testing.assert_allclose(a.asnumpy()[2:], np.ones((2, 3)))
+    # view reads see base writes
+    a[1] = 9.0
+    np.testing.assert_allclose(row.asnumpy(), [9, 9, 9])
+
+
+def test_setitem():
+    a = nd.zeros((3, 3))
+    a[0, 1] = 5.0
+    assert a.asnumpy()[0, 1] == 5.0
+    a[:] = 2.0
+    np.testing.assert_allclose(a.asnumpy(), np.full((3, 3), 2.0))
+
+
+def test_dot():
+    a = nd.array(np.arange(6).reshape(2, 3))
+    b = nd.array(np.arange(12).reshape(3, 4))
+    c = nd.dot(a, b)
+    np.testing.assert_allclose(
+        c.asnumpy(), np.arange(6).reshape(2, 3) @ np.arange(12).reshape(3, 4)
+    )
+    # transpose flags
+    d = nd.dot(a, a, transpose_b=True)
+    np.testing.assert_allclose(
+        d.asnumpy(),
+        np.arange(6).reshape(2, 3) @ np.arange(6).reshape(2, 3).T,
+    )
+
+
+def test_reductions():
+    x = np.random.RandomState(0).rand(3, 4, 5).astype(np.float32)
+    a = nd.array(x)
+    np.testing.assert_allclose(nd.sum(a).asnumpy(), x.sum(), rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.sum(a, axis=1).asnumpy(), x.sum(axis=1), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        nd.max(a, axis=(0, 2)).asnumpy(), x.max(axis=(0, 2)), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        nd.argmax(a, axis=2).asnumpy(), x.argmax(axis=2)
+    )
+    np.testing.assert_allclose(
+        nd.norm(a).asnumpy(), [np.sqrt((x ** 2).sum())], rtol=1e-5
+    )
+
+
+def test_reshape_slice():
+    x = np.arange(24).reshape(2, 3, 4).astype(np.float32)
+    a = nd.array(x)
+    np.testing.assert_array_equal(
+        nd.reshape(a, shape=(0, -1)).asnumpy(), x.reshape(2, 12)
+    )
+    np.testing.assert_array_equal(nd.flatten(a).asnumpy(), x.reshape(2, 12))
+    np.testing.assert_array_equal(
+        nd.transpose(a, axes=(2, 0, 1)).asnumpy(), x.transpose(2, 0, 1)
+    )
+    np.testing.assert_array_equal(
+        nd.slice_axis(a, axis=1, begin=1, end=3).asnumpy(), x[:, 1:3]
+    )
+    np.testing.assert_array_equal(
+        nd.expand_dims(a, axis=1).asnumpy(), x[:, None]
+    )
+
+
+def test_concat_split():
+    x = np.random.rand(2, 6, 4).astype(np.float32)
+    a = nd.array(x)
+    parts = nd.SliceChannel(a, num_outputs=3, axis=1)
+    assert len(parts) == 3
+    np.testing.assert_array_equal(parts[0].asnumpy(), x[:, :2])
+    cat = nd.Concat(*parts, dim=1)
+    np.testing.assert_array_equal(cat.asnumpy(), x)
+
+
+def test_broadcast():
+    a = nd.array(np.ones((2, 1, 3), np.float32))
+    b = nd.broadcast_to(a, shape=(2, 4, 3))
+    assert b.shape == (2, 4, 3)
+    x = nd.array([[1.0], [2.0]])
+    y = nd.array([[10.0, 20.0]])
+    np.testing.assert_allclose(
+        nd.broadcast_add(x, y).asnumpy(), [[11, 21], [12, 22]]
+    )
+
+
+def test_take_onehot_pick():
+    w = nd.array(np.arange(12).reshape(4, 3).astype(np.float32))
+    idx = nd.array([0, 2])
+    np.testing.assert_array_equal(
+        nd.take(w, idx).asnumpy(), [[0, 1, 2], [6, 7, 8]]
+    )
+    np.testing.assert_array_equal(
+        nd.one_hot(idx, depth=4).asnumpy(),
+        [[1, 0, 0, 0], [0, 0, 1, 0]],
+    )
+    data = nd.array([[0.1, 0.9], [0.8, 0.2]])
+    pk = nd.pick(data, nd.array([1, 0]), axis=1)
+    np.testing.assert_allclose(pk.asnumpy(), [0.9, 0.8])
+
+
+def test_topk_sort():
+    x = np.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], np.float32)
+    a = nd.array(x)
+    np.testing.assert_array_equal(
+        nd.sort(a, axis=1).asnumpy(), np.sort(x, axis=1)
+    )
+    both = nd.topk(a, k=2, ret_typ="both", axis=1)
+    np.testing.assert_allclose(both[0].asnumpy(), [[3, 2], [5, 4]])
+    np.testing.assert_allclose(both[1].asnumpy(), [[0, 2], [1, 2]])
+
+
+def test_random_reproducible():
+    mx.random.seed(42)
+    a = nd.uniform(0, 1, shape=(3, 3))
+    mx.random.seed(42)
+    b = nd.uniform(0, 1, shape=(3, 3))
+    np.testing.assert_array_equal(a.asnumpy(), b.asnumpy())
+    assert a.shape == (3, 3)
+    n = nd.normal(0, 1, shape=(500,))
+    assert abs(float(n.asnumpy().mean())) < 0.2
+
+
+def test_save_load(tmp_path):
+    f = str(tmp_path / "test.params")
+    d = {
+        "arg:w": nd.array(np.random.rand(3, 4).astype(np.float32)),
+        "aux:m": nd.array(np.arange(5, dtype=np.int32)),
+    }
+    nd.save(f, d)
+    loaded = nd.load(f)
+    assert set(loaded) == {"arg:w", "aux:m"}
+    np.testing.assert_array_equal(loaded["arg:w"].asnumpy(), d["arg:w"].asnumpy())
+    np.testing.assert_array_equal(loaded["aux:m"].asnumpy(), d["aux:m"].asnumpy())
+    assert loaded["aux:m"].dtype == np.int32
+    # list form
+    nd.save(f, [d["arg:w"]])
+    (back,) = nd.load(f)
+    np.testing.assert_array_equal(back.asnumpy(), d["arg:w"].asnumpy())
+
+
+def test_copyto_astype_context():
+    a = nd.array([1.0, 2.0])
+    b = nd.zeros((2,))
+    a.copyto(b)
+    np.testing.assert_array_equal(b.asnumpy(), [1, 2])
+    c = a.astype(np.float16)
+    assert c.dtype == np.float16
+    d = a.as_in_context(mx.cpu(0))
+    assert d.context.device_type == "cpu"
+
+
+def test_out_kwarg():
+    a = nd.array([1.0, 4.0, 9.0])
+    out = nd.zeros((3,))
+    nd.sqrt(a, out=out)
+    np.testing.assert_allclose(out.asnumpy(), [1, 2, 3])

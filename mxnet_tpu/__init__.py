@@ -24,5 +24,10 @@ from . import ndarray as nd
 from .ndarray import NDArray
 from . import random
 from . import autograd
+from . import symbol
+from . import symbol as sym
+from .symbol import Variable, Group, AttrScope
+from . import executor
+from .executor import Executor
 
 __version__ = "0.1.0"

@@ -1,0 +1,284 @@
+"""Executor: binds a Symbol + NDArrays into a compiled computation.
+
+Analog of the reference GraphExecutor (src/executor/graph_executor.cc:333
+Init / :912 Bind) and python/mxnet/executor.py. The entire NNVM pass
+pipeline collapses into XLA:
+
+  Gradient pass            -> jax.vjp over the traced graph
+  PlaceDevice              -> sharding annotations (parallel/, later)
+  InferShape/InferType     -> done at bind via ops/shape_infer.py
+  PlanMemory / inplace     -> XLA buffer assignment + donation
+  AttachOpExecs, bulk-exec -> ONE jit computation for the whole graph
+                              (the logical endpoint of bulk-exec: the
+                              "segment" is the entire graph)
+
+Training uses a single fused forward+backward computation: `forward
+(is_train=True)` runs it with default head gradients (ones — loss ops'
+custom_vjp ignores/replaces them, matching reference semantics), caches
+gradients, and `backward()` just applies them to the grad arrays under
+grad_req write/add. An explicit `backward(out_grads)` re-runs the fused
+computation with the provided head gradients.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import random as _random
+from .base import MXNetError
+from .ndarray import NDArray
+from .symbol import _topo
+
+
+class Executor:
+    def __init__(self, symbol, ctx, args, args_grad, grad_req, aux_states,
+                 group2ctx=None, shared_exec=None):
+        self._symbol = symbol
+        self._ctx = ctx
+        self._group2ctx = group2ctx or {}
+        self.arg_dict = dict(args)
+        self.grad_dict = dict(args_grad or {})
+        self.aux_dict = dict(aux_states or {})
+        self._grad_req = dict(grad_req)
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        missing = [n for n in self._arg_names if n not in self.arg_dict]
+        if missing:
+            raise MXNetError(f"bind: missing arguments {missing}")
+        self.arg_arrays = [self.arg_dict[n] for n in self._arg_names]
+        self.grad_arrays = [
+            self.grad_dict.get(n) for n in self._arg_names
+        ]
+        self.aux_arrays = [self.aux_dict[n] for n in self._aux_names]
+        self._grad_names = [
+            n
+            for n in self._arg_names
+            if self._grad_req.get(n, "null") != "null" and n in self.grad_dict
+        ]
+        self.outputs = []
+        self._monitor_callback = None
+        self._cached_grads = None
+        self._last_inputs = None
+        # draw from the framework PRNG chain so mx.random.seed() controls
+        # symbolic Dropout/rrelu reproducibly
+        self._rng = _random.next_key()
+
+        self._build()
+
+    # ----------------------------------------------------------- build
+    def _build(self):
+        sym = self._symbol
+        nodes = _topo(sym._outputs)
+        node_ids = {id(n): i for i, n in enumerate(nodes)}
+        heads = [(id(n), i) for n, i in sym._outputs]
+        plan = []
+        for n in nodes:
+            if n.is_variable:
+                continue
+            params = n.op.normalize_params(n.attrs)
+            plan.append(
+                (
+                    n.op,
+                    params,
+                    n.op.resolved_num_outputs(params),
+                    [(id(src), i) for src, i in n.inputs],
+                    id(n),
+                    node_ids[id(n)],
+                    n.name,
+                )
+            )
+        var_names = {
+            id(n): n.name for n in nodes if n.is_variable
+        }
+        aux_set = set(self._aux_names)
+
+        def run_graph(arg_vals, aux_vals, rng, is_train):
+            env = {}
+            for nid, name in var_names.items():
+                env[(nid, 0)] = (
+                    aux_vals[name] if name in aux_set else arg_vals[name]
+                )
+            aux_updates = {}
+            for opdef, params, n_out, in_keys, nid, node_idx, nname in plan:
+                in_vals = [env[k] for k in in_keys]
+                kwargs = dict(params)
+                if opdef.needs_rng:
+                    kwargs["rng"] = jax.random.fold_in(rng, node_idx)
+                if opdef.needs_mode:
+                    kwargs["is_train"] = is_train
+                res = opdef.fn(*in_vals, **kwargs)
+                if not isinstance(res, tuple):
+                    res = (res,)
+                for i in range(n_out):
+                    env[(nid, i)] = res[i]
+                n_aux = len(opdef.aux_names)
+                if n_aux and is_train and len(res) > n_out:
+                    # trailing inputs are the aux vars; map updates back
+                    for (src, _), upd in zip(
+                        in_keys[-n_aux:], res[n_out:]
+                    ):
+                        aux_updates[var_names[src]] = upd
+            outs = [env[k] for k in heads]
+            return outs, aux_updates
+
+        self._run_graph = run_graph
+        self._jit_fwd = {
+            True: jax.jit(lambda a, x, r: run_graph(a, x, r, True)),
+            False: jax.jit(lambda a, x, r: run_graph(a, x, r, False)),
+        }
+
+        grad_names = list(self._grad_names)
+
+        def train_step(arg_vals, aux_vals, rng, head_grads):
+            grad_vals = {k: arg_vals[k] for k in grad_names}
+            others = {
+                k: v for k, v in arg_vals.items() if k not in grad_vals
+            }
+
+            def f(gv):
+                outs, aux_upd = run_graph(
+                    {**others, **gv}, aux_vals, rng, True
+                )
+                return outs, aux_upd
+
+            outs, vjp_fn, aux_upd = jax.vjp(f, grad_vals, has_aux=True)
+            (grads,) = vjp_fn(head_grads)
+            return outs, grads, aux_upd
+
+        self._jit_train_step = jax.jit(train_step)
+
+    # --------------------------------------------------------- running
+    def _gather_inputs(self):
+        arg_vals = {n: self.arg_dict[n]._data for n in self._arg_names}
+        aux_vals = {n: self.aux_dict[n]._data for n in self._aux_names}
+        return arg_vals, aux_vals
+
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError(f"unknown forward argument {k!r}")
+            self.arg_dict[k][:] = v
+        arg_vals, aux_vals = self._gather_inputs()
+        self._rng, rng = jax.random.split(self._rng)
+        self._cached_grads = None
+        if is_train and self._grad_names:
+            head_grads = self._default_head_grads(arg_vals, aux_vals, rng)
+            outs, grads, aux_upd = self._jit_train_step(
+                arg_vals, aux_vals, rng, head_grads
+            )
+            self._cached_grads = grads
+        else:
+            outs, aux_upd = self._jit_fwd[bool(is_train)](
+                arg_vals, aux_vals, rng
+            )
+        self._last_inputs = (arg_vals, aux_vals, rng)
+        if is_train:
+            for name, val in aux_upd.items():
+                self.aux_dict[name]._set_data(val)
+        self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
+        return self.outputs
+
+    def _default_head_grads(self, arg_vals, aux_vals, rng):
+        if not hasattr(self, "_head_shapes"):
+            shapes = jax.eval_shape(
+                lambda a, x, r: self._run_graph(a, x, r, True)[0],
+                arg_vals, aux_vals, rng,
+            )
+            self._head_shapes = [
+                (tuple(s.shape), s.dtype) for s in shapes
+            ]
+        return [jnp.ones(s, d) for s, d in self._head_shapes]
+
+    def backward(self, out_grads=None):
+        if not self._grad_names:
+            return
+        if out_grads is not None:
+            if self._last_inputs is None:
+                raise MXNetError("backward called before forward")
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            head_grads = [g._data for g in out_grads]
+            arg_vals, aux_vals, rng = self._last_inputs
+            _, grads, _ = self._jit_train_step(
+                arg_vals, aux_vals, rng, head_grads
+            )
+        else:
+            if self._cached_grads is None:
+                raise MXNetError(
+                    "backward called without forward(is_train=True)"
+                )
+            grads = self._cached_grads
+        for name, g in grads.items():
+            req = self._grad_req.get(name, "null")
+            tgt = self.grad_dict.get(name)
+            if tgt is None or req == "null":
+                continue
+            if req == "write":
+                tgt._set_data(g)
+            elif req == "add":
+                tgt._set_data(tgt._data + g)
+            else:
+                raise MXNetError(f"unknown grad_req {req!r}")
+
+    # --------------------------------------------------------- utilities
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, arr in (arg_params or {}).items():
+            if name in self.arg_dict:
+                self.arg_dict[name][:] = arr
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown argument {name!r}")
+        for name, arr in (aux_params or {}).items():
+            if name in self.aux_dict:
+                self.aux_dict[name][:] = arr
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown aux state {name!r}")
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False,
+                **new_shapes):
+        """Return a new executor bound with new input shapes, sharing
+        parameter NDArrays where shapes are unchanged
+        (reference MXExecutorReshape)."""
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**new_shapes)
+        from . import ndarray as nd
+
+        new_args = {}
+        for name, shape in zip(self._arg_names, arg_shapes):
+            cur = self.arg_dict[name]
+            if tuple(cur.shape) == tuple(shape):
+                new_args[name] = cur
+            else:
+                new_args[name] = nd.zeros(shape, ctx=self._ctx,
+                                          dtype=cur.dtype)
+        new_grads = {}
+        for name in self.grad_dict:
+            idx = self._arg_names.index(name)
+            shape = arg_shapes[idx]
+            cur = self.grad_dict[name]
+            if tuple(cur.shape) == tuple(shape):
+                new_grads[name] = cur
+            else:
+                new_grads[name] = nd.zeros(shape, ctx=self._ctx,
+                                           dtype=cur.dtype)
+        new_aux = {}
+        for name, shape in zip(self._aux_names, aux_shapes):
+            cur = self.aux_dict[name]
+            if tuple(cur.shape) == tuple(shape):
+                new_aux[name] = cur
+            else:
+                new_aux[name] = nd.zeros(shape, ctx=self._ctx,
+                                         dtype=cur.dtype)
+        return Executor(self._symbol, self._ctx, new_args, new_grads,
+                        self._grad_req, new_aux,
+                        group2ctx=self._group2ctx)
+
+    def set_monitor_callback(self, callback):
+        self._monitor_callback = callback
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    def debug_str(self):
+        return self._symbol.debug_str()

@@ -49,7 +49,7 @@ def activation(data, act_type="relu"):
 
 @register(
     "LeakyReLU",
-    arg_names=["data"],
+    arg_names=["data", "gamma"],
     defaults={"act_type": "leaky", "slope": 0.25,
               "lower_bound": 0.125, "upper_bound": 0.334},
     coerce={"slope": coerce_float, "lower_bound": coerce_float,

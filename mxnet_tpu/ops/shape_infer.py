@@ -1,0 +1,215 @@
+"""Forward shape/type inference for symbolic binding.
+
+Analog of the reference's InferShape pass (nnvm) + per-op InferShape
+attributes (src/operator/operator_common.h macros). TPU-native twist: only
+ops that *create* parameter shapes (FullyConnected infers its weight from
+the data shape, etc.) need hand-written rules; every other op's output
+shape falls out of `jax.eval_shape` abstract evaluation of its registered
+jax function — no per-op shape code.
+
+An infer rule has signature
+    fn(params, in_shapes) -> (in_shapes, out_shapes)
+where `in_shapes` is a list of tuples-or-None (None = unknown, to be
+inferred); the returned in_shapes must be fully known. Inputs include
+trailing aux states for ops that have them.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from . import registry as _registry
+
+_RULES: dict[str, callable] = {}
+
+
+def rule(name):
+    def deco(fn):
+        _RULES[name] = fn
+        return fn
+
+    return deco
+
+
+def _prod(t):
+    p = 1
+    for x in t:
+        p *= x
+    return p
+
+
+def infer_node(opdef, params, in_shapes, in_dtypes):
+    """Infer (in_shapes, out_shapes, out_dtypes) for one node.
+
+    Raises MXNetError if inference is impossible with the known inputs.
+    """
+    r = _RULES.get(opdef.name)
+    if r is not None:
+        in_shapes, _ = r(params, list(in_shapes))
+    if any(s is None for s in in_shapes):
+        missing = [i for i, s in enumerate(in_shapes) if s is None]
+        raise MXNetError(
+            f"op {opdef.name!r}: cannot infer shapes of inputs {missing}"
+        )
+    # abstract-eval the registered fn for output shapes/dtypes
+    kwargs = dict(params)
+    structs = [
+        jax.ShapeDtypeStruct(s, d or np.float32)
+        for s, d in zip(in_shapes, in_dtypes)
+    ]
+
+    def f(*xs):
+        extra = {}
+        if opdef.needs_rng:
+            extra["rng"] = jax.random.PRNGKey(0)
+        if opdef.needs_mode:
+            extra["is_train"] = False
+        res = opdef.fn(*xs, **kwargs, **extra)
+        return res
+
+    out = jax.eval_shape(f, *structs)
+    if not isinstance(out, (tuple, list)):
+        out = (out,)
+    n_out = opdef.resolved_num_outputs(params)
+    out = tuple(out)[:n_out]
+    return (
+        [tuple(s) for s in in_shapes],
+        [tuple(o.shape) for o in out],
+        [np.dtype(o.dtype) for o in out],
+    )
+
+
+# --------------------------------------------------- parameter-creating ops
+
+
+@rule("FullyConnected")
+def _fc(params, ins):
+    data, weight, bias = (ins + [None] * 3)[:3]
+    nh = int(params["num_hidden"])
+    no_bias = params.get("no_bias", False)
+    if data is not None:
+        d = (
+            _prod(data[1:])
+            if params.get("flatten", True)
+            else data[-1]
+        )
+        if weight is None:
+            weight = (nh, d)
+    if weight is None:
+        raise MXNetError("FullyConnected: cannot infer weight shape")
+    if no_bias:
+        return [data, weight], None
+    if bias is None:
+        bias = (nh,)
+    return [data, weight, bias], None
+
+
+@rule("Convolution")
+def _conv(params, ins):
+    data, weight, bias = (ins + [None] * 3)[:3]
+    nf = int(params["num_filter"])
+    ng = int(params.get("num_group", 1))
+    kernel = tuple(params["kernel"])
+    no_bias = params.get("no_bias", False)
+    if data is not None and weight is None:
+        weight = (nf, data[1] // ng) + kernel
+    if no_bias:
+        return [data, weight], None
+    if bias is None:
+        bias = (nf,)
+    return [data, weight, bias], None
+
+
+@rule("Deconvolution")
+def _deconv(params, ins):
+    data, weight, bias = (ins + [None] * 3)[:3]
+    nf = int(params["num_filter"])
+    ng = int(params.get("num_group", 1))
+    kernel = tuple(params["kernel"])
+    no_bias = params.get("no_bias", True)
+    if data is not None and weight is None:
+        weight = (data[1], nf // ng) + kernel
+    if no_bias:
+        return [data, weight], None
+    if bias is None:
+        bias = (nf,)
+    return [data, weight, bias], None
+
+
+@rule("BatchNorm")
+def _bn(params, ins):
+    data = ins[0]
+    if data is None:
+        raise MXNetError("BatchNorm: data shape required")
+    c = (data[int(params.get("axis", 1)) % len(data)],)
+    out = [data] + [s if s is not None else c for s in ins[1:]]
+    while len(out) < 5:
+        out.append(c)
+    return out, None
+
+
+@rule("InstanceNorm")
+def _in(params, ins):
+    data = ins[0]
+    c = (data[1],)
+    return [data, ins[1] or c, ins[2] if len(ins) > 2 and ins[2] else c], None
+
+
+@rule("Embedding")
+def _emb(params, ins):
+    data, weight = (ins + [None] * 2)[:2]
+    if weight is None:
+        weight = (int(params["input_dim"]), int(params["output_dim"]))
+    return [data, weight], None
+
+
+@rule("LeakyReLU")
+def _lrelu(params, ins):
+    if params.get("act_type") == "prelu":
+        data = ins[0]
+        gamma = ins[1] if len(ins) > 1 and ins[1] else (data[1],)
+        return [data, gamma], None
+    return ins, None
+
+
+def _label_rule(label_like_data=False):
+    def fn(params, ins):
+        data, label = (ins + [None] * 2)[:2]
+        if data is not None and label is None:
+            if label_like_data:
+                # regression: label shaped like data, except (N,1)->(N,)
+                label = (
+                    (data[0],)
+                    if len(data) == 2 and data[1] == 1
+                    else data
+                )
+            else:
+                if params.get("multi_output"):
+                    label = (data[0],) + tuple(data[2:])
+                elif params.get("preserve_shape"):
+                    label = tuple(data[:-1])
+                else:
+                    label = (data[0],)
+        return [data, label], None
+
+    return fn
+
+
+for _n in ("SoftmaxOutput", "SVMOutput"):
+    _RULES[_n] = _label_rule(False)
+for _n in (
+    "LinearRegressionOutput",
+    "MAERegressionOutput",
+    "LogisticRegressionOutput",
+):
+    _RULES[_n] = _label_rule(True)
+
+
+@rule("softmax_cross_entropy")
+def _sce(params, ins):
+    data, label = (ins + [None] * 2)[:2]
+    if data is not None and label is None:
+        label = (data[0],)
+    return [data, label], None

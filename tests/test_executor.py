@@ -1,0 +1,135 @@
+"""Executor tests (model: reference tests/python/unittest/test_executor.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def test_forward_simple():
+    x = sym.Variable("x")
+    y = sym.Variable("y")
+    z = x * y + x
+    ex = z.bind(
+        mx.cpu(),
+        args={"x": nd.array([1.0, 2.0]), "y": nd.array([3.0, 4.0])},
+        grad_req="null",
+    )
+    out = ex.forward()
+    np.testing.assert_allclose(out[0].asnumpy(), [4.0, 10.0])
+
+
+def test_backward_simple():
+    x = sym.Variable("x")
+    z = x * x
+    gx = nd.zeros((3,))
+    ex = z.bind(
+        mx.cpu(),
+        args={"x": nd.array([1.0, 2.0, 3.0])},
+        args_grad={"x": gx},
+    )
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(gx.asnumpy(), [2.0, 4.0, 6.0])
+
+
+def test_backward_out_grads():
+    x = sym.Variable("x")
+    z = x * 2.0
+    gx = nd.zeros((2,))
+    ex = z.bind(
+        mx.cpu(), args={"x": nd.array([1.0, 1.0])}, args_grad={"x": gx}
+    )
+    ex.forward(is_train=True)
+    ex.backward(nd.array([10.0, 20.0]))
+    np.testing.assert_allclose(gx.asnumpy(), [20.0, 40.0])
+
+
+def test_grad_req_add():
+    x = sym.Variable("x")
+    z = x * 3.0
+    gx = nd.ones((2,))
+    ex = z.bind(
+        mx.cpu(), args={"x": nd.array([1.0, 1.0])}, args_grad={"x": gx},
+        grad_req="add",
+    )
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(gx.asnumpy(), [4.0, 4.0])
+
+
+def test_simple_bind_mlp_train():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, name="fc", num_hidden=3)
+    out = sym.SoftmaxOutput(fc, name="softmax")
+    ex = out.simple_bind(mx.cpu(), data=(4, 5))
+    # init params
+    rs = np.random.RandomState(0)
+    ex.arg_dict["fc_weight"][:] = rs.rand(3, 5).astype(np.float32)
+    ex.arg_dict["fc_bias"][:] = 0.0
+    ex.arg_dict["data"][:] = rs.rand(4, 5).astype(np.float32)
+    ex.arg_dict["softmax_label"][:] = np.array([0, 1, 2, 0], np.float32)
+    out_nd = ex.forward(is_train=True)[0]
+    np.testing.assert_allclose(
+        out_nd.asnumpy().sum(axis=1), np.ones(4), rtol=1e-5
+    )
+    ex.backward()
+    g = ex.grad_dict["fc_weight"].asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_batchnorm_aux_update():
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data, name="bn", momentum=0.5, fix_gamma=False)
+    ex = bn.simple_bind(mx.cpu(), data=(8, 4))
+    ex.arg_dict["bn_gamma"][:] = 1.0
+    x = np.random.RandomState(3).rand(8, 4).astype(np.float32) * 4 + 2
+    ex.arg_dict["data"][:] = x
+    mean0 = ex.aux_dict["bn_moving_mean"].asnumpy().copy()
+    ex.forward(is_train=True)
+    mean1 = ex.aux_dict["bn_moving_mean"].asnumpy()
+    expect = mean0 * 0.5 + x.mean(axis=0) * 0.5
+    np.testing.assert_allclose(mean1, expect, rtol=1e-4)
+    # eval mode uses (and does not update) moving stats
+    out_eval = ex.forward(is_train=False)[0].asnumpy()
+    mean2 = ex.aux_dict["bn_moving_mean"].asnumpy()
+    np.testing.assert_allclose(mean1, mean2)
+    expect_eval = (x - mean1) / np.sqrt(
+        ex.aux_dict["bn_moving_var"].asnumpy() + 1e-3
+    )
+    np.testing.assert_allclose(out_eval, expect_eval, rtol=1e-3, atol=1e-4)
+
+
+def test_dropout_train_vs_eval():
+    data = sym.Variable("data")
+    d = sym.Dropout(data, p=0.5, name="do")
+    ex = d.simple_bind(mx.cpu(), grad_req="null", data=(50, 50))
+    ex.arg_dict["data"][:] = 1.0
+    out_train = ex.forward(is_train=True)[0].asnumpy()
+    frac = (out_train == 0).mean()
+    assert 0.3 < frac < 0.7
+    out_eval = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_array_equal(out_eval, np.ones((50, 50), np.float32))
+
+
+def test_executor_reshape():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, name="fc", num_hidden=4)
+    ex = fc.simple_bind(mx.cpu(), data=(8, 6))
+    ex.arg_dict["fc_weight"][:] = 1.0
+    ex2 = ex.reshape(data=(2, 6))
+    assert ex2.arg_dict["data"].shape == (2, 6)
+    # weight shared with original executor
+    assert ex2.arg_dict["fc_weight"] is ex.arg_dict["fc_weight"]
+    ex2.arg_dict["data"][:] = 1.0
+    out = ex2.forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), np.full((2, 4), 6.0))
+
+
+def test_copy_params_from():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, name="fc", num_hidden=2, no_bias=True)
+    ex = fc.simple_bind(mx.cpu(), data=(1, 2))
+    ex.copy_params_from({"fc_weight": nd.array([[1.0, 2.0], [3.0, 4.0]])})
+    ex.arg_dict["data"][:] = np.array([[1.0, 1.0]], np.float32)
+    out = ex.forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), [[3.0, 7.0]])

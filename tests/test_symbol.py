@@ -1,0 +1,109 @@
+"""Symbol tests (model: reference tests/python/unittest/test_symbol.py,
+test_infer_shape.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def _mlp():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=64)
+    act1 = sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = sym.FullyConnected(act1, name="fc2", num_hidden=10)
+    out = sym.SoftmaxOutput(fc2, name="softmax")
+    return out
+
+
+def test_compose_and_list():
+    net = _mlp()
+    assert net.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label",
+    ]
+    assert net.list_outputs() == ["softmax_output"]
+    assert net.list_auxiliary_states() == []
+
+
+def test_infer_shape_mlp():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(32, 100))
+    assert arg_shapes == [
+        (32, 100), (64, 100), (64,), (10, 64), (10,), (32,),
+    ]
+    assert out_shapes == [(32, 10)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_conv():
+    data = sym.Variable("data")
+    conv = sym.Convolution(data, name="conv", kernel=(3, 3), num_filter=8,
+                           pad=(1, 1))
+    bn = sym.BatchNorm(conv, name="bn")
+    pool = sym.Pooling(bn, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    arg_shapes, out_shapes, aux_shapes = pool.infer_shape(
+        data=(4, 3, 28, 28)
+    )
+    names = pool.list_arguments()
+    d = dict(zip(names, arg_shapes))
+    assert d["conv_weight"] == (8, 3, 3, 3)
+    assert d["conv_bias"] == (8,)
+    assert d["bn_gamma"] == (8,)
+    assert out_shapes == [(4, 8, 14, 14)]
+    assert aux_shapes == [(8,), (8,)]
+    assert pool.list_auxiliary_states() == [
+        "bn_moving_mean", "bn_moving_var"
+    ]
+
+
+def test_group_and_internals():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=4)
+    fc2 = sym.FullyConnected(fc1, name="fc2", num_hidden=2)
+    grp = mx.Group([fc1, fc2])
+    assert grp.list_outputs() == ["fc1_output", "fc2_output"]
+    internals = fc2.get_internals()
+    assert "fc1_output" in internals.list_outputs()
+    sliced = internals["fc1_output"]
+    assert sliced.list_outputs() == ["fc1_output"]
+
+
+def test_json_roundtrip():
+    net = _mlp()
+    js = net.tojson()
+    net2 = sym.loads(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    arg_shapes, out_shapes, _ = net2.infer_shape(data=(8, 20))
+    assert out_shapes == [(8, 10)]
+    # params survive the string round trip
+    ex = net2.simple_bind(mx.cpu(), data=(8, 20))
+    out = ex.forward()
+    assert out[0].shape == (8, 10)
+
+
+def test_attr_scope_and_variable_attrs():
+    with mx.AttrScope(ctx_group="dev1"):
+        v = sym.Variable("w", lr_mult=2.0)
+    assert v.attr("__ctx_group__") == "dev1"
+    assert v.attr("__lr_mult__") == "2.0"
+
+
+def test_arith_sugar():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = (a + b) * 2.0 - a / b
+    ex = c.bind(
+        mx.cpu(),
+        args={"a": mx.nd.array([4.0]), "b": mx.nd.array([2.0])},
+        grad_req="null",
+    )
+    out = ex.forward()
+    np.testing.assert_allclose(out[0].asnumpy(), [10.0])
+
+
+def test_infer_type():
+    net = _mlp()
+    arg_types, out_types, _ = net.infer_type()
+    assert all(t == np.float32 for t in arg_types)
+    assert out_types == [np.float32]

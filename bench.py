@@ -1,0 +1,106 @@
+"""Benchmark: ResNet-50 training throughput (img/s) on one chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+
+Baseline anchor (BASELINE.md): reference MXNet ResNet-50 training on
+K80 = 45.52 img/s (batch 32, docs/how_to/perf.md:151-185). vs_baseline
+is the ratio of our throughput to that number.
+"""
+import json
+import os
+import sys
+import time
+
+BASELINE_IMG_S = 45.52  # reference ResNet-50 K80 training throughput
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import get_resnet
+
+    platform = jax.devices()[0].platform
+    batch = int(os.environ.get("BENCH_BATCH", "32"))
+    if platform == "cpu":
+        # keep the CPU-mesh dry-run cheap; real numbers come from tpu
+        batch = int(os.environ.get("BENCH_BATCH", "4"))
+        num_layers = 18
+        image = (3, 32, 32)
+        classes = 16
+        iters = 3
+    else:
+        num_layers = 50
+        image = (3, 224, 224)
+        classes = 1000
+        iters = 20
+
+    net = get_resnet(num_classes=classes, num_layers=num_layers,
+                     image_shape=image)
+    ex = net.simple_bind(
+        ctx=mx.tpu() if platform == "tpu" else mx.cpu(),
+        grad_req="write",
+        data=(batch,) + image, softmax_label=(batch,))
+
+    arg_names = net.list_arguments()
+    aux_names = net.list_auxiliary_states()
+    data_names = {"data", "softmax_label"}
+    param_names = [n for n in arg_names if n not in data_names]
+    run = ex._run_graph
+
+    def train_step(params, auxs, data, label, rng):
+        def loss_fn(ps):
+            outs, aux_upd = run(
+                {**ps, "data": data, "softmax_label": label}, auxs, rng,
+                True)
+            probs = outs[0]
+            ll = jnp.take_along_axis(
+                probs, label.astype(jnp.int32)[:, None], axis=1)[:, 0]
+            return -jnp.mean(jnp.log(ll + 1e-8)), aux_upd
+
+        (loss, aux_upd), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        new_params = {k: v - 0.05 * grads[k] for k, v in params.items()}
+        return loss, new_params, {**auxs, **aux_upd}
+
+    # init
+    rng = jax.random.PRNGKey(0)
+    params = {}
+    for n in param_names:
+        shp = ex.arg_dict[n].shape
+        rng, k = jax.random.split(rng)
+        params[n] = 0.05 * jax.random.normal(k, shp, jnp.float32)
+    auxs = {n: ex.aux_dict[n]._data for n in aux_names}
+    data = jnp.ones((batch,) + image, jnp.float32)
+    label = jnp.zeros((batch,), jnp.float32)
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+
+    # warmup / compile
+    loss, params, auxs = step(params, auxs, data, label, rng)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss, params, auxs = step(params, auxs, data, label, rng)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_s = batch * iters / dt
+    metric = (
+        f"resnet{num_layers}_train_throughput_{platform}_b{batch}"
+    )
+    vs = img_s / BASELINE_IMG_S if num_layers == 50 else 0.0
+    print(json.dumps({
+        "metric": metric,
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

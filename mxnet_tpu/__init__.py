@@ -29,5 +29,19 @@ from . import symbol as sym
 from .symbol import Variable, Group, AttrScope
 from . import executor
 from .executor import Executor
+from . import initializer
+from . import initializer as init
+from . import optimizer
+from . import lr_scheduler
+from . import metric
+from . import callback
+from . import io
+from . import kvstore as kv
+from . import kvstore
+from . import model
+from . import module
+from . import module as mod
+from . import parallel
+from .callback import Speedometer
 
 __version__ = "0.1.0"

@@ -1,0 +1,483 @@
+"""Image pipeline: decode + augment + iterate.
+
+Analog of python/mxnet/image.py (559 lines — ImageIter over
+imdecode/resize_short/random_crop/color_normalize augmenters) and the
+C++ ImageRecordIter (src/io/iter_image_recordio_2.cc). Host-side decode
+(PIL/cv2) feeds NCHW float batches; on TPU the augmented batch is a
+single host->HBM transfer per step, with the PrefetchingIter overlapping
+decode and compute like the reference's parser threads.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import random
+
+import numpy as np
+
+from . import io as _io
+from . import ndarray as nd
+from . import recordio
+from .base import MXNetError
+
+
+def imdecode(buf, to_rgb=True, flag=1):
+    """Decode an image bytestring to an HWC uint8 NDArray (reference
+    image.py imdecode over the mx.nd.imdecode op, src/io/image_io.cc)."""
+    arr = recordio._imdecode_np(
+        buf if isinstance(buf, (bytes, bytearray)) else bytes(buf), flag)
+    if to_rgb and arr.ndim == 3:
+        arr = arr[:, :, ::-1]
+    return nd.array(np.ascontiguousarray(arr), dtype=np.uint8)
+
+
+def scale_down(src_size, size):
+    """Scale target size down to fit in src (reference image.py:33)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def _resize_np(img, w, h, interp=2):
+    """Resize HWC numpy image via PIL/cv2."""
+    try:
+        import cv2
+
+        return cv2.resize(img, (w, h), interpolation=interp)
+    except ImportError:
+        from PIL import Image
+
+        pil = Image.fromarray(img.astype(np.uint8))
+        return np.asarray(pil.resize((w, h), Image.BILINEAR))
+
+
+def imresize(src, w, h, interp=2):
+    img = src.asnumpy() if isinstance(src, nd.NDArray) else np.asarray(src)
+    return nd.array(_resize_np(img, w, h, interp), dtype=np.uint8)
+
+
+def resize_short(src, size, interp=2):
+    """Resize so the shorter edge is `size` (reference image.py:44)."""
+    img = src.asnumpy() if isinstance(src, nd.NDArray) else np.asarray(src)
+    h, w = img.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return nd.array(_resize_np(img, new_w, new_h, interp), dtype=np.uint8)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    """(reference image.py:57)"""
+    img = src.asnumpy() if isinstance(src, nd.NDArray) else np.asarray(src)
+    out = img[y0: y0 + h, x0: x0 + w]
+    if size is not None and (w, h) != size:
+        out = _resize_np(out, size[0], size[1], interp)
+    return nd.array(out, dtype=np.uint8)
+
+
+def random_crop(src, size, interp=2):
+    """(reference image.py:65)"""
+    img = src.asnumpy() if isinstance(src, nd.NDArray) else np.asarray(src)
+    h, w = img.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = random.randint(0, w - new_w)
+    y0 = random.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    """(reference image.py:77)"""
+    img = src.asnumpy() if isinstance(src, nd.NDArray) else np.asarray(src)
+    h, w = img.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    """(reference image.py:89)"""
+    arr = src.asnumpy().astype(np.float32)
+    arr -= np.asarray(mean, dtype=np.float32)
+    if std is not None:
+        arr /= np.asarray(std, dtype=np.float32)
+    return nd.array(arr)
+
+
+def random_size_crop(src, size, min_area, ratio, interp=2):
+    """Random area+aspect crop (reference image.py:96)."""
+    img = src.asnumpy() if isinstance(src, nd.NDArray) else np.asarray(src)
+    h, w = img.shape[:2]
+    area = w * h
+    for _ in range(10):
+        new_area = random.uniform(min_area, 1.0) * area
+        new_ratio = random.uniform(*ratio)
+        new_w = int(np.sqrt(new_area * new_ratio))
+        new_h = int(np.sqrt(new_area / new_ratio))
+        if random.random() < 0.5:
+            new_w, new_h = new_h, new_w
+        if new_w <= w and new_h <= h:
+            x0 = random.randint(0, w - new_w)
+            y0 = random.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return random_crop(src, size, interp)
+
+
+# ------------------------------------------------------------ augmenters
+
+
+def ResizeAug(size, interp=2):
+    """(reference image.py:126)"""
+
+    def aug(src):
+        return [resize_short(src, size, interp)]
+
+    return aug
+
+
+def RandomCropAug(size, interp=2):
+    def aug(src):
+        return [random_crop(src, size, interp)[0]]
+
+    return aug
+
+
+def RandomSizedCropAug(size, min_area, ratio, interp=2):
+    def aug(src):
+        return [random_size_crop(src, size, min_area, ratio, interp)[0]]
+
+    return aug
+
+
+def CenterCropAug(size, interp=2):
+    def aug(src):
+        return [center_crop(src, size, interp)[0]]
+
+    return aug
+
+
+def RandomOrderAug(ts):
+    """(reference image.py:158)"""
+
+    def aug(src):
+        srcs = [src]
+        random.shuffle(ts)
+        for t in ts:
+            srcs = [j for i in srcs for j in t(i)]
+        return srcs
+
+    return aug
+
+
+def ColorJitterAug(brightness, contrast, saturation):
+    """(reference image.py:170)"""
+    ts = []
+    coef = np.array([[[0.299, 0.587, 0.114]]], dtype=np.float32)
+    if brightness > 0:
+        def baug(src):
+            alpha = 1.0 + random.uniform(-brightness, brightness)
+            arr = src.asnumpy().astype(np.float32) * alpha
+            return [nd.array(np.clip(arr, 0, 255))]
+
+        ts.append(baug)
+    if contrast > 0:
+        def caug(src):
+            alpha = 1.0 + random.uniform(-contrast, contrast)
+            arr = src.asnumpy().astype(np.float32)
+            gray = (arr * coef).sum(axis=2, keepdims=True)
+            arr = arr * alpha + gray.mean() * (1.0 - alpha)
+            return [nd.array(np.clip(arr, 0, 255))]
+
+        ts.append(caug)
+    if saturation > 0:
+        def saug(src):
+            alpha = 1.0 + random.uniform(-saturation, saturation)
+            arr = src.asnumpy().astype(np.float32)
+            gray = (arr * coef).sum(axis=2, keepdims=True)
+            arr = arr * alpha + gray * (1.0 - alpha)
+            return [nd.array(np.clip(arr, 0, 255))]
+
+        ts.append(saug)
+    return RandomOrderAug(ts)
+
+
+def LightingAug(alphastd, eigval, eigvec):
+    """PCA lighting noise (reference image.py:204)."""
+
+    def aug(src):
+        alpha = np.random.normal(0, alphastd, size=(3,))
+        rgb = np.dot(eigvec * alpha, eigval)
+        arr = src.asnumpy().astype(np.float32) + rgb
+        return [nd.array(arr)]
+
+    return aug
+
+
+def ColorNormalizeAug(mean, std):
+    def aug(src):
+        return [color_normalize(src, mean, std)]
+
+    return aug
+
+
+def HorizontalFlipAug(p):
+    def aug(src):
+        if random.random() < p:
+            return [nd.array(src.asnumpy()[:, ::-1])]
+        return [src]
+
+    return aug
+
+
+def CastAug():
+    def aug(src):
+        return [nd.array(src.asnumpy().astype(np.float32))]
+
+    return aug
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, inter_method=2):
+    """Standard augmenter list (reference image.py:246-290)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(
+            RandomSizedCropAug(crop_size, 0.3, (3.0 / 4.0, 4.0 / 3.0),
+                               inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([
+            [-0.5675, 0.7192, 0.4009],
+            [-0.5808, -0.0045, -0.8140],
+            [-0.5836, -0.6948, 0.4203],
+        ])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None:
+        assert std is not None
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(_io.DataIter):
+    """Image iterator over .rec files and/or raw image lists with
+    augmenters (reference image.py:293-460 + C++ ImageRecordIter)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        assert path_imgrec or path_imglist or (isinstance(imglist, list))
+        if path_imgrec:
+            logging.info("loading recordio %s...", path_imgrec)
+            if path_imgidx:
+                self.imgrec = recordio.MXIndexedRecordIO(
+                    path_imgidx, path_imgrec, "r")
+                self.imgidx = list(self.imgrec.keys)
+            else:
+                self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
+                self.imgidx = None
+        else:
+            self.imgrec = None
+
+        if path_imglist:
+            logging.info("loading image list %s...", path_imglist)
+            with open(path_imglist) as fin:
+                imglist = {}
+                imgkeys = []
+                for line in iter(fin.readline, ""):
+                    line = line.strip().split("\t")
+                    label = nd.array([float(i) for i in line[1:-1]])
+                    key = int(line[0])
+                    imglist[key] = (label, line[-1])
+                    imgkeys.append(key)
+                self.imglist = imglist
+        elif isinstance(imglist, list):
+            logging.info("loading image list...")
+            result = {}
+            imgkeys = []
+            index = 1
+            for img in imglist:
+                key = str(index)
+                index += 1
+                if isinstance(img[0], nd.NDArray):
+                    label = img[0]
+                else:
+                    label = nd.array(img[0] if isinstance(img[0], list)
+                                     else [img[0]])
+                result[key] = (label, img[1])
+                imgkeys.append(str(key))
+            self.imglist = result
+        else:
+            self.imglist = None
+            imgkeys = None
+        self.path_root = path_root
+
+        self.check_data_shape(data_shape)
+        self.provide_data = [_io.DataDesc(data_name,
+                                          (batch_size,) + data_shape)]
+        if label_width > 1:
+            self.provide_label = [
+                _io.DataDesc(label_name, (batch_size, label_width))]
+        else:
+            self.provide_label = [_io.DataDesc(label_name, (batch_size,))]
+        self.batch_size = batch_size
+        self.data_shape = data_shape
+        self.label_width = label_width
+
+        self.shuffle = shuffle
+        if self.imgrec is None:
+            self.seq = imgkeys
+        elif shuffle or num_parts > 1:
+            assert self.imgidx is not None, \
+                "shuffling or sharding a .rec needs the .idx file"
+            self.seq = self.imgidx
+        else:
+            self.seq = None
+
+        if num_parts > 1 and self.seq is not None:
+            assert part_index < num_parts
+            N = len(self.seq)
+            C = N // num_parts
+            self.seq = self.seq[part_index * C: (part_index + 1) * C]
+        if aug_list is None:
+            self.auglist = CreateAugmenter(data_shape, **kwargs)
+        else:
+            self.auglist = aug_list
+        self.cur = 0
+        self.reset()
+
+    def reset(self):
+        if self.shuffle and self.seq is not None:
+            random.shuffle(self.seq)
+        if self.imgrec is not None:
+            self.imgrec.reset()
+        self.cur = 0
+
+    def next_sample(self):
+        """(reference image.py:398)"""
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.imgrec is not None:
+                s = self.imgrec.read_idx(idx)
+                header, img = recordio.unpack(s)
+                if self.imglist is None:
+                    return header.label, img
+                return self.imglist[idx][0], img
+            label, fname = self.imglist[idx]
+            return label, self.read_image(fname)
+        s = self.imgrec.read()
+        if s is None:
+            raise StopIteration
+        header, img = recordio.unpack(s)
+        return header.label, img
+
+    def next(self):
+        """(reference image.py:420)"""
+        batch_size = self.batch_size
+        c, h, w = self.data_shape
+        batch_data = np.zeros((batch_size, c, h, w), dtype=np.float32)
+        batch_label = np.zeros(
+            (batch_size,) if self.label_width == 1
+            else (batch_size, self.label_width), dtype=np.float32)
+        i = 0
+        try:
+            while i < batch_size:
+                label, s = self.next_sample()
+                data = [imdecode(s)]
+                if len(data[0].shape) == 0:
+                    logging.debug("Invalid image, skipping.")
+                    continue
+                for aug in self.auglist:
+                    data = [ret for src in data for ret in aug(src)]
+                for d in data:
+                    assert i < batch_size, \
+                        "Batch size must be multiple of augmenter output"
+                    arr = d.asnumpy()
+                    batch_data[i] = arr.transpose(2, 0, 1)
+                    if isinstance(label, nd.NDArray):
+                        lab = label.asnumpy()
+                    else:
+                        lab = np.asarray(label)
+                    if self.label_width == 1:
+                        batch_label[i] = lab.reshape(-1)[0]
+                    else:
+                        batch_label[i] = lab.reshape(-1)[: self.label_width]
+                    i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+        return _io.DataBatch(
+            data=[nd.array(batch_data)], label=[nd.array(batch_label)],
+            pad=batch_size - i, index=None,
+            provide_data=self.provide_data,
+            provide_label=self.provide_label)
+
+    def check_data_shape(self, data_shape):
+        if not len(data_shape) == 3:
+            raise ValueError(
+                "data_shape should have length 3, with dimensions CxHxW")
+        if not data_shape[0] == 3 and not data_shape[0] == 1:
+            raise ValueError(
+                "This iterator expects the input image to have 1 or 3 "
+                "channels.")
+
+    def read_image(self, fname):
+        with open(os.path.join(self.path_root or "", fname), "rb") as fin:
+            return fin.read()
+
+
+def ImageRecordIter(path_imgrec=None, data_shape=(3, 224, 224),
+                    batch_size=128, shuffle=False, mean_r=0.0, mean_g=0.0,
+                    mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0,
+                    rand_crop=False, rand_mirror=False, path_imgidx=None,
+                    preprocess_threads=4, prefetch_buffer=4,
+                    part_index=0, num_parts=1, label_width=1, **kwargs):
+    """Compatibility constructor matching the C++ ImageRecordIter params
+    (src/io/iter_image_recordio_2.cc:559 registration), returning an
+    ImageIter wrapped in a PrefetchingIter (the analog of the fused
+    parser + prefetcher pipeline)."""
+    mean = None
+    std = None
+    if mean_r or mean_g or mean_b:
+        mean = np.array([mean_r, mean_g, mean_b])
+    if std_r != 1.0 or std_g != 1.0 or std_b != 1.0:
+        std = np.array([std_r, std_g, std_b])
+    it = ImageIter(
+        batch_size=batch_size, data_shape=data_shape,
+        path_imgrec=path_imgrec, path_imgidx=path_imgidx, shuffle=shuffle,
+        rand_crop=rand_crop, rand_mirror=rand_mirror, mean=mean, std=std,
+        part_index=part_index, num_parts=num_parts,
+        label_width=label_width,
+    )
+    return _io.PrefetchingIter(it)

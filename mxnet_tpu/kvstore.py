@@ -1,0 +1,162 @@
+"""KVStore: key-value parameter synchronization.
+
+Analog of the reference KVStore (include/mxnet/kvstore.h:26-286,
+src/kvstore/kvstore_local.h, python/mxnet/kvstore.py). The reference's
+transports map onto TPU machinery:
+
+  'local'/'device'  -> in-process reduce over device copies (reference
+                       CommCPU/CommDevice, src/kvstore/comm.h:74,211).
+                       Here: jnp adds — XLA fuses the reduction; on a
+                       real multi-chip mesh the reduce is a psum that
+                       rides ICI (see parallel/).
+  'dist_*' / 'tpu'  -> NO parameter server. push+pull lower to
+                       jax collectives over the mesh inside the jit'd
+                       step (parallel/kvstore_tpu.py); rank/num_workers
+                       come from jax.process_index/process_count. The
+                       ps-lite server processes (kvstore_dist_server.h)
+                       have no TPU analog — the optimizer state is
+                       sharded across chips instead (ZeRO-style).
+
+API kept verbatim: init/push/pull/set_optimizer/rank/num_workers/
+save_optimizer_states/load_optimizer_states/type.
+"""
+from __future__ import annotations
+
+import pickle
+
+from . import optimizer as opt
+from .base import MXNetError
+from .ndarray import NDArray
+
+
+def _ctype_key_value(keys, vals):
+    """Normalize (key, value) to parallel lists (reference
+    kvstore.py:21-48)."""
+    if isinstance(keys, (int, str)):
+        if isinstance(vals, NDArray):
+            return [keys], [[vals]]
+        for v in vals:
+            assert isinstance(v, NDArray)
+        return [keys], [list(vals)]
+    assert len(keys) == len(vals)
+    out_keys, out_vals = [], []
+    for k, v in zip(keys, vals):
+        ks, vs = _ctype_key_value(k, v)
+        out_keys += ks
+        out_vals += vs
+    return out_keys, out_vals
+
+
+class KVStore(object):
+    """Single-process store with device-side reduce (reference
+    KVStoreLocal, src/kvstore/kvstore_local.h:50-90)."""
+
+    def __init__(self, kv_type="local"):
+        self._type = kv_type
+        self._store: dict = {}
+        self._updater = None
+        self._updater_func = None
+
+    # ------------------------------------------------------------ basic
+    def init(self, key, value):
+        keys, vals = _ctype_key_value(key, value)
+        for k, v in zip(keys, vals):
+            if k in self._store:
+                raise MXNetError(f"key {k!r} already initialized")
+            self._store[k] = v[0].copy()
+
+    def push(self, key, value, priority=0):
+        """Aggregate values (sum across device copies — reference
+        comm.h Reduce) and apply the updater if set, else accumulate into
+        the stored value for a later pull (reference
+        kvstore_local.h:50-73)."""
+        keys, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            if k not in self._store:
+                raise MXNetError(f"key {k!r} not initialized")
+            merged = vlist[0]
+            if len(vlist) > 1:
+                # gather device copies onto the first value's device then
+                # sum (reference CommCPU::Reduce copies to a shared
+                # context before the tree-reduce, src/kvstore/comm.h:74)
+                import jax
+
+                dev = vlist[0].context.jax_device()
+                acc = vlist[0]._data
+                for v in vlist[1:]:
+                    acc = acc + jax.device_put(v._data, dev)
+                merged = NDArray(acc, ctx=vlist[0].context)
+            if self._updater is not None:
+                self._updater(_str_key(k), merged, self._store[k])
+            else:
+                # no updater: store the merged value for pull (reference
+                # kvstore_local.h:70 CopyFromTo(merged, &local))
+                merged.copyto(self._store[k])
+
+    def pull(self, key, out=None, priority=0):
+        """Broadcast stored value into each out array (reference
+        kvstore_local.h:75-90)."""
+        assert out is not None
+        keys, outs = _ctype_key_value(key, out)
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError(f"key {k!r} not initialized")
+            src = self._store[k]
+            for o in olist:
+                src.copyto(o)
+
+    # -------------------------------------------------------- optimizer
+    def set_optimizer(self, optimizer):
+        """Register the optimizer; in dist mode the reference serializes
+        it to the servers (kvstore.py:208-230) — here there are no
+        servers, so it always becomes the local updater."""
+        self._set_updater(opt.get_updater(optimizer))
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def _barrier(self):
+        pass
+
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    # ------------------------------------------------- optimizer states
+    def save_optimizer_states(self, fname):
+        assert self._updater is not None, "Cannot save states for distributed training"
+        with open(fname, "wb") as fout:
+            fout.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "Cannot load states for distributed training"
+        with open(fname, "rb") as fin:
+            self._updater.set_states(fin.read())
+
+
+def _str_key(k):
+    return k
+
+
+def create(name="local"):
+    """Factory (reference src/kvstore/kvstore.cc:17-45 string dispatch +
+    python/mxnet/kvstore.py:396 create)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    lname = name.lower()
+    if "tpu" in lname or "dist" in lname:
+        from .parallel.kvstore_tpu import KVStoreTPU
+
+        return KVStoreTPU(lname)
+    if lname in ("local", "local_update_cpu", "local_allreduce_cpu",
+                 "local_allreduce_device", "device"):
+        return KVStore(lname)
+    raise MXNetError(f"unknown KVStore type {name!r}")

@@ -1,0 +1,120 @@
+"""Model-level helpers: kvstore creation/update routing and
+checkpoint save/load.
+
+Analog of python/mxnet/model.py — `_create_kvstore` (model.py:40),
+`_update_params_on_kvstore` (model.py:88-97), `save_checkpoint` /
+`load_checkpoint` (model.py:319-385). The legacy FeedForward estimator
+lives in feed_forward.py; Module (module/) is the primary training API,
+as in the reference.
+
+Checkpoint format kept bit-compatible in spirit: `prefix-symbol.json`
+(graph JSON) + `prefix-%04d.params` (NDArray dict with `arg:`/`aux:`
+name tags) so reference-style tooling round-trips.
+"""
+from __future__ import annotations
+
+import logging
+
+from . import ndarray as nd
+from . import symbol as sym
+from .base import MXNetError
+
+
+def _create_kvstore(kvstore, num_device, arg_params):
+    """Create kvstore + decide whether to update on it (reference
+    model.py:40-66)."""
+    from . import kvstore as kvs
+
+    update_on_kvstore = True
+    if kvstore is None:
+        kv = None
+    elif isinstance(kvstore, kvs.KVStore):
+        kv = kvstore
+    elif isinstance(kvstore, str):
+        if num_device == 1 and "dist" not in kvstore and "tpu" not in kvstore:
+            # a single device doesn't need a kvstore at all
+            kv = None
+        else:
+            kv = kvs.create(kvstore)
+            if kvstore == "local":
+                # reference heuristic: big arrays -> update on kvstore
+                max_size = max(
+                    int(nd_arr.size) for nd_arr in arg_params.values()
+                ) if arg_params else 0
+                if max_size < 1024 * 1024 * 16:
+                    update_on_kvstore = False
+    else:
+        raise TypeError("kvstore must be KVStore, str or None")
+    if kv is None:
+        update_on_kvstore = False
+    return (kv, update_on_kvstore)
+
+
+def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
+                        update_on_kvstore):
+    """Init each param key; in update-on-kvstore mode pull the initial
+    weights back (reference model.py:68-86)."""
+    for idx, param_on_devs in enumerate(param_arrays):
+        kvstore.init(idx, arg_params[param_names[idx]])
+        if update_on_kvstore:
+            kvstore.pull(idx, param_on_devs, priority=-idx)
+
+
+def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
+    """push(grad); pull(weight) per key (reference model.py:88-97).
+    Priority -index makes early layers sync first in the reference
+    engine; jax dispatch keeps issue order, which preserves the same
+    overlap behavior."""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list[0] is None:
+            continue
+        kvstore.push(index, grad_list, priority=-index)
+        kvstore.pull(index, arg_list, priority=-index)
+
+
+def _update_params(param_arrays, grad_arrays, updater, num_device,
+                   kvstore=None):
+    """Local update path: optional kvstore aggregation, then run the
+    updater on each device copy (reference model.py:99-130)."""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list[0] is None:
+            continue
+        if kvstore:
+            kvstore.push(index, grad_list, priority=-index)
+            kvstore.pull(index, grad_list, priority=-index)
+        for k, p in enumerate(zip(arg_list, grad_list)):
+            # faked an index so an optimizer create only one state per key
+            w, g = p
+            updater(index * num_device + k, g, w)
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """Write prefix-symbol.json + prefix-%04d.params (reference
+    model.py:319-347)."""
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
+    save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd.save(param_name, save_dict)
+    logging.info('Saved checkpoint to "%s"', param_name)
+
+
+def load_checkpoint(prefix, epoch):
+    """Load (symbol, arg_params, aux_params) (reference
+    model.py:349-385)."""
+    symbol = sym.load(f"{prefix}-symbol.json")
+    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+    arg_params = {}
+    aux_params = {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+        else:
+            raise MXNetError(f"Invalid param file: bad key {k!r}")
+    return (symbol, arg_params, aux_params)

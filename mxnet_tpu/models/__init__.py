@@ -1,0 +1,13 @@
+"""Model zoo: symbol builders for the reference's example model families
+(reference example/image-classification/symbol_*.py, example/rnn/).
+
+Each builder returns a Symbol ending in SoftmaxOutput, ready for
+Module.fit. ResNet is the flagship/benchmark model (BASELINE.md
+headline: ResNet-50 throughput + MFU).
+"""
+from .mlp import get_mlp
+from .lenet import get_lenet
+from .resnet import get_resnet
+from .alexnet import get_alexnet
+from .inception import get_inception_bn
+from .vgg import get_vgg

@@ -1,0 +1,46 @@
+"""AlexNet (reference example/image-classification/symbol_alexnet.py)."""
+from .. import symbol as sym
+
+
+def get_alexnet(num_classes=1000):
+    input_data = sym.Variable(name="data")
+    # stage 1
+    conv1 = sym.Convolution(input_data, name="conv1", kernel=(11, 11),
+                            stride=(4, 4), num_filter=96)
+    relu1 = sym.Activation(conv1, name="relu1", act_type="relu")
+    pool1 = sym.Pooling(relu1, name="pool1", pool_type="max",
+                        kernel=(3, 3), stride=(2, 2))
+    lrn1 = sym.LRN(pool1, name="lrn1", alpha=0.0001, beta=0.75, knorm=1,
+                   nsize=5)
+    # stage 2
+    conv2 = sym.Convolution(lrn1, name="conv2", kernel=(5, 5), pad=(2, 2),
+                            num_filter=256)
+    relu2 = sym.Activation(conv2, name="relu2", act_type="relu")
+    pool2 = sym.Pooling(relu2, name="pool2", kernel=(3, 3), stride=(2, 2),
+                        pool_type="max")
+    lrn2 = sym.LRN(pool2, name="lrn2", alpha=0.0001, beta=0.75, knorm=1,
+                   nsize=5)
+    # stage 3
+    conv3 = sym.Convolution(lrn2, name="conv3", kernel=(3, 3), pad=(1, 1),
+                            num_filter=384)
+    relu3 = sym.Activation(conv3, name="relu3", act_type="relu")
+    conv4 = sym.Convolution(relu3, name="conv4", kernel=(3, 3), pad=(1, 1),
+                            num_filter=384)
+    relu4 = sym.Activation(conv4, name="relu4", act_type="relu")
+    conv5 = sym.Convolution(relu4, name="conv5", kernel=(3, 3), pad=(1, 1),
+                            num_filter=256)
+    relu5 = sym.Activation(conv5, name="relu5", act_type="relu")
+    pool3 = sym.Pooling(relu5, name="pool3", kernel=(3, 3), stride=(2, 2),
+                        pool_type="max")
+    # stage 4
+    flatten = sym.Flatten(pool3, name="flatten")
+    fc1 = sym.FullyConnected(flatten, name="fc1", num_hidden=4096)
+    relu6 = sym.Activation(fc1, name="relu6", act_type="relu")
+    dropout1 = sym.Dropout(relu6, name="dropout1", p=0.5)
+    # stage 5
+    fc2 = sym.FullyConnected(dropout1, name="fc2", num_hidden=4096)
+    relu7 = sym.Activation(fc2, name="relu7", act_type="relu")
+    dropout2 = sym.Dropout(relu7, name="dropout2", p=0.5)
+    # stage 6
+    fc3 = sym.FullyConnected(dropout2, name="fc3", num_hidden=num_classes)
+    return sym.SoftmaxOutput(fc3, name="softmax")

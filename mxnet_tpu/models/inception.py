@@ -1,0 +1,85 @@
+"""Inception-BN (reference
+example/image-classification/symbol_inception-bn.py)."""
+from .. import symbol as sym
+
+
+def _conv_factory(data, num_filter, kernel, stride=(1, 1), pad=(0, 0),
+                  name=None, suffix=""):
+    conv = sym.Convolution(
+        data, name=f"conv_{name}{suffix}", num_filter=num_filter,
+        kernel=kernel, stride=stride, pad=pad)
+    bn = sym.BatchNorm(conv, name=f"bn_{name}{suffix}", fix_gamma=False)
+    act = sym.Activation(bn, name=f"relu_{name}{suffix}", act_type="relu")
+    return act
+
+
+def _inception_a(data, num_1x1, num_3x3red, num_3x3, num_d3x3red, num_d3x3,
+                 pool, proj, name):
+    c1x1 = _conv_factory(data, num_1x1, (1, 1), name=f"{name}_1x1")
+    c3x3r = _conv_factory(data, num_3x3red, (1, 1),
+                          name=f"{name}_3x3", suffix="_reduce")
+    c3x3 = _conv_factory(c3x3r, num_3x3, (3, 3), pad=(1, 1),
+                         name=f"{name}_3x3")
+    cd3x3r = _conv_factory(data, num_d3x3red, (1, 1),
+                           name=f"{name}_double_3x3", suffix="_reduce")
+    cd3x3 = _conv_factory(cd3x3r, num_d3x3, (3, 3), pad=(1, 1),
+                          name=f"{name}_double_3x3_0")
+    cd3x3 = _conv_factory(cd3x3, num_d3x3, (3, 3), pad=(1, 1),
+                          name=f"{name}_double_3x3_1")
+    pooling = sym.Pooling(
+        data, name=f"{pool}_pool_{name}_pool", kernel=(3, 3),
+        stride=(1, 1), pad=(1, 1), pool_type=pool)
+    cproj = _conv_factory(pooling, proj, (1, 1), name=f"{name}_proj")
+    return sym.Concat(c1x1, c3x3, cd3x3, cproj,
+                      name=f"ch_concat_{name}_chconcat")
+
+
+def _inception_b(data, num_3x3red, num_3x3, num_d3x3red, num_d3x3, name):
+    c3x3r = _conv_factory(data, num_3x3red, (1, 1),
+                          name=f"{name}_3x3", suffix="_reduce")
+    c3x3 = _conv_factory(c3x3r, num_3x3, (3, 3), pad=(1, 1),
+                         stride=(2, 2), name=f"{name}_3x3")
+    cd3x3r = _conv_factory(data, num_d3x3red, (1, 1),
+                           name=f"{name}_double_3x3", suffix="_reduce")
+    cd3x3 = _conv_factory(cd3x3r, num_d3x3, (3, 3), pad=(1, 1),
+                          name=f"{name}_double_3x3_0")
+    cd3x3 = _conv_factory(cd3x3, num_d3x3, (3, 3), pad=(1, 1),
+                          stride=(2, 2), name=f"{name}_double_3x3_1")
+    pooling = sym.Pooling(
+        data, name=f"max_pool_{name}_pool", kernel=(3, 3), stride=(2, 2),
+        pad=(1, 1), pool_type="max")
+    return sym.Concat(c3x3, cd3x3, pooling,
+                      name=f"ch_concat_{name}_chconcat")
+
+
+def get_inception_bn(num_classes=1000):
+    data = sym.Variable("data")
+    # stage 1
+    conv1 = _conv_factory(data, 64, (7, 7), stride=(2, 2), pad=(3, 3),
+                          name="conv1")
+    pool1 = sym.Pooling(conv1, name="pool1", kernel=(3, 3), stride=(2, 2),
+                        pool_type="max")
+    # stage 2
+    conv2red = _conv_factory(pool1, 64, (1, 1), name="conv2red")
+    conv2 = _conv_factory(conv2red, 192, (3, 3), pad=(1, 1), name="conv2")
+    pool2 = sym.Pooling(conv2, name="pool2", kernel=(3, 3), stride=(2, 2),
+                        pool_type="max")
+    # stage 3
+    in3a = _inception_a(pool2, 64, 64, 64, 64, 96, "avg", 32, "3a")
+    in3b = _inception_a(in3a, 64, 64, 96, 64, 96, "avg", 64, "3b")
+    in3c = _inception_b(in3b, 128, 160, 64, 96, "3c")
+    # stage 4
+    in4a = _inception_a(in3c, 224, 64, 96, 96, 128, "avg", 128, "4a")
+    in4b = _inception_a(in4a, 192, 96, 128, 96, 128, "avg", 128, "4b")
+    in4c = _inception_a(in4b, 160, 128, 160, 128, 160, "avg", 128, "4c")
+    in4d = _inception_a(in4c, 96, 128, 192, 160, 192, "avg", 128, "4d")
+    in4e = _inception_b(in4d, 128, 192, 192, 256, "4e")
+    # stage 5
+    in5a = _inception_a(in4e, 352, 192, 320, 160, 224, "avg", 128, "5a")
+    in5b = _inception_a(in5a, 352, 192, 320, 192, 224, "max", 128, "5b")
+    # global avg pooling
+    avg = sym.Pooling(in5b, name="global_pool", kernel=(7, 7),
+                      stride=(1, 1), global_pool=True, pool_type="avg")
+    flatten = sym.Flatten(avg, name="flatten")
+    fc1 = sym.FullyConnected(flatten, name="fc1", num_hidden=num_classes)
+    return sym.SoftmaxOutput(fc1, name="softmax")

@@ -1,0 +1,25 @@
+"""LeNet (reference example/image-classification/symbol_lenet.py)."""
+from .. import symbol as sym
+
+
+def get_lenet(num_classes=10):
+    data = sym.Variable("data")
+    # first conv
+    conv1 = sym.Convolution(data, name="conv1", kernel=(5, 5),
+                            num_filter=20)
+    tanh1 = sym.Activation(conv1, name="tanh1", act_type="tanh")
+    pool1 = sym.Pooling(tanh1, name="pool1", pool_type="max",
+                        kernel=(2, 2), stride=(2, 2))
+    # second conv
+    conv2 = sym.Convolution(pool1, name="conv2", kernel=(5, 5),
+                            num_filter=50)
+    tanh2 = sym.Activation(conv2, name="tanh2", act_type="tanh")
+    pool2 = sym.Pooling(tanh2, name="pool2", pool_type="max",
+                        kernel=(2, 2), stride=(2, 2))
+    # first fullc
+    flatten = sym.Flatten(pool2, name="flatten")
+    fc1 = sym.FullyConnected(flatten, name="fc1", num_hidden=500)
+    tanh3 = sym.Activation(fc1, name="tanh3", act_type="tanh")
+    # second fullc
+    fc2 = sym.FullyConnected(tanh3, name="fc2", num_hidden=num_classes)
+    return sym.SoftmaxOutput(fc2, name="softmax")

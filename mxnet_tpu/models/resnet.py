@@ -1,0 +1,114 @@
+"""ResNet v1/v2 (reference example/image-classification/symbol_resnet.py
+style; units/filters per the original He et al. configs).
+
+TPU notes: NCHW layout is kept for API parity (XLA:TPU transposes to its
+preferred layout internally); BatchNorm carries moving stats as aux
+states; the whole network lowers to one fused XLA computation at bind.
+"""
+from .. import symbol as sym
+
+
+def _residual_unit(data, num_filter, stride, dim_match, name,
+                   bottle_neck=True, bn_mom=0.9):
+    """Residual unit with identity/projection shortcut (pre-activation,
+    He 2016)."""
+    if bottle_neck:
+        bn1 = sym.BatchNorm(data, name=name + "_bn1", fix_gamma=False,
+                            eps=2e-5, momentum=bn_mom)
+        act1 = sym.Activation(bn1, name=name + "_relu1", act_type="relu")
+        conv1 = sym.Convolution(
+            act1, name=name + "_conv1", num_filter=num_filter // 4,
+            kernel=(1, 1), stride=(1, 1), pad=(0, 0), no_bias=True)
+        bn2 = sym.BatchNorm(conv1, name=name + "_bn2", fix_gamma=False,
+                            eps=2e-5, momentum=bn_mom)
+        act2 = sym.Activation(bn2, name=name + "_relu2", act_type="relu")
+        conv2 = sym.Convolution(
+            act2, name=name + "_conv2", num_filter=num_filter // 4,
+            kernel=(3, 3), stride=stride, pad=(1, 1), no_bias=True)
+        bn3 = sym.BatchNorm(conv2, name=name + "_bn3", fix_gamma=False,
+                            eps=2e-5, momentum=bn_mom)
+        act3 = sym.Activation(bn3, name=name + "_relu3", act_type="relu")
+        conv3 = sym.Convolution(
+            act3, name=name + "_conv3", num_filter=num_filter,
+            kernel=(1, 1), stride=(1, 1), pad=(0, 0), no_bias=True)
+        if dim_match:
+            shortcut = data
+        else:
+            shortcut = sym.Convolution(
+                act1, name=name + "_sc", num_filter=num_filter,
+                kernel=(1, 1), stride=stride, no_bias=True)
+        return conv3 + shortcut
+    bn1 = sym.BatchNorm(data, name=name + "_bn1", fix_gamma=False,
+                        eps=2e-5, momentum=bn_mom)
+    act1 = sym.Activation(bn1, name=name + "_relu1", act_type="relu")
+    conv1 = sym.Convolution(
+        act1, name=name + "_conv1", num_filter=num_filter,
+        kernel=(3, 3), stride=stride, pad=(1, 1), no_bias=True)
+    bn2 = sym.BatchNorm(conv1, name=name + "_bn2", fix_gamma=False,
+                        eps=2e-5, momentum=bn_mom)
+    act2 = sym.Activation(bn2, name=name + "_relu2", act_type="relu")
+    conv2 = sym.Convolution(
+        act2, name=name + "_conv2", num_filter=num_filter,
+        kernel=(3, 3), stride=(1, 1), pad=(1, 1), no_bias=True)
+    if dim_match:
+        shortcut = data
+    else:
+        shortcut = sym.Convolution(
+            act1, name=name + "_sc", num_filter=num_filter,
+            kernel=(1, 1), stride=stride, no_bias=True)
+    return conv2 + shortcut
+
+
+_CONFIGS = {
+    18: ([2, 2, 2, 2], [64, 64, 128, 256, 512], False),
+    34: ([3, 4, 6, 3], [64, 64, 128, 256, 512], False),
+    50: ([3, 4, 6, 3], [64, 256, 512, 1024, 2048], True),
+    101: ([3, 4, 23, 3], [64, 256, 512, 1024, 2048], True),
+    152: ([3, 8, 36, 3], [64, 256, 512, 1024, 2048], True),
+}
+
+
+def get_resnet(num_classes=1000, num_layers=50, image_shape=(3, 224, 224),
+               bn_mom=0.9):
+    """Build ResNet-{18,34,50,101,152} (reference symbol_resnet.py resnet())."""
+    if num_layers not in _CONFIGS:
+        raise ValueError(f"no ResNet-{num_layers} config")
+    units, filter_list, bottle_neck = _CONFIGS[num_layers]
+
+    data = sym.Variable("data")
+    data = sym.BatchNorm(data, name="bn_data", fix_gamma=True, eps=2e-5)
+    (nchannel, height, _) = image_shape
+    if height <= 32:  # cifar-style stem
+        body = sym.Convolution(
+            data, name="conv0", num_filter=filter_list[0], kernel=(3, 3),
+            stride=(1, 1), pad=(1, 1), no_bias=True)
+    else:  # imagenet stem
+        body = sym.Convolution(
+            data, name="conv0", num_filter=filter_list[0], kernel=(7, 7),
+            stride=(2, 2), pad=(3, 3), no_bias=True)
+        body = sym.BatchNorm(body, name="bn0", fix_gamma=False, eps=2e-5,
+                             momentum=bn_mom)
+        body = sym.Activation(body, name="relu0", act_type="relu")
+        body = sym.Pooling(body, name="pool0", kernel=(3, 3),
+                           stride=(2, 2), pad=(1, 1), pool_type="max")
+
+    for i, num_unit in enumerate(units):
+        stride = (1, 1) if i == 0 else (2, 2)
+        body = _residual_unit(
+            body, filter_list[i + 1], stride, False,
+            name=f"stage{i + 1}_unit1", bottle_neck=bottle_neck,
+            bn_mom=bn_mom)
+        for j in range(num_unit - 1):
+            body = _residual_unit(
+                body, filter_list[i + 1], (1, 1), True,
+                name=f"stage{i + 1}_unit{j + 2}", bottle_neck=bottle_neck,
+                bn_mom=bn_mom)
+
+    bn1 = sym.BatchNorm(body, name="bn1", fix_gamma=False, eps=2e-5,
+                        momentum=bn_mom)
+    relu1 = sym.Activation(bn1, name="relu1", act_type="relu")
+    pool1 = sym.Pooling(relu1, name="pool1", global_pool=True,
+                        kernel=(7, 7), pool_type="avg")
+    flat = sym.Flatten(pool1, name="flatten")
+    fc1 = sym.FullyConnected(flat, name="fc1", num_hidden=num_classes)
+    return sym.SoftmaxOutput(fc1, name="softmax")

@@ -1,0 +1,34 @@
+"""VGG-16 (reference example/image-classification/symbol_vgg.py)."""
+from .. import symbol as sym
+
+_CFG = {
+    11: [(1, 64), (1, 128), (2, 256), (2, 512), (2, 512)],
+    13: [(2, 64), (2, 128), (2, 256), (2, 512), (2, 512)],
+    16: [(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)],
+    19: [(2, 64), (2, 128), (4, 256), (4, 512), (4, 512)],
+}
+
+
+def get_vgg(num_classes=1000, num_layers=16):
+    if num_layers not in _CFG:
+        raise ValueError(f"no VGG-{num_layers} config")
+    data = sym.Variable("data")
+    net = data
+    for i, (reps, filters) in enumerate(_CFG[num_layers], 1):
+        for j in range(1, reps + 1):
+            net = sym.Convolution(
+                net, name=f"conv{i}_{j}", kernel=(3, 3), pad=(1, 1),
+                num_filter=filters)
+            net = sym.Activation(net, name=f"relu{i}_{j}",
+                                 act_type="relu")
+        net = sym.Pooling(net, name=f"pool{i}", kernel=(2, 2),
+                          stride=(2, 2), pool_type="max")
+    flatten = sym.Flatten(net, name="flatten")
+    fc6 = sym.FullyConnected(flatten, name="fc6", num_hidden=4096)
+    relu6 = sym.Activation(fc6, name="relu6", act_type="relu")
+    drop6 = sym.Dropout(relu6, name="drop6", p=0.5)
+    fc7 = sym.FullyConnected(drop6, name="fc7", num_hidden=4096)
+    relu7 = sym.Activation(fc7, name="relu7", act_type="relu")
+    drop7 = sym.Dropout(relu7, name="drop7", p=0.5)
+    fc8 = sym.FullyConnected(drop7, name="fc8", num_hidden=num_classes)
+    return sym.SoftmaxOutput(fc8, name="softmax")

@@ -1,0 +1,496 @@
+"""DataParallelExecutorGroup: bind one executor per device context and
+split each batch across them.
+
+Analog of python/mxnet/module/executor_group.py (decide_slices :207,
+_bind_ith_exec :537). On TPU hardware the idiomatic path is ONE pjit'd
+computation over the mesh's data axis (parallel/), but the executor-group
+shape is kept because (a) it is the reference's multi-device semantics —
+testable on N virtual CPU devices exactly like the reference tests DP on
+mx.cpu(0)/mx.cpu(1) — and (b) BucketingModule and Monitor hang off its
+interfaces.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .. import ndarray as nd
+from ..base import MXNetError
+from ..io import DataDesc
+
+
+def _load_general(data, targets):
+    """Load a list of batch arrays into per-device slices (reference
+    executor_group.py:16-30)."""
+    for d_src, d_targets in zip(data, targets):
+        if isinstance(d_targets, nd.NDArray):
+            d_src.copyto(d_targets)
+        else:
+            for slice_idx, d_dst in d_targets:
+                if d_src.shape == d_dst.shape:
+                    d_src.copyto(d_dst)
+                else:
+                    d_src[slice_idx.start: slice_idx.stop].copyto(d_dst)
+
+
+def _load_data(batch, targets):
+    _load_general(batch.data, targets)
+
+
+def _load_label(batch, targets):
+    _load_general(batch.label, targets)
+
+
+def _merge_multi_context(outputs):
+    """Concatenate per-device outputs along batch dim, gathering onto the
+    first device (reference executor_group.py:33-41)."""
+    import jax
+
+    merged = []
+    for tensors in outputs:
+        if len(tensors) == 1:
+            merged.append(tensors[0])
+            continue
+        dev = tensors[0].context.jax_device()
+        gathered = [tensors[0]] + [
+            nd.NDArray(jax.device_put(x._data, dev),
+                       ctx=tensors[0].context)
+            for x in tensors[1:]
+        ]
+        merged.append(nd.concatenate(gathered, axis=0))
+    return merged
+
+
+class DataParallelExecutorGroup(object):
+    """(reference executor_group.py:77-270)"""
+
+    def __init__(self, symbol, contexts, workload, data_shapes,
+                 label_shapes, param_names, for_training, inputs_need_grad,
+                 shared_group=None, logger=logging, fixed_param_names=None,
+                 grad_req="write", state_names=None):
+        self.param_names = param_names
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+
+        self.symbol = symbol
+        self.contexts = contexts
+        self.workload = workload if workload else [1] * len(contexts)
+
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+
+        self.logger = logger
+
+        self.fixed_param_names = fixed_param_names or []
+        self.state_names = state_names or []
+        if not for_training:
+            grad_req = "null"
+
+        data_names = [x[0] for x in data_shapes]
+
+        if isinstance(grad_req, str):
+            self.grad_req = {}
+            for k in self.arg_names:
+                if k in self.param_names:
+                    self.grad_req[k] = (
+                        "null" if k in self.fixed_param_names else grad_req
+                    )
+                elif k in data_names:
+                    self.grad_req[k] = grad_req if inputs_need_grad else "null"
+                else:
+                    self.grad_req[k] = "null"
+        elif isinstance(grad_req, (list, tuple)):
+            assert len(grad_req) == len(self.arg_names)
+            self.grad_req = dict(zip(self.arg_names, grad_req))
+        elif isinstance(grad_req, dict):
+            self.grad_req = {}
+            for k in self.arg_names:
+                if k in self.param_names:
+                    self.grad_req[k] = (
+                        "null" if k in self.fixed_param_names else "write"
+                    )
+                elif k in data_names:
+                    self.grad_req[k] = "write" if inputs_need_grad else "null"
+                else:
+                    self.grad_req[k] = "null"
+            self.grad_req.update(grad_req)
+        else:
+            raise MXNetError("grad_req must be one of str, list, tuple, or dict.")
+
+        if shared_group is not None:
+            self.shared_data_arrays = shared_group.shared_data_arrays
+        else:
+            self.shared_data_arrays = [{} for _ in contexts]
+
+        self.output_layouts = [
+            DataDesc.get_batch_axis(self.symbol[name].attr("__layout__"))
+            for name in self.symbol.list_outputs()
+        ]
+
+        self.batch_size = None
+        self.slices = None
+        self.execs = []
+        self._default_execs = None
+        self.data_arrays = None
+        self.label_arrays = None
+        self.param_arrays = None
+        self.grad_arrays = None
+        self.aux_arrays = None
+        self.input_grad_arrays = None
+
+        self.data_shapes = None
+        self.label_shapes = None
+        self.data_layouts = None
+        self.label_layouts = None
+        self.bind_exec(data_shapes, label_shapes, shared_group)
+
+    def decide_slices(self, data_shapes):
+        """Split batch_size across contexts by workload (reference
+        executor_group.py:207-230)."""
+        assert len(data_shapes) > 0
+        major_axis = [DataDesc.get_batch_axis(getattr(x, "layout", "NCHW"))
+                      for x in data_shapes]
+        for (name, shape), axis in zip(data_shapes, major_axis):
+            if axis == -1:
+                continue
+            batch_size = shape[axis]
+            if self.batch_size is not None:
+                assert batch_size == self.batch_size, (
+                    f"all data must have the same batch size: batch_size = "
+                    f"{self.batch_size}, but {name} has shape {shape}"
+                )
+            else:
+                self.batch_size = batch_size
+                rests = self.batch_size - sum(
+                    int(round(self.batch_size * v / sum(self.workload)))
+                    for v in self.workload[:-1]
+                )
+                slices = []
+                start = 0
+                for i, v in enumerate(self.workload):
+                    if i == len(self.workload) - 1:
+                        step = rests
+                    else:
+                        step = int(round(self.batch_size * v / sum(self.workload)))
+                    slices.append(slice(start, start + step))
+                    start += step
+                self.slices = slices
+        return major_axis
+
+    def _sliced_shape(self, shapes, i, major_axis):
+        """Shape of the i-th executor's slice (reference
+        executor_group.py:232-245)."""
+        sliced = []
+        for (desc, axis) in zip(shapes, major_axis):
+            shape = list(desc.shape if isinstance(desc, DataDesc)
+                         else desc[1])
+            if axis >= 0:
+                shape[axis] = self.slices[i].stop - self.slices[i].start
+            name = desc.name if isinstance(desc, DataDesc) else desc[0]
+            dtype = desc.dtype if isinstance(desc, DataDesc) else np.float32
+            sliced.append(DataDesc(name, tuple(shape), dtype))
+        return sliced
+
+    def bind_exec(self, data_shapes, label_shapes, shared_group=None,
+                  reshape=False):
+        """(Re)bind executors (reference executor_group.py:247-270)."""
+        assert reshape or not self.execs
+        self.batch_size = None
+
+        self.data_layouts = self.decide_slices(data_shapes)
+        if label_shapes is not None:
+            self.label_layouts = self.decide_slices(label_shapes)
+
+        for i in range(len(self.contexts)):
+            data_shapes_i = self._sliced_shape(data_shapes, i,
+                                               self.data_layouts)
+            if label_shapes is not None:
+                label_shapes_i = self._sliced_shape(label_shapes, i,
+                                                    self.label_layouts)
+            else:
+                label_shapes_i = []
+
+            if reshape:
+                self.execs[i] = self._default_execs[i].reshape(
+                    allow_up_sizing=True,
+                    **dict([(x.name, x.shape)
+                            for x in data_shapes_i + label_shapes_i])
+                )
+            else:
+                self.execs.append(
+                    self._bind_ith_exec(i, data_shapes_i, label_shapes_i,
+                                        shared_group)
+                )
+
+        self.data_shapes = data_shapes
+        self.label_shapes = label_shapes
+        self._collect_arrays()
+
+    def reshape(self, data_shapes, label_shapes):
+        if data_shapes == self.data_shapes and label_shapes == self.label_shapes:
+            return
+        if self._default_execs is None:
+            self._default_execs = [i for i in self.execs]
+        self.bind_exec(data_shapes, label_shapes, reshape=True)
+
+    def _collect_arrays(self):
+        """Gather param/grad/data/label arrays across executors (reference
+        executor_group.py:272-320)."""
+        self.data_arrays = [
+            [(self.slices[i], e.arg_dict[name])
+             for i, e in enumerate(self.execs)]
+            for name, _ in self.data_shapes
+        ]
+        if self.label_shapes is not None:
+            self.label_arrays = [
+                [(self.slices[i], e.arg_dict[name])
+                 for i, e in enumerate(self.execs)]
+                for name, _ in self.label_shapes
+            ]
+        else:
+            self.label_arrays = None
+
+        self.param_arrays = [
+            [exec_.arg_dict[name] for exec_ in self.execs]
+            for name in self.param_names
+        ]
+        self.state_arrays = [
+            [e.arg_dict[name] for e in self.execs]
+            for name in self.state_names
+        ]
+        if self.for_training:
+            self.grad_arrays = [
+                [exec_.grad_dict[name] for exec_ in self.execs]
+                if self.grad_req[name] != "null" else [None] * len(self.execs)
+                for name in self.param_names
+            ]
+        else:
+            self.grad_arrays = None
+
+        data_names = [x[0] for x in self.data_shapes]
+        if self.inputs_need_grad:
+            self.input_grad_arrays = [
+                [exec_.grad_dict[name] for exec_ in self.execs]
+                for name in data_names if name in self.execs[0].grad_dict
+            ]
+        else:
+            self.input_grad_arrays = None
+
+        self.aux_arrays = [
+            [exec_.aux_dict[name] for exec_ in self.execs]
+            for name in self.aux_names
+        ]
+
+    @staticmethod
+    def _block_mean(block):
+        """Average device copies of one parameter, gathering onto the
+        first copy's device (reference executor_group.py:322 sums with
+        cross-device CopyFromTo)."""
+        if len(block) == 1:
+            return block[0].copy()
+        import jax
+
+        dev = block[0].context.jax_device()
+        acc = block[0]._data
+        for w in block[1:]:
+            acc = acc + jax.device_put(w._data, dev).astype(acc.dtype)
+        return nd.NDArray(acc / len(block), ctx=block[0].context)
+
+    def get_params(self, arg_params, aux_params):
+        """Average params across devices into the given dicts (reference
+        executor_group.py:322-340)."""
+        for name, block in zip(self.param_names, self.param_arrays):
+            weight = self._block_mean(block)
+            weight.astype(arg_params[name].dtype).copyto(arg_params[name])
+        for name, block in zip(self.aux_names, self.aux_arrays):
+            weight = self._block_mean(block)
+            weight.astype(aux_params[name].dtype).copyto(aux_params[name])
+
+    def set_params(self, arg_params, aux_params):
+        for exec_ in self.execs:
+            exec_.copy_params_from(arg_params, aux_params)
+
+    def forward(self, data_batch, is_train=None):
+        """Slice batch across devices and run forward (reference
+        executor_group.py:355-380)."""
+        _load_data(data_batch, self.data_arrays)
+        if is_train is None:
+            is_train = self.for_training
+        if self.label_arrays is not None and data_batch.label:
+            _load_label(data_batch, self.label_arrays)
+        for exec_ in self.execs:
+            exec_.forward(is_train=is_train)
+
+    def get_output_shapes(self):
+        outputs = self.execs[0].outputs
+        shapes = [out.shape for out in outputs]
+        concat_shapes = []
+        for key, the_shape, axis in zip(
+            self.symbol.list_outputs(), shapes, self.output_layouts
+        ):
+            the_shape = list(the_shape)
+            if axis >= 0:
+                the_shape[axis] = self.batch_size
+            concat_shapes.append((key, tuple(the_shape)))
+        return concat_shapes
+
+    def get_outputs(self, merge_multi_context=True):
+        """(reference executor_group.py:395-410)"""
+        outputs = [
+            [exec_.outputs[i] for exec_ in self.execs]
+            for i in range(len(self.execs[0].outputs))
+        ]
+        if merge_multi_context:
+            outputs = _merge_multi_context(outputs)
+        return outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.inputs_need_grad
+        if merge_multi_context:
+            return _merge_multi_context(self.input_grad_arrays)
+        return self.input_grad_arrays
+
+    def backward(self, out_grads=None):
+        """Run backward on each executor with sliced head grads (reference
+        executor_group.py:481-510)."""
+        assert self.for_training, "re-bind with for_training=True to run backward"
+        if out_grads is None:
+            out_grads = []
+        if isinstance(out_grads, nd.NDArray):
+            out_grads = [out_grads]
+        for i, exec_ in enumerate(self.execs):
+            out_grads_slice = []
+            for grad, axis in zip(out_grads, self.output_layouts):
+                if axis >= 0:
+                    og_my_slice = nd.NDArray(
+                        grad._data[
+                            tuple(
+                                self.slices[i] if ax == axis
+                                else slice(None)
+                                for ax in range(grad.ndim)
+                            )
+                        ],
+                        ctx=self.contexts[i],
+                    )
+                    out_grads_slice.append(
+                        og_my_slice.as_in_context(self.contexts[i])
+                    )
+                else:
+                    out_grads_slice.append(grad.copyto(self.contexts[i]))
+            exec_.backward(out_grads=out_grads_slice or None)
+
+    def update_metric(self, eval_metric, labels):
+        """(reference executor_group.py:512-520)"""
+        for texec, islice in zip(self.execs, self.slices):
+            labels_slice = []
+            for label, axis in zip(labels, self.label_layouts or []):
+                if axis == 0:
+                    if label.shape[0] == islice.stop - islice.start:
+                        labels_slice.append(label)
+                    else:
+                        labels_slice.append(label[islice.start: islice.stop])
+                elif axis > 0:
+                    label_my_slice = nd.NDArray(
+                        label._data[
+                            tuple(
+                                islice if ax == axis else slice(None)
+                                for ax in range(label.ndim)
+                            )
+                        ],
+                        ctx=label.context,
+                    )
+                    labels_slice.append(label_my_slice)
+                else:
+                    labels_slice.append(label)
+            eval_metric.update(labels_slice, texec.outputs)
+
+    def _bind_ith_exec(self, i, data_shapes, label_shapes, shared_group):
+        """Bind executor i, sharing memory with shared_group's executor i
+        (reference executor_group.py:537-620). XLA owns buffer placement,
+        so "sharing the memory pool" reduces to sharing parameter
+        NDArrays (shape-equal args) with the shared executor."""
+        shared_exec = None if shared_group is None else shared_group.execs[i]
+        context = self.contexts[i]
+        shared_data_arrays = self.shared_data_arrays[i]
+
+        input_shapes = dict(data_shapes)
+        if label_shapes is not None:
+            input_shapes.update(dict(label_shapes))
+
+        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**input_shapes)
+        assert arg_shapes is not None, "shape inference failed"
+
+        input_types = {x.name: x.dtype for x in data_shapes}
+        if label_shapes is not None:
+            input_types.update({x.name: x.dtype for x in label_shapes})
+        arg_types, _, aux_types = self.symbol.infer_type(**input_types)
+        assert arg_types is not None, "type inference failed"
+
+        arg_arrays = []
+        grad_arrays = {} if self.for_training else None
+
+        def _get_or_reshape(name, shared_data_arrays, arg_shape, arg_type,
+                            context, logger):
+            if name in shared_data_arrays:
+                arg_arr = shared_data_arrays[name]
+                if np.prod(arg_arr.shape) >= np.prod(arg_shape):
+                    assert arg_arr.dtype == arg_type
+                    arg_arr = nd.NDArray(
+                        arg_arr._data.ravel()[: int(np.prod(arg_shape))]
+                        .reshape(arg_shape),
+                        ctx=context,
+                    )
+                else:
+                    logger.warning(
+                        "bucketing: data %s has a shape %s, which is larger "
+                        "than already allocated shape %s. Need to re-allocate."
+                        " Consider putting default_bucket_key to be the "
+                        "bucket taking the largest input for better memory "
+                        "sharing.", name, arg_shape, arg_arr.shape)
+                    arg_arr = nd.zeros(arg_shape, context, dtype=arg_type)
+                    shared_data_arrays[name] = arg_arr
+            else:
+                arg_arr = nd.zeros(arg_shape, context, dtype=arg_type)
+                shared_data_arrays[name] = arg_arr
+            return arg_arr
+
+        for j in range(len(self.arg_names)):
+            name = self.arg_names[j]
+            if name in self.param_names:
+                if shared_exec is None:
+                    arg_arr = nd.zeros(arg_shapes[j], context,
+                                       dtype=arg_types[j])
+                else:
+                    arg_arr = shared_exec.arg_dict[name]
+                    assert arg_arr.shape == arg_shapes[j]
+                    assert arg_arr.dtype == arg_types[j]
+                if self.grad_req[name] != "null":
+                    grad_arrays[name] = nd.zeros(arg_shapes[j], context,
+                                                 dtype=arg_types[j])
+            else:
+                arg_arr = _get_or_reshape(name, shared_data_arrays,
+                                          arg_shapes[j], arg_types[j],
+                                          context, self.logger)
+                if self.grad_req[name] != "null":
+                    grad_arrays[name] = _get_or_reshape(
+                        "grad of " + name, shared_data_arrays,
+                        arg_shapes[j], arg_types[j], context, self.logger)
+            arg_arrays.append(arg_arr)
+
+        if shared_exec is None:
+            aux_arrays = [
+                nd.zeros(s, context, dtype=t)
+                for s, t in zip(aux_shapes, aux_types)
+            ]
+        else:
+            aux_arrays = shared_exec.aux_arrays
+
+        args = dict(zip(self.arg_names, arg_arrays))
+        aux = dict(zip(self.aux_names, aux_arrays))
+        executor = self.symbol.bind(
+            ctx=context, args=args, args_grad=grad_arrays,
+            aux_states=aux, grad_req=self.grad_req,
+            shared_exec=shared_exec,
+        )
+        return executor

@@ -199,6 +199,10 @@ class NDArray:
             raise MXNetError("array is not writable")
         if isinstance(value, NDArray):
             val = value._data
+            if value._ctx != self._ctx:
+                # keep the write on this array's device (reference
+                # CopyFromTo handles the cross-device hop)
+                val = jax.device_put(val, self._ctx.jax_device())
         elif np.isscalar(value):
             val = value
         else:

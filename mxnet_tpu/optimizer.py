@@ -1,0 +1,508 @@
+"""Optimizer registry + implementations.
+
+Analog of python/mxnet/optimizer.py (755 lines: SGD:279, Adam:451,
+RMSProp:536, Updater closure:722). TPU-native design: every optimizer's
+`update` routes through a *fused* registered op (ops/optimizer_ops.py) or
+a jitted jax closure, so weight+state update is one XLA kernel per
+parameter — the analog of the reference's fused `sgd_update`/`adam_update`
+mshadow kernels. State arrays live on device; the scalar schedule math
+(lr_scheduler, wd multipliers, update counts) stays host-side, exactly
+like the reference.
+
+The `get_updater` closure is what KVStore calls per key (reference
+optimizer.py:722 `Updater`), so server-side optimizer semantics carry
+over unchanged to the KVStore('tpu') facade.
+"""
+from __future__ import annotations
+
+import math
+import pickle
+
+import numpy as np
+
+from . import ndarray as nd
+from .base import MXNetError
+from .ndarray import NDArray
+
+_OPT_REGISTRY: dict[str, type] = {}
+
+
+def register(klass):
+    """Register an optimizer class under its lowercased name (reference
+    optimizer.py Optimizer.register)."""
+    name = klass.__name__.lower()
+    _OPT_REGISTRY[name] = klass
+    return klass
+
+
+class Optimizer:
+    """Base optimizer (reference optimizer.py:29-277): tracks per-index
+    update counts, lr/wd multipliers resolved from param_idx2name + symbol
+    attrs, gradient rescale/clip, and an optional lr_scheduler."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01,
+                 lr_scheduler=None, sym=None, begin_num_update=0):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        if param_idx2name is None:
+            param_idx2name = {}
+        if not isinstance(param_idx2name, dict):
+            raise MXNetError("param_idx2name should be a dict of param indexes to names.")
+        self.idx2name = param_idx2name.copy()
+        self.sym = sym
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        key = name.lower()
+        if key not in _OPT_REGISTRY:
+            raise MXNetError(f"Cannot find optimizer {name!r}")
+        return _OPT_REGISTRY[key](**kwargs)
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+    # ------------------------------------------------- lr/wd multipliers
+    def set_lr_mult(self, args_lr_mult):
+        """Per-arg lr multipliers; symbol `__lr_mult__` attrs participate
+        (reference optimizer.py:120-140)."""
+        self.lr_mult = {}
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        """wd defaults to 0 for biases/gammas/betas (reference
+        optimizer.py:142-170: every name not ending in _weight/_gamma gets
+        wd_mult 0 unless overridden)."""
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    # -------------------------------------------------------- schedules
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+
+# `mx.optimizer.Optimizer.create_optimizer` alias (reference keeps both)
+create = Optimizer.create_optimizer
+
+
+def _fused(name, inputs, params, n_state):
+    """Run a fused update op; op outputs are (weight', *states'), written
+    in place over weight and the trailing state inputs."""
+    from .ops import registry as _reg
+    from .ndarray import invoke
+
+    opdef = _reg.get(name)
+    targets = [inputs[0]] + (inputs[-n_state:] if n_state else [])
+    return invoke(opdef, inputs, params, out=targets)
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum (reference optimizer.py:279: fused via
+    sgd_update/sgd_mom_update ops)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kwargs = {"lr": lr, "wd": wd, "rescale_grad": self.rescale_grad,
+                  "clip_gradient": self.clip_gradient or -1.0}
+        if state is not None:
+            _fused("sgd_mom_update", [weight, grad, state],
+                   dict(kwargs, momentum=self.momentum), 1)
+        else:
+            _fused("sgd_update", [weight, grad], kwargs, 0)
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (reference optimizer.py:366)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        grad_v = grad._data * self.rescale_grad
+        if self.clip_gradient is not None:
+            import jax.numpy as jnp
+
+            grad_v = jnp.clip(grad_v, -self.clip_gradient, self.clip_gradient)
+        if state is not None:
+            mom = state._data
+            mom = self.momentum * mom + grad_v + wd * weight._data
+            grad_v = grad_v + self.momentum * mom
+            state._set_data(mom)
+            weight._set_data(weight._data - lr * (grad_v + wd * weight._data))
+        else:
+            weight._set_data(
+                weight._data - lr * (grad_v + wd * weight._data)
+            )
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (reference optimizer.py:408):
+    SGD plus gaussian noise scaled by sqrt(lr)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        import jax
+        import jax.numpy as jnp
+
+        from . import random as _random
+
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        noise = jax.random.normal(
+            _random.next_key(), weight.shape, weight._data.dtype
+        ) * math.sqrt(lr)
+        weight._set_data(
+            weight._data - lr / 2 * (g + wd * weight._data) + noise
+        )
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference optimizer.py DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (
+            nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+            weight.copy(),
+        )
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        import jax.numpy as jnp
+
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        mom, previous_weight = state
+        delta = -lr * (
+            g + wd * weight._data
+            + self.lamda * g * g * (weight._data - previous_weight._data)
+        )
+        if mom is not None:
+            m = self.momentum * mom._data + delta
+            mom._set_data(m)
+            delta = m
+        previous_weight._set_data(weight._data)
+        weight._set_data(weight._data + delta)
+
+
+@register
+class Adam(Optimizer):
+    """Adam (reference optimizer.py:451; fused adam_update op). Applies
+    the bias-corrected lr on host, like the reference."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (
+            nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+            nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+        )
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        _fused(
+            "adam_update", [weight, grad, mean, var],
+            {"lr": lr, "beta1": self.beta1, "beta2": self.beta2,
+             "epsilon": self.epsilon, "wd": wd,
+             "rescale_grad": self.rescale_grad,
+             "clip_gradient": self.clip_gradient or -1.0}, 2,
+        )
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (reference optimizer.py:508)."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        import jax.numpy as jnp
+
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        history = state._data + g * g
+        state._set_data(history)
+        weight._set_data(
+            weight._data
+            - lr * (g / jnp.sqrt(history + self.float_stable_eps)
+                    + wd * weight._data)
+        )
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp (reference optimizer.py:536): centered=False → Tieleman &
+    Hinton variant (rmsprop_update); centered=True → Graves variant
+    (rmspropalex_update)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (
+                nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+            )
+        return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kwargs = {"lr": lr, "gamma1": self.gamma1, "epsilon": self.epsilon,
+                  "wd": wd, "rescale_grad": self.rescale_grad,
+                  "clip_gradient": self.clip_gradient or -1.0,
+                  "clip_weights": self.clip_weights or -1.0}
+        if self.centered:
+            n, g, delta = state
+            _fused("rmspropalex_update", [weight, grad, n, g, delta],
+                   dict(kwargs, gamma2=self.gamma2), 3)
+        else:
+            _fused("rmsprop_update", [weight, grad, state], kwargs, 1)
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (reference optimizer.py:601)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (
+            nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+            nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+        )
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        import jax.numpy as jnp
+
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        acc_g, acc_delta = state
+        new_acc_g = self.rho * acc_g._data + (1.0 - self.rho) * g * g
+        current_delta = (
+            jnp.sqrt(acc_delta._data + self.epsilon)
+            / jnp.sqrt(new_acc_g + self.epsilon) * g
+        )
+        new_acc_delta = (
+            self.rho * acc_delta._data
+            + (1.0 - self.rho) * current_delta * current_delta
+        )
+        acc_g._set_data(new_acc_g)
+        acc_delta._set_data(new_acc_delta)
+        weight._set_data(
+            weight._data - current_delta - wd * weight._data
+        )
+
+
+@register
+class Ftrl(Optimizer):
+    """FTRL-proximal (reference optimizer.py:648)."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (
+            nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),  # z
+            nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),  # n
+        )
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        import jax.numpy as jnp
+
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        z, n = state
+        sigma = -jnp.sqrt(n._data)
+        new_n = n._data + g * g
+        sigma = (sigma + jnp.sqrt(new_n)) / lr
+        new_z = z._data + g - sigma * weight._data
+        n._set_data(new_n)
+        z._set_data(new_z)
+        new_w = (
+            (jnp.sign(new_z) * self.lamda1 - new_z)
+            / ((self.beta + jnp.sqrt(new_n)) / lr + wd)
+            * (jnp.abs(new_z) > self.lamda1)
+        )
+        weight._set_data(new_w.astype(weight._data.dtype))
+
+
+@register
+class Test(Optimizer):
+    """Test optimizer: w -= rescale_grad * grad (reference
+    optimizer.py:700)."""
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight._set_data(weight._data - grad._data * self.rescale_grad)
+
+
+# ccSGD in the reference is SGD with a fused C++ kernel; identical math.
+@register
+class CcSGD(SGD):
+    pass
+
+
+_OPT_REGISTRY["ccsgd"] = CcSGD
+
+
+class Updater:
+    """Closure applying `optimizer` per (index, grad, weight) — what
+    KVStore calls server-side (reference optimizer.py:722-754). Lazily
+    creates per-index state and supports state (de)serialization for
+    checkpointing optimizer state."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state(index, weight)
+        self.optimizer.update(index, weight, grad, self.states[index])
+
+    def set_states(self, states):
+        def _to_nd(x):
+            if isinstance(x, np.ndarray):
+                return nd.array(x)
+            if isinstance(x, (tuple, list)):
+                return tuple(_to_nd(i) for i in x)
+            return x
+
+        self.states = {k: _to_nd(v) for k, v in pickle.loads(states).items()}
+
+    def get_states(self):
+        def _to_np(x):
+            if isinstance(x, NDArray):
+                return x.asnumpy()
+            if isinstance(x, (tuple, list)):
+                return tuple(_to_np(i) for i in x)
+            return x
+
+        return pickle.dumps({k: _to_np(v) for k, v in self.states.items()})
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

@@ -1,0 +1,16 @@
+"""Distributed / multi-chip machinery.
+
+The reference's distribution stack (KVStore over ps-lite, §2.5 of
+SURVEY.md) is replaced by mesh-sharded computation: a
+`jax.sharding.Mesh` over the TPU slice, `NamedSharding` layouts on
+parameters/batches, and XLA collectives over ICI/DCN inserted by the
+compiler. This package holds the mesh helpers, the KVStore('tpu')
+facade, and the data-parallel fused train step.
+"""
+from .mesh import (
+    current_mesh,
+    default_mesh,
+    set_mesh,
+    data_parallel_mesh,
+)
+from .kvstore_tpu import KVStoreTPU

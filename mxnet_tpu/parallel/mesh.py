@@ -1,0 +1,70 @@
+"""Device-mesh management.
+
+TPU-native replacement for the reference's device bookkeeping (context
+lists in Module + kvstore device comm). A global mesh is the ambient
+fabric: axes named 'data', 'model', 'seq', 'pipe', 'expert' cover
+DP/TP/SP/PP/EP. Multi-host: jax.distributed supplies the full device
+set; processes see the same global mesh (analog of ps-lite's node
+roster, kvstore_dist.h:35-51, without the server tier).
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+_current_mesh = None
+
+# Canonical axis names, in nesting order.
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
+EXPERT_AXIS = "expert"
+
+
+def data_parallel_mesh(n_devices=None):
+    """1-D mesh over all (or first n) devices with a 'data' axis — the
+    analog of the reference's default multi-device data parallelism
+    (DataParallelExecutorGroup over a context list)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (DATA_AXIS,))
+
+
+def make_mesh(axis_sizes: dict):
+    """Build a mesh from {axis_name: size}; sizes must multiply to a
+    divisor of the device count. E.g. {'data': 2, 'model': 4}."""
+    names = tuple(axis_sizes.keys())
+    sizes = tuple(axis_sizes.values())
+    n = int(np.prod(sizes))
+    devs = np.asarray(jax.devices()[:n]).reshape(sizes)
+    return Mesh(devs, names)
+
+
+def set_mesh(mesh):
+    global _current_mesh
+    _current_mesh = mesh
+
+
+def current_mesh():
+    return _current_mesh
+
+
+def default_mesh():
+    """Current mesh, or a fresh data-parallel mesh over all devices."""
+    global _current_mesh
+    if _current_mesh is None:
+        _current_mesh = data_parallel_mesh()
+    return _current_mesh
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def batch_sharded(mesh, axis=0, mesh_axis=DATA_AXIS):
+    spec = [None] * (axis + 1)
+    spec[axis] = mesh_axis
+    return NamedSharding(mesh, PartitionSpec(*spec))

@@ -1,0 +1,283 @@
+"""RecordIO: magic-delimited binary record files + indexed variant.
+
+Analog of python/mxnet/recordio.py (269 lines) and the dmlc recordio
+format consumed by src/io/iter_image_recordio*.cc. Format kept
+bit-compatible: each record is
+
+  [kMagic:4][lrec:4][data:cflag-encoded][pad to 4]
+
+where lrec's upper 3 bits are the continue-flag (multi-part records for
+payloads containing the magic) and lower 29 bits the length. IRHeader
+(flag, label, id, id2) prefixes packed image records (image_recordio.h).
+"""
+from __future__ import annotations
+
+import ctypes
+import numbers
+import os
+import struct
+
+import numpy as np
+
+from .base import MXNetError
+
+_MAGIC = 0xCED7230A
+_LREC_KMAX = (1 << 29) - 1
+
+
+def _encode_lrec(cflag, length):
+    return (cflag << 29) | length
+
+
+def _dec_flag(lrec):
+    return (lrec >> 29) & 7
+
+
+def _dec_length(lrec):
+    return lrec & _LREC_KMAX
+
+
+class MXRecordIO(object):
+    """Sequential reader/writer (reference recordio.py:14-116)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.handle = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.handle = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.is_open = True
+
+    def __del__(self):
+        self.close()
+
+    def close(self):
+        if self.is_open and self.handle is not None:
+            self.handle.close()
+            self.is_open = False
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def write(self, buf):
+        assert self.writable
+        data = bytes(buf)
+        # split payloads at embedded magics (dmlc recordio contract)
+        magic_bytes = struct.pack("<I", _MAGIC)
+        parts = data.split(magic_bytes)
+        n = len(parts)
+        for i, part in enumerate(parts):
+            if n == 1:
+                cflag = 0
+            elif i == 0:
+                cflag = 1
+            elif i == n - 1:
+                cflag = 3
+            else:
+                cflag = 2
+            self.handle.write(magic_bytes)
+            self.handle.write(struct.pack("<I", _encode_lrec(cflag,
+                                                             len(part))))
+            self.handle.write(part)
+            pad = (4 - (len(part) % 4)) % 4
+            if pad:
+                self.handle.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        data = b""
+        first = True
+        while True:
+            head = self.handle.read(8)
+            if len(head) < 8:
+                if first:
+                    return None
+                raise MXNetError("truncated recordio file")
+            magic, lrec = struct.unpack("<II", head)
+            if magic != _MAGIC:
+                raise MXNetError("invalid record magic")
+            cflag = _dec_flag(lrec)
+            length = _dec_length(lrec)
+            payload = self.handle.read(length)
+            pad = (4 - (length % 4)) % 4
+            if pad:
+                self.handle.read(pad)
+            if first and cflag in (0, 1):
+                data = payload
+            elif cflag in (2, 3):
+                data += struct.pack("<I", _MAGIC) + payload
+            else:
+                raise MXNetError("invalid record continue-flag sequence")
+            first = False
+            if cflag in (0, 3):
+                return data
+
+    def tell(self):
+        return self.handle.tell()
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access reader/writer with a .idx sidecar (reference
+    recordio.py:119-185)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin.readlines():
+                    line = line.strip().split("\t")
+                    key = self.key_type(line[0])
+                    self.idx[key] = int(line[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if self.is_open and self.writable:
+            with open(self.idx_path, "w") as fout:
+                for k in self.keys:
+                    fout.write(f"{k}\t{self.idx[k]}\n")
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        pos = self.idx[idx]
+        self.handle.seek(pos)
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+# IRHeader: flag, label (float or array), id, id2 (reference
+# recordio.py:188-200; C++ image_recordio.h)
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+class IRHeader(object):
+    __slots__ = ("flag", "label", "id", "id2")
+
+    def __init__(self, flag, label, id, id2):  # noqa: A002
+        self.flag = flag
+        self.label = label
+        self.id = id
+        self.id2 = id2
+
+    def __iter__(self):
+        return iter((self.flag, self.label, self.id, self.id2))
+
+    def __eq__(self, other):
+        return tuple(self) == tuple(other)
+
+
+def pack(header, s):
+    """Pack a header + raw bytes into a record payload (reference
+    recordio.py:203-220)."""
+    flag, label, id_, id2 = header
+    label = np.asarray(label, dtype=np.float32)
+    if label.ndim == 0:
+        hdr = struct.pack(_IR_FORMAT, 0, float(label), id_, id2)
+        return hdr + s
+    hdr = struct.pack(_IR_FORMAT, label.size, 0.0, id_, id2)
+    return hdr + label.tobytes() + s
+
+
+def unpack(s):
+    """Unpack a record payload into (IRHeader, bytes) (reference
+    recordio.py:223-240)."""
+    flag, label, id_, id2 = struct.unpack(_IR_FORMAT, s[:_IR_SIZE])
+    s = s[_IR_SIZE:]
+    if flag > 0:
+        label = np.frombuffer(s[: flag * 4], dtype=np.float32)
+        s = s[flag * 4:]
+    header = IRHeader(flag, label, id_, id2)
+    return header, s
+
+
+def unpack_img(s, iscolor=1):
+    """Unpack a packed image record into (IRHeader, ndarray image)
+    (reference recordio.py:243-255)."""
+    header, s = unpack(s)
+    img = _imdecode_np(s, iscolor)
+    return header, img
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Pack an image array (reference recordio.py:258-269)."""
+    encoded = _imencode_np(img, quality=quality, img_fmt=img_fmt)
+    return pack(header, encoded)
+
+
+def _imdecode_np(buf, iscolor=1):
+    try:
+        import cv2
+
+        return cv2.imdecode(np.frombuffer(buf, dtype=np.uint8), iscolor)
+    except ImportError:
+        pass
+    from io import BytesIO
+
+    from PIL import Image
+
+    img = Image.open(BytesIO(buf))
+    if iscolor:
+        img = img.convert("RGB")
+        # match cv2's BGR convention for byte-level parity
+        return np.asarray(img)[:, :, ::-1]
+    return np.asarray(img.convert("L"))
+
+
+def _imencode_np(img, quality=95, img_fmt=".jpg"):
+    try:
+        import cv2
+
+        jpg_formats = [".JPG", ".JPEG"]
+        png_formats = [".PNG"]
+        encode_params = None
+        if img_fmt.upper() in jpg_formats:
+            encode_params = [cv2.IMWRITE_JPEG_QUALITY, quality]
+        elif img_fmt.upper() in png_formats:
+            encode_params = [cv2.IMWRITE_PNG_COMPRESSION, quality]
+        ret, buf = cv2.imencode(img_fmt, img, encode_params)
+        assert ret, "failed to encode image"
+        return buf.tobytes()
+    except ImportError:
+        pass
+    from io import BytesIO
+
+    from PIL import Image
+
+    arr = np.asarray(img)
+    if arr.ndim == 3:
+        arr = arr[:, :, ::-1]  # BGR -> RGB
+    pil = Image.fromarray(arr)
+    bio = BytesIO()
+    fmt = "JPEG" if img_fmt.lower() in (".jpg", ".jpeg") else "PNG"
+    pil.save(bio, format=fmt, quality=quality)
+    return bio.getvalue()

@@ -1,0 +1,98 @@
+"""Initializer tests (model: tests/python/unittest/test_init.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import initializer as init
+
+
+def test_default_init():
+    """Default variable init (reference test_init.py test_default_init)."""
+    data = mx.sym.Variable("data")
+    sym = mx.symbol.LeakyReLU(data=data, act_type="prelu")
+    mod = mx.mod.Module(sym, label_names=None, context=mx.cpu())
+    mod.bind([("data", (10, 10))], None, for_training=False)
+    mod.init_params(initializer=init.One())
+    arg_params, _ = mod.get_params()
+    for v in arg_params.values():
+        np.testing.assert_allclose(v.asnumpy(), 1.0)
+
+
+def test_name_dispatch():
+    ini = init.Xavier()
+    bias = mx.nd.ones((8,))
+    ini("fc1_bias", bias)
+    np.testing.assert_allclose(bias.asnumpy(), 0.0)
+    gamma = mx.nd.zeros((8,))
+    ini("bn_gamma", gamma)
+    np.testing.assert_allclose(gamma.asnumpy(), 1.0)
+    mean = mx.nd.ones((8,))
+    ini("bn_moving_mean", mean)
+    np.testing.assert_allclose(mean.asnumpy(), 0.0)
+    var = mx.nd.zeros((8,))
+    ini("bn_moving_var", var)
+    np.testing.assert_allclose(var.asnumpy(), 1.0)
+
+
+def test_uniform_normal_range():
+    w = mx.nd.zeros((1000,))
+    init.Uniform(0.5)("x_weight", w)
+    a = w.asnumpy()
+    assert a.min() >= -0.5 and a.max() <= 0.5
+    assert abs(a.mean()) < 0.1
+
+    init.Normal(2.0)("x_weight", w)
+    a = w.asnumpy()
+    assert 1.5 < a.std() < 2.5
+
+
+def test_xavier_scale():
+    w = mx.nd.zeros((64, 32))
+    init.Xavier(factor_type="avg", magnitude=3)("x_weight", w)
+    bound = np.sqrt(3.0 / ((64 + 32) / 2))
+    a = w.asnumpy()
+    assert a.min() >= -bound and a.max() <= bound
+
+
+def test_orthogonal():
+    w = mx.nd.zeros((16, 16))
+    init.Orthogonal(scale=1.0)("x_weight", w)
+    a = w.asnumpy()
+    np.testing.assert_allclose(a @ a.T, np.eye(16), atol=1e-4)
+
+
+def test_constant_and_attr_override():
+    """__init__ attr on a Variable overrides the global initializer
+    (reference InitDesc attr dispatch, initializer.py:54)."""
+    ini = init.Xavier()
+    desc = init.InitDesc(
+        "x_weight", attrs={"__init__": init.Constant(7.0).dumps()})
+    w = mx.nd.zeros((4, 4))
+    ini(desc, w)
+    np.testing.assert_allclose(w.asnumpy(), 7.0)
+
+
+def test_mixed_and_load():
+    w1 = mx.nd.zeros((4,))
+    mixed = init.Mixed(
+        [".*bias", ".*"], [init.Constant(1.0), init.Constant(2.0)])
+    mixed("fc_bias", w1)
+    np.testing.assert_allclose(w1.asnumpy(), 1.0)
+    mixed("fc_weight", w1)
+    np.testing.assert_allclose(w1.asnumpy(), 2.0)
+
+    loaded = init.Load(
+        {"arg:fc_weight": mx.nd.ones((4,)) * 3},
+        default_init=init.Constant(9.0))
+    loaded("fc_weight", w1)
+    np.testing.assert_allclose(w1.asnumpy(), 3.0)
+    loaded("other_weight", w1)
+    np.testing.assert_allclose(w1.asnumpy(), 9.0)
+
+
+def test_lstmbias():
+    b = mx.nd.ones((16,))
+    init.LSTMBias(forget_bias=1.0)("lstm_bias", b)
+    a = b.asnumpy()
+    np.testing.assert_allclose(a[:4], 0.0)
+    np.testing.assert_allclose(a[4:8], 1.0)
+    np.testing.assert_allclose(a[8:], 0.0)

@@ -1,0 +1,92 @@
+"""Data iterator tests (model: tests/python/unittest/test_io.py)."""
+import os
+import tempfile
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import (
+    CSVIter,
+    DataBatch,
+    DataDesc,
+    NDArrayIter,
+    PrefetchingIter,
+    ResizeIter,
+)
+
+
+def test_ndarrayiter_basic():
+    data = np.arange(40).reshape(10, 4).astype(np.float32)
+    label = np.arange(10).astype(np.float32)
+    it = NDArrayIter(data, label, batch_size=4, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 4)
+    assert batches[-1].pad == 2
+    # pad wraps around to the start
+    np.testing.assert_allclose(
+        batches[-1].data[0].asnumpy()[-1], data[1])
+
+    it.reset()
+    again = list(it)
+    assert len(again) == 3
+
+
+def test_ndarrayiter_discard_and_shuffle():
+    data = np.arange(30).reshape(10, 3).astype(np.float32)
+    it = NDArrayIter(data, None, batch_size=4, shuffle=True,
+                     last_batch_handle="discard")
+    batches = list(it)
+    assert len(batches) == 2
+    seen = np.concatenate([b.data[0].asnumpy() for b in batches])
+    # all rows came from the original data
+    for row in seen:
+        assert row.tolist() in data.tolist()
+
+
+def test_ndarrayiter_dict_input():
+    it = NDArrayIter(
+        {"a": np.zeros((8, 2)), "b": np.ones((8, 3))},
+        {"l": np.arange(8)}, batch_size=4)
+    assert sorted(d.name for d in it.provide_data) == ["a", "b"]
+    assert [d.name for d in it.provide_label] == ["l"]
+    b = next(it)
+    assert b.data[0].shape in [(4, 2), (4, 3)]
+
+
+def test_resize_iter():
+    data = np.zeros((8, 2), dtype=np.float32)
+    base = NDArrayIter(data, None, batch_size=4)
+    r = ResizeIter(base, size=5)
+    assert len(list(r)) == 5
+
+
+def test_prefetching_iter():
+    data = np.random.rand(16, 2).astype(np.float32)
+    label = np.arange(16).astype(np.float32)
+    base = NDArrayIter(data, label, batch_size=4)
+    pre = PrefetchingIter(base)
+    batches = list(pre)
+    assert len(batches) == 4
+    pre.reset()
+    assert len(list(pre)) == 4
+
+
+def test_csviter():
+    with tempfile.TemporaryDirectory() as d:
+        data_path = os.path.join(d, "data.csv")
+        arr = np.arange(24).reshape(8, 3)
+        np.savetxt(data_path, arr, delimiter=",")
+        it = CSVIter(data_csv=data_path, data_shape=(3,), batch_size=4)
+        batches = list(it)
+        assert len(batches) == 2
+        np.testing.assert_allclose(
+            batches[0].data[0].asnumpy(), arr[:4].astype(np.float32))
+
+
+def test_datadesc():
+    d = DataDesc("x", (32, 3, 224, 224))
+    name, shape = d
+    assert name == "x" and shape == (32, 3, 224, 224)
+    assert DataDesc.get_batch_axis("NCHW") == 0
+    assert DataDesc.get_batch_axis("TNC") == 1

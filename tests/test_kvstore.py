@@ -1,0 +1,99 @@
+"""KVStore tests (model: tests/python/unittest/test_kvstore.py:22-40 —
+init/push/pull arithmetic, list keys, multi-device aggregation)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore as kvs
+
+SHAPE = (4, 4)
+KEYS = [5, 7, 11]
+
+
+def _init_kv(kv_type="local"):
+    kv = kvs.create(kv_type)
+    kv.init(3, mx.nd.zeros(SHAPE))
+    kv.init(KEYS, [mx.nd.zeros(shape=SHAPE)] * len(KEYS))
+    return kv
+
+
+def _check_diff_to_scalar(A, x):
+    assert np.sum(np.abs(A.asnumpy() - x)) == 0, (A.asnumpy(), x)
+
+
+@pytest.mark.parametrize("kv_type", ["local", "device", "tpu"])
+def test_single_kv_pair(kv_type):
+    kv = _init_kv(kv_type)
+    kv.push(3, mx.nd.ones(SHAPE))
+    val = mx.nd.empty(SHAPE)
+    kv.pull(3, out=val)
+    _check_diff_to_scalar(val, 1)
+
+
+def test_list_kv_pair():
+    kv = _init_kv()
+    kv.push(KEYS, [mx.nd.ones(SHAPE) * 4] * len(KEYS))
+    out = [mx.nd.empty(SHAPE)] * len(KEYS)
+    kv.pull(KEYS, out=out)
+    for o in out:
+        _check_diff_to_scalar(o, 4)
+
+
+def test_aggregator():
+    """Multi-device push aggregates (reference test_kvstore.py
+    test_aggregator)."""
+    kv = _init_kv()
+    num_devs = 4
+    devs = [mx.cpu(i) for i in range(num_devs)]
+    vals = [mx.nd.ones(SHAPE, ctx=d) for d in devs]
+    kv.push(3, vals)
+    out = [mx.nd.empty(SHAPE, ctx=d) for d in devs]
+    kv.pull(3, out=out)
+    for o in out:
+        _check_diff_to_scalar(o, num_devs)
+
+
+def test_updater():
+    """Custom updater runs on push (reference test_kvstore.py
+    test_updater)."""
+    kv = _init_kv()
+
+    def updater(key, recv, stored):
+        stored += recv * 2
+
+    kv._set_updater(updater)
+
+    kv.push(3, mx.nd.ones(SHAPE))
+    val = mx.nd.empty(SHAPE)
+    kv.pull(3, out=val)
+    _check_diff_to_scalar(val, 2)
+
+    num_devs = 4
+    vals = [mx.nd.ones(SHAPE, ctx=mx.cpu(i)) for i in range(num_devs)]
+    kv.push(3, vals)
+    kv.pull(3, out=val)
+    _check_diff_to_scalar(val, 2 + 2 * num_devs)
+
+
+def test_get_type():
+    assert kvs.create("local").type == "local"
+    assert kvs.create("tpu").type == "tpu"
+
+
+def test_tpu_kvstore_rank():
+    kv = kvs.create("tpu")
+    assert kv.rank == 0
+    assert kv.num_workers == 1
+    kv._barrier()  # no-op single process
+
+
+def test_optimizer_on_kvstore():
+    kv = _init_kv()
+    from mxnet_tpu import optimizer as opt
+
+    kv.set_optimizer(opt.create("sgd", learning_rate=0.5))
+    kv.push(3, mx.nd.ones(SHAPE))
+    val = mx.nd.empty(SHAPE)
+    kv.pull(3, out=val)
+    # w = 0 - 0.5 * 1
+    _check_diff_to_scalar(val, -0.5)

@@ -1,0 +1,81 @@
+"""Metric tests (model: reference test coverage via test_metric usage in
+tests/python/unittest)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import metric
+
+
+def test_accuracy():
+    m = metric.create("acc")
+    pred = mx.nd.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    label = mx.nd.array([1, 0, 0])
+    m.update([label], [pred])
+    name, value = m.get()
+    assert name == "accuracy"
+    np.testing.assert_allclose(value, 2.0 / 3.0)
+
+
+def test_topk():
+    m = metric.TopKAccuracy(top_k=2)
+    pred = mx.nd.array([[0.5, 0.3, 0.2], [0.1, 0.2, 0.7]])
+    label = mx.nd.array([1, 0])
+    m.update([label], [pred])
+    _, value = m.get()
+    np.testing.assert_allclose(value, 0.5)
+
+
+def test_f1():
+    m = metric.F1()
+    pred = mx.nd.array([[0.2, 0.8], [0.9, 0.1], [0.4, 0.6]])
+    label = mx.nd.array([1, 0, 1])
+    m.update([label], [pred])
+    _, value = m.get()
+    assert 0.99 < value <= 1.0
+
+
+def test_mse_mae_rmse():
+    pred = mx.nd.array([[1.0], [2.0]])
+    label = mx.nd.array([1.5, 2.5])
+    for name, expect in [("mse", 0.25), ("mae", 0.5), ("rmse", 0.5)]:
+        m = metric.create(name)
+        m.update([label], [pred])
+        _, value = m.get()
+        np.testing.assert_allclose(value, expect, rtol=1e-6)
+
+
+def test_perplexity():
+    m = metric.Perplexity(ignore_label=None)
+    pred = mx.nd.array([[0.5, 0.5], [0.9, 0.1]])
+    label = mx.nd.array([0, 0])
+    m.update([label], [pred])
+    _, value = m.get()
+    expected = np.exp(-(np.log(0.5) + np.log(0.9)) / 2)
+    np.testing.assert_allclose(value, expected, rtol=1e-5)
+
+
+def test_cross_entropy():
+    m = metric.CrossEntropy()
+    pred = mx.nd.array([[0.2, 0.8], [0.6, 0.4]])
+    label = mx.nd.array([1, 0])
+    m.update([label], [pred])
+    _, value = m.get()
+    expected = (-np.log(0.8 + 1e-8) - np.log(0.6 + 1e-8)) / 2
+    np.testing.assert_allclose(value, expected, rtol=1e-5)
+
+
+def test_composite_and_custom():
+    comp = metric.create(["acc", "ce"])
+    pred = mx.nd.array([[0.3, 0.7]])
+    label = mx.nd.array([1])
+    comp.update([label], [pred])
+    names, values = comp.get()
+    assert names == ["accuracy", "cross-entropy"]
+
+    def feval(lab, p):
+        return float(np.sum(lab))
+
+    m = metric.np(feval)
+    m.update([label], [pred])
+    _, v = m.get()
+    assert v == 1.0

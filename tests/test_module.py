@@ -1,0 +1,185 @@
+"""Module training-API tests (model: tests/python/unittest/test_module.py
++ test_model_parallel.py's use of two cpu contexts for multi-device)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _make_net():
+    data = mx.sym.Variable("data")
+    fc1 = mx.symbol.FullyConnected(data, name="fc1", num_hidden=16)
+    act = mx.symbol.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = mx.symbol.FullyConnected(act, name="fc2", num_hidden=2)
+    return mx.symbol.SoftmaxOutput(fc2, name="softmax")
+
+
+def _make_data(n=256, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 10).astype(np.float32)
+    y = (X @ rng.randn(10) > 0).astype(np.float32)
+    return X, y
+
+
+def test_module_fit_single_device():
+    X, y = _make_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True)
+    mod = mx.mod.Module(_make_net(), context=mx.cpu())
+    mod.fit(it, num_epoch=8, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5}, eval_metric="acc")
+    score = mod.score(it, "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_module_fit_data_parallel_two_devices():
+    X, y = _make_data(seed=1)
+    it = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True)
+    mod = mx.mod.Module(_make_net(), context=[mx.cpu(0), mx.cpu(1)])
+    mod.fit(it, num_epoch=8, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5}, eval_metric="acc")
+    score = mod.score(it, "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_module_update_on_kvstore():
+    X, y = _make_data(seed=2)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(_make_net(), context=[mx.cpu(0), mx.cpu(1)])
+    mod.fit(it, num_epoch=8, optimizer="adam", kvstore="device",
+            optimizer_params={"learning_rate": 0.01}, eval_metric="acc")
+    score = mod.score(it, "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_module_tpu_kvstore_facade():
+    X, y = _make_data(seed=3)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(_make_net(), context=mx.cpu())
+    mod.fit(it, num_epoch=8, optimizer="sgd", kvstore="tpu",
+            optimizer_params={"learning_rate": 0.5}, eval_metric="acc")
+    score = mod.score(it, "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_module_checkpoint_roundtrip():
+    X, y = _make_data(seed=4)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(_make_net(), context=mx.cpu())
+    mod.fit(it, num_epoch=4, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5})
+    ref = mod.score(it, "acc")[0][1]
+
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "m")
+        mod.save_checkpoint(prefix, 4, save_optimizer_states=True)
+        assert os.path.exists(prefix + "-symbol.json")
+        assert os.path.exists(prefix + "-0004.params")
+        assert os.path.exists(prefix + "-0004.states")
+
+        mod2 = mx.mod.Module.load(prefix, 4)
+        mod2.bind(it.provide_data, it.provide_label, for_training=False)
+        mod2.init_params()
+        got = mod2.score(it, "acc")[0][1]
+        assert abs(got - ref) < 1e-6
+
+
+def test_module_predict_and_outputs():
+    X, y = _make_data(seed=5)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(_make_net(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label, for_training=False)
+    mod.init_params()
+    out = mod.predict(it)
+    assert out.shape == (256, 2)
+    # rows are probabilities
+    np.testing.assert_allclose(out.asnumpy().sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_module_input_grads():
+    X, y = _make_data(seed=6)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(_make_net(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label, for_training=True,
+             inputs_need_grad=True)
+    mod.init_params()
+    mod.init_optimizer()
+    batch = next(it)
+    mod.forward_backward(batch)
+    grads = mod.get_input_grads()
+    assert grads[0].shape == (32, 10)
+    assert np.abs(grads[0].asnumpy()).sum() > 0
+
+
+def test_module_fixed_params():
+    X, y = _make_data(seed=7)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(_make_net(), context=mx.cpu(),
+                        fixed_param_names=["fc1_weight"])
+    mod.bind(it.provide_data, it.provide_label, for_training=True)
+    mod.init_params()
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.5})
+    w_before = mod._exec_group.execs[0].arg_dict["fc1_weight"].asnumpy().copy()
+    batch = next(it)
+    mod.forward_backward(batch)
+    mod.update()
+    w_after = mod._exec_group.execs[0].arg_dict["fc1_weight"].asnumpy()
+    np.testing.assert_allclose(w_before, w_after)
+
+
+def test_bucketing_module():
+    """Buckets share parameters (reference bucketing_module.py:18)."""
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        fc = mx.symbol.FullyConnected(data, name="fc_shared", num_hidden=4)
+        out = mx.symbol.SoftmaxOutput(fc, name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=10,
+                                 context=mx.cpu())
+    from mxnet_tpu.io import DataBatch, DataDesc
+
+    mod.bind([DataDesc("data", (8, 10))], [DataDesc("softmax_label", (8,))])
+    mod.init_params()
+    mod.init_optimizer()
+
+    def make_batch(key):
+        return DataBatch(
+            data=[mx.nd.ones((8, key))],
+            label=[mx.nd.zeros((8,))],
+            bucket_key=key,
+            provide_data=[DataDesc("data", (8, key))],
+            provide_label=[DataDesc("softmax_label", (8,))],
+        )
+
+    # default bucket cannot infer fc weights for other lengths -> each
+    # bucket needs its own shapes but shares fc_shared weights
+    mod.forward(make_batch(10), is_train=True)
+    mod.backward()
+    mod.update()
+    out10 = mod.get_outputs()[0].shape
+    assert out10 == (8, 4)
+
+
+def test_sequential_module():
+    X, y = _make_data(seed=8)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+
+    net1 = mx.symbol.FullyConnected(
+        mx.sym.Variable("data"), name="fc1", num_hidden=8)
+    net2 = mx.symbol.SoftmaxOutput(
+        mx.symbol.FullyConnected(
+            mx.sym.Variable("data"), name="fc2", num_hidden=2),
+        name="softmax")
+
+    mod = mx.mod.SequentialModule()
+    mod.add(mx.mod.Module(net1, label_names=None, context=mx.cpu()))
+    mod.add(mx.mod.Module(net2, context=mx.cpu()), take_labels=True,
+            auto_wiring=True)
+    mod.fit(it, num_epoch=6, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5})
+    score = mod.score(it, "acc")
+    assert score[0][1] > 0.85, score

@@ -1,0 +1,143 @@
+"""Optimizer tests: each optimizer vs a numpy reference implementation
+(model: tests/python/unittest/test_optimizer.py in the reference)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+
+
+def _run_steps(optimizer, w0, grads):
+    w = mx.nd.array(w0.copy())
+    state = optimizer.create_state(0, w)
+    for g in grads:
+        optimizer.update(0, w, mx.nd.array(g), state)
+    return w.asnumpy()
+
+
+def test_sgd_matches_numpy():
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(8, 3).astype(np.float32)
+    grads = [rng.randn(8, 3).astype(np.float32) for _ in range(5)]
+
+    got = _run_steps(opt.create("sgd", learning_rate=0.1, wd=0.01,
+                                rescale_grad=0.5), w0, grads)
+
+    w = w0.copy()
+    for g in grads:
+        w = w - 0.1 * (0.5 * g + 0.01 * w)
+    np.testing.assert_allclose(got, w, rtol=1e-5)
+
+
+def test_sgd_momentum_matches_numpy():
+    rng = np.random.RandomState(1)
+    w0 = rng.randn(10).astype(np.float32)
+    grads = [rng.randn(10).astype(np.float32) for _ in range(5)]
+
+    got = _run_steps(
+        opt.create("sgd", learning_rate=0.1, momentum=0.9), w0, grads)
+
+    w = w0.copy()
+    mom = np.zeros_like(w)
+    for g in grads:
+        mom = 0.9 * mom - 0.1 * g
+        w = w + mom
+    np.testing.assert_allclose(got, w, rtol=1e-5)
+
+
+def test_adam_matches_numpy():
+    rng = np.random.RandomState(2)
+    w0 = rng.randn(6).astype(np.float32)
+    grads = [rng.randn(6).astype(np.float32) for _ in range(4)]
+
+    got = _run_steps(opt.create("adam", learning_rate=0.01), w0, grads)
+
+    w = w0.copy()
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    for t, g in enumerate(grads, 1):
+        lr_t = 0.01 * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        w = w - lr_t * m / (np.sqrt(v) + eps)
+    np.testing.assert_allclose(got, w, rtol=1e-4)
+
+
+def test_rmsprop_matches_numpy():
+    rng = np.random.RandomState(3)
+    w0 = rng.randn(6).astype(np.float32)
+    grads = [rng.randn(6).astype(np.float32) for _ in range(4)]
+
+    got = _run_steps(
+        opt.create("rmsprop", learning_rate=0.01, gamma1=0.9), w0, grads)
+
+    w = w0.copy()
+    n = np.zeros_like(w)
+    for g in grads:
+        n = 0.1 * g * g + 0.9 * n
+        w = w - 0.01 * g / np.sqrt(n + 1e-8)
+    np.testing.assert_allclose(got, w, rtol=1e-4)
+
+
+def test_adagrad_adadelta_ftrl_nag_run():
+    rng = np.random.RandomState(4)
+    w0 = rng.randn(5).astype(np.float32)
+    grads = [rng.randn(5).astype(np.float32) for _ in range(3)]
+    for name in ["adagrad", "adadelta", "ftrl", "nag", "sgld", "dcasgd"]:
+        got = _run_steps(opt.create(name), w0, grads)
+        assert got.shape == w0.shape
+        assert np.all(np.isfinite(got))
+        assert not np.allclose(got, w0), name
+
+
+def test_clip_gradient():
+    w0 = np.zeros(4, dtype=np.float32)
+    grads = [np.asarray([10.0, -10.0, 0.5, -0.5], dtype=np.float32)]
+    got = _run_steps(
+        opt.create("sgd", learning_rate=1.0, clip_gradient=1.0), w0, grads)
+    np.testing.assert_allclose(got, [-1.0, 1.0, -0.5, 0.5], rtol=1e-6)
+
+
+def test_lr_scheduler_factor():
+    from mxnet_tpu.lr_scheduler import FactorScheduler, MultiFactorScheduler
+
+    sched = FactorScheduler(step=10, factor=0.5)
+    sched.base_lr = 1.0
+    assert sched(5) == 1.0
+    assert sched(11) == 0.5
+    assert sched(21) == 0.25
+
+    msched = MultiFactorScheduler(step=[5, 8], factor=0.1)
+    msched.base_lr = 1.0
+    assert msched(3) == 1.0
+    assert abs(msched(6) - 0.1) < 1e-12
+    assert abs(msched(9) - 0.01) < 1e-12
+
+
+def test_lr_wd_mult():
+    optim = opt.create(
+        "sgd", learning_rate=1.0, wd=0.1,
+        param_idx2name={0: "w_weight", 1: "b_bias"})
+    optim.set_lr_mult({"b_bias": 0.0})
+    # bias: zero lr -> no update at all
+    w = mx.nd.ones((2,))
+    b = mx.nd.ones((2,))
+    g = mx.nd.ones((2,))
+    optim.update(1, b, g, optim.create_state(1, b))
+    np.testing.assert_allclose(b.asnumpy(), [1.0, 1.0])
+    # weight: wd applies (wd_mult defaults 1 for *_weight)
+    optim.update(0, w, g, optim.create_state(0, w))
+    np.testing.assert_allclose(w.asnumpy(), 1.0 - 1.0 * (1.0 + 0.1),
+                               rtol=1e-5)
+
+
+def test_updater_state_roundtrip():
+    optim = opt.create("adam", learning_rate=0.1)
+    upd = opt.get_updater(optim)
+    w = mx.nd.ones((3,))
+    upd(0, mx.nd.ones((3,)), w)
+    blob = upd.get_states()
+    upd2 = opt.get_updater(opt.create("adam", learning_rate=0.1))
+    upd2.set_states(blob)
+    assert 0 in upd2.states

@@ -56,7 +56,26 @@ class Executor:
             for n in self._arg_names
             if self._grad_req.get(n, "null") != "null" and n in self.grad_dict
         ]
-        self.outputs = []
+        # Allocate output NDArrays at bind time (the reference GraphExecutor
+        # allocates head entries in InitDataEntryMemory, so exec.outputs is
+        # valid before the first Forward — SequentialModule relies on this).
+        _, out_shapes, _ = symbol.infer_shape(
+            **{n: tuple(a.shape) for n, a in self.arg_dict.items()}
+        )
+        if out_shapes is None:
+            raise MXNetError(
+                f"bind: cannot infer output shapes for {symbol.list_outputs()}"
+            )
+        try:
+            _, out_types, _ = symbol.infer_type()
+        except Exception:
+            out_types = None
+        if not out_types:
+            out_types = [np.float32] * len(out_shapes)
+        self.outputs = [
+            NDArray(jnp.zeros(s, t), ctx=ctx)
+            for s, t in zip(out_shapes, out_types)
+        ]
         self._monitor_callback = None
         self._cached_grads = None
         self._last_inputs = None

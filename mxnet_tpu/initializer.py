@@ -147,14 +147,17 @@ class One(Initializer):
 
 @register
 class Constant(Initializer):
+    """Fill with a constant value unconditionally — unlike Zero/One (which
+    keep the reference's suffix dispatch so a *global* Zero/One initializer
+    still zeroes biases and ones gammas), an explicitly requested Constant
+    has no other sensible meaning for any parameter name."""
+
     def __init__(self, value=0.0):
         super().__init__(value=value)
         self.value = value
 
-    def _init_weight(self, _, arr):
+    def _init_impl(self, _, arr):
         arr[:] = self.value
-
-    _init_default = _init_weight
 
 
 @register

@@ -42,6 +42,9 @@ from . import model
 from . import module
 from . import module as mod
 from . import parallel
+from . import rnn
+from . import operator
+from . import test_utils
 from .callback import Speedometer
 
 __version__ = "0.1.0"

@@ -55,10 +55,14 @@ class Initializer:
     def __call__(self, desc, arr):
         if not isinstance(desc, InitDesc):
             desc = InitDesc(str(desc))
+        if desc.global_init is None:
+            desc.global_init = self
         init = desc.attrs.get("__init__", "")
         if init:
+            # Variable-attached initializer: invoked via _init_weight
+            # regardless of the name suffix (reference initializer.py:76-79)
             klass, kwargs = json.loads(init)
-            create(klass, **kwargs)._init_impl(desc, arr)
+            create(klass, **kwargs)._init_weight(desc, arr)
         else:
             self._init_impl(desc, arr)
 
@@ -156,8 +160,10 @@ class Constant(Initializer):
         super().__init__(value=value)
         self.value = value
 
-    def _init_impl(self, _, arr):
+    def _init_weight(self, _, arr):
         arr[:] = self.value
+
+    _init_impl = _init_weight
 
 
 @register
@@ -314,11 +320,20 @@ class FusedRNN(Initializer):
             self._bidirectional, forget_bias=self._forget_bias,
             prefix="",
         )
-        args = cell.unpack_weights({"parameters": arr.copy()})
+        host = np.array(
+            arr.asnumpy() if hasattr(arr, "asnumpy") else arr, copy=True
+        )
+        args = cell.unpack_weights({"parameters": host})
+        global_init = getattr(desc, "global_init", None)
         for name in args:
-            desc2 = InitDesc(name, getattr(desc, "attrs", {}))
-            if self._init is None:
-                self._init_impl(desc2, args[name])
+            desc2 = InitDesc(name, global_init=global_init)
+            # forget-gate bias gets the configured constant (reference
+            # initializer.py:512-514)
+            if self._mode == "lstm" and name.endswith("_f_bias"):
+                args[name][:] = self._forget_bias
+            elif self._init is None:
+                fallback = global_init or Uniform(0.1)
+                fallback(desc2, args[name])
             else:
                 self._init(desc2, args[name])
         arr[:] = cell.pack_weights(args)["parameters"]

@@ -138,7 +138,9 @@ class NDArray:
         jax.block_until_ready(self._data)
 
     def asnumpy(self):
-        return np.asarray(jax.device_get(self._data))
+        # fresh writable copy, matching the reference's D2H copy semantics
+        # (device_get can return a read-only view of the device buffer)
+        return np.array(jax.device_get(self._data))
 
     def asscalar(self):
         a = self.asnumpy()

@@ -12,3 +12,5 @@ from . import init_sample  # noqa: F401
 from . import ordering  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer_ops  # noqa: F401
+from . import rnn_op  # noqa: F401
+from . import custom  # noqa: F401

@@ -1,0 +1,206 @@
+"""`Custom` op: Python-defined operators inside compiled graphs.
+
+Capability parity with the reference custom-op machinery
+(src/operator/custom/custom-inl.h + python/mxnet/operator.py:396-855):
+a CustomOpProp subclass registered under an op_type string supplies
+list_arguments / list_outputs / infer_shape and a CustomOp whose
+forward/backward run as Python. TPU-native mechanism: the Python
+callbacks execute host-side through `jax.pure_callback` (the analog of
+the reference's kAsync exec type that moves Python callbacks off the
+engine worker, include/mxnet/operator.h:84), and the custom backward is
+wired in with `jax.custom_vjp` so `jax.grad`/Executor backward flow
+through the user's backward() exactly like the reference's engine calls
+the registered backward entry.
+
+Note XLA cannot fuse across a pure_callback: each Custom node is a
+host round-trip. That is the same boundary the reference has (custom
+ops run on the CPU in Python, with device<->host copies around them).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+
+from .registry import register
+from ..base import MXNetError
+
+_PROP_REGISTRY: dict[str, type] = {}
+
+
+def register_prop(reg_name, prop_cls):
+    _PROP_REGISTRY[reg_name] = prop_cls
+
+
+def get_prop_cls(reg_name):
+    try:
+        return _PROP_REGISTRY[reg_name]
+    except KeyError:
+        raise MXNetError(
+            f"unknown custom op type {reg_name!r}; register a "
+            "CustomOpProp with mx.operator.register first"
+        ) from None
+
+
+@functools.lru_cache(maxsize=None)
+def _make_prop(op_type, kwargs_items):
+    cls = get_prop_cls(op_type)
+    prop = cls(**dict(kwargs_items))
+    prop._op_type = op_type
+    prop._kwargs = dict(kwargs_items)
+    return prop
+
+
+def _prop_from_params(params):
+    kwargs = {
+        k: v for k, v in params.items() if k != "op_type"
+    }
+    return _make_prop(
+        params["op_type"], tuple(sorted(kwargs.items()))
+    )
+
+
+def _custom_arg_names(params):
+    return list(_prop_from_params(params).list_arguments())
+
+
+def _custom_num_outputs(params):
+    return len(_prop_from_params(params).list_outputs())
+
+
+def custom_fn(*inputs, rng=None, is_train=False, **params):
+    """Trace-time body of the Custom op."""
+    prop = _prop_from_params(params)
+    if prop.list_auxiliary_states():
+        raise MXNetError(
+            "Custom ops with auxiliary states are not supported yet"
+        )
+    n_out = len(prop.list_outputs())
+    in_shapes = [tuple(x.shape) for x in inputs]
+    in_shapes2, out_shapes, _ = _infer_shapes(prop, in_shapes)
+    in_dtypes = [x.dtype for x in inputs]
+    types = prop.infer_type([np.dtype(d) for d in in_dtypes])
+    out_dtypes = list(types[1])
+    out_structs = [
+        jax.ShapeDtypeStruct(s, d)
+        for s, d in zip(out_shapes, out_dtypes)
+    ]
+    train_flag = bool(is_train)
+
+    def _new_op():
+        from ..context import cpu
+
+        return prop.create_operator(cpu(), in_shapes, in_dtypes)
+
+    def fwd_callback(*xs):
+        from ..ndarray import NDArray, array
+
+        op = _new_op()
+        in_data = [array(np.asarray(x)) for x in xs]
+        out_data = [
+            array(np.zeros(s, d))
+            for s, d in zip(out_shapes, out_dtypes)
+        ]
+        op.forward(
+            is_train=train_flag,
+            req=["write"] * len(out_data),
+            in_data=in_data,
+            out_data=out_data,
+            aux=[],
+        )
+        return tuple(
+            np.asarray(o.asnumpy(), dtype=d)
+            for o, d in zip(out_data, out_dtypes)
+        )
+
+    @jax.custom_vjp
+    def f(*ins):
+        out = jax.pure_callback(fwd_callback, tuple(out_structs), *ins)
+        return tuple(out)
+
+    def f_fwd(*ins):
+        out = f(*ins)
+        return out, (ins, out)
+
+    def f_bwd(res, gs):
+        ins, outs = res
+        in_structs = tuple(
+            jax.ShapeDtypeStruct(tuple(x.shape), x.dtype) for x in ins
+        )
+
+        def bwd_callback(*flat):
+            from ..ndarray import array
+
+            n_g = n_out
+            n_i = len(ins)
+            out_grad = [array(np.asarray(x)) for x in flat[:n_g]]
+            in_data = [
+                array(np.asarray(x)) for x in flat[n_g: n_g + n_i]
+            ]
+            out_data = [array(np.asarray(x)) for x in flat[n_g + n_i:]]
+            op = _new_op()
+            in_grad = [
+                array(np.zeros(tuple(x.shape),
+                               np.dtype(x.dtype)))
+                for x in in_data
+            ]
+            op.backward(
+                req=["write"] * len(in_grad),
+                out_grad=out_grad,
+                in_data=in_data,
+                out_data=out_data,
+                in_grad=in_grad,
+                aux=[],
+            )
+            return tuple(
+                np.asarray(g.asnumpy(), dtype=x.dtype)
+                for g, x in zip(in_grad, ins)
+            )
+
+        grads = jax.pure_callback(
+            bwd_callback, in_structs, *gs, *ins, *outs
+        )
+        return tuple(grads)
+
+    f.defvjp(f_fwd, f_bwd)
+    out = f(*inputs)
+    return out if n_out > 1 else out[0]
+
+
+def _infer_shapes(prop, in_shapes):
+    """Normalize prop.infer_shape's 2- or 3-tuple return."""
+    ret = prop.infer_shape(list(in_shapes))
+    if len(ret) == 2:
+        ins, outs = ret
+        auxs = []
+    else:
+        ins, outs, auxs = ret
+    return (
+        [tuple(s) for s in ins],
+        [tuple(s) for s in outs],
+        [tuple(s) for s in auxs],
+    )
+
+
+register(
+    "Custom",
+    arg_names=None,
+    arg_names_fn=_custom_arg_names,
+    num_outputs_fn=_custom_num_outputs,
+    needs_rng=True,
+    needs_mode=True,
+)(custom_fn)
+
+
+# shape-infer rule: let the prop fill unknown input shapes (the reference
+# calls CustomOpProp.infer_shape from the InferShape pass)
+from . import shape_infer as _shape_infer
+
+
+@_shape_infer.rule("Custom")
+def _custom_rule(params, ins):
+    prop = _prop_from_params(params)
+    known = [s for s in ins]
+    new_ins, _, _ = _infer_shapes(prop, known)
+    return list(new_ins), None

@@ -49,6 +49,9 @@ class OpDef:
     # Which num_outputs to expose when params are known (e.g. SliceChannel's
     # num_outputs depends on its params); callable(params)->int.
     num_outputs_fn: Optional[Callable] = None
+    # Param-dependent input names (e.g. Custom's depend on op_type);
+    # callable(params)->list[str]. Overrides arg_names when set.
+    arg_names_fn: Optional[Callable] = None
     # Optional list of input names whose gradient is always zero
     # (e.g. labels); purely informational for executors.
     no_grad_inputs: Sequence[str] = ()
@@ -83,6 +86,7 @@ def register(
     aliases=(),
     num_outputs_fn=None,
     no_grad_inputs=(),
+    arg_names_fn=None,
 ):
     """Decorator registering a jax function as a framework op."""
 
@@ -100,6 +104,7 @@ def register(
             aliases=tuple(aliases),
             num_outputs_fn=num_outputs_fn,
             no_grad_inputs=tuple(no_grad_inputs),
+            arg_names_fn=arg_names_fn,
         )
         if name in _REGISTRY:
             raise MXNetError(f"op {name!r} registered twice")

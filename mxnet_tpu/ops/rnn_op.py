@@ -1,0 +1,209 @@
+"""Fused multi-layer RNN op — the cuDNN-RNN analog, TPU-style.
+
+Capability parity with the reference `RNN` op (src/operator/rnn-inl.h:71-93
+RNNParam; real implementation src/operator/cudnn_rnn-inl.h:127-267 —
+LSTM/GRU/vanilla via cuDNN with a single packed parameter blob). The
+TPU-native design:
+
+- The whole sequence's input projections run as ONE batched matmul per
+  layer/direction ((T*N, in) @ (in, G*H)) — large, MXU-shaped work —
+  BEFORE the time loop, so the `lax.scan` body only carries the (N, H) @
+  (H, G*H) recurrent matmul. This is the standard XLA RNN recipe; there
+  is no cuDNN "fused kernel" to call, the fusion IS the scan + XLA.
+- Parameters live in one flat vector with the same conceptual layout as
+  cuDNN's packed blob (all weights layer-major/direction-inner, then all
+  biases): `param_layout()` below is shared with
+  rnn/rnn_cell.py:FusedRNNCell.unpack_weights/pack_weights so the fused
+  ⇄ unfused conversion is consistent by construction.
+- Bidirectional = scan the time-reversed sequence and flip the result;
+  inter-layer dropout (cuDNN semantics: between layers only) uses the
+  op-level rng.
+
+Gate orders match the reference FusedRNNCell gate names
+(python/mxnet/rnn/rnn_cell.py: lstm [i f c o], gru [r z o]).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+from ..base import MXNetError, coerce_bool, coerce_float, coerce_int
+
+MODE_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def param_layout(input_size, state_size, num_layers, bidirectional, mode):
+    """Flat-parameter layout: list of (kind, layer, dir, part) -> (offset,
+    shape), plus total size. kind in {'w','b'}, part in {'i2h','h2h'}.
+
+    Layout rule (mirrors cuDNN packing, cudnn_rnn-inl.h): all weight
+    matrices first — layer-major, direction-inner, i2h before h2h — then
+    all bias vectors in the same order.
+    """
+    h = state_size
+    gh = MODE_GATES[mode] * h
+    dirs = 2 if bidirectional else 1
+    entries = {}
+    off = 0
+    for layer in range(num_layers):
+        in_size = input_size if layer == 0 else h * dirs
+        for d in range(dirs):
+            entries[("w", layer, d, "i2h")] = (off, (gh, in_size))
+            off += gh * in_size
+            entries[("w", layer, d, "h2h")] = (off, (gh, h))
+            off += gh * h
+    for layer in range(num_layers):
+        for d in range(dirs):
+            entries[("b", layer, d, "i2h")] = (off, (gh,))
+            off += gh
+            entries[("b", layer, d, "h2h")] = (off, (gh,))
+            off += gh
+    return entries, off
+
+
+def rnn_param_size(input_size, state_size, num_layers=1,
+                   bidirectional=False, mode="lstm"):
+    """Total flat parameter count (reference FusedRNNCell weight size)."""
+    return param_layout(
+        input_size, state_size, num_layers, bidirectional, mode)[1]
+
+
+def _layer_scan(x, h0, c0, w_hh, b_hh, mode):
+    """Scan one direction of one layer. x: (T, N, G*H) pre-projected
+    inputs (i2h matmul + i2h bias already applied)."""
+    if mode == "lstm":
+        def step(carry, xt):
+            hprev, cprev = carry
+            g = xt + hprev @ w_hh.T + b_hh
+            i, f, c, o = jnp.split(g, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            f = jax.nn.sigmoid(f)
+            c = jnp.tanh(c)
+            o = jax.nn.sigmoid(o)
+            cnext = f * cprev + i * c
+            hnext = o * jnp.tanh(cnext)
+            return (hnext, cnext), hnext
+
+        (hf, cf), ys = lax.scan(step, (h0, c0), x)
+        return ys, hf, cf
+    if mode == "gru":
+        def step(hprev, xt):
+            hp = hprev @ w_hh.T + b_hh
+            xr, xz, xn = jnp.split(xt, 3, axis=-1)
+            hr, hz, hn = jnp.split(hp, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            hnext = (1.0 - z) * n + z * hprev
+            return hnext, hnext
+
+        hf, ys = lax.scan(step, h0, x)
+        return ys, hf, None
+    act = jnp.tanh if mode == "rnn_tanh" else (lambda v: jnp.maximum(v, 0))
+
+    def step(hprev, xt):
+        hnext = act(xt + hprev @ w_hh.T + b_hh)
+        return hnext, hnext
+
+    hf, ys = lax.scan(step, h0, x)
+    return ys, hf, None
+
+
+@register(
+    "RNN",
+    arg_names=["data", "parameters", "state", "state_cell"],
+    coerce={
+        "state_size": coerce_int,
+        "num_layers": coerce_int,
+        "bidirectional": coerce_bool,
+        "p": coerce_float,
+        "state_outputs": coerce_bool,
+        "lstm_state_clip_nan": coerce_bool,
+    },
+    defaults={
+        "num_layers": 1,
+        "bidirectional": False,
+        "p": 0.0,
+        "state_outputs": False,
+    },
+    needs_rng=True,
+    needs_mode=True,
+    num_outputs_fn=lambda p: (
+        1 if not p.get("state_outputs")
+        else (3 if p.get("mode") == "lstm" else 2)
+    ),
+)
+def rnn(data, parameters, state, state_cell=None, *, state_size, mode,
+        num_layers=1, bidirectional=False, p=0.0, state_outputs=False,
+        rng=None, is_train=False, **_ignored):
+    """data: (T, N, input) TNC; parameters: flat 1-D blob (param_layout);
+    state: (L*dirs, N, H) initial hidden; state_cell: same (lstm only).
+    Returns output (T, N, H*dirs) [, final state [, final cell]]."""
+    if mode not in MODE_GATES:
+        raise MXNetError(f"RNN: unknown mode {mode!r}")
+    t, n, input_size = data.shape
+    h = state_size
+    dirs = 2 if bidirectional else 1
+    entries, total = param_layout(
+        input_size, h, num_layers, bidirectional, mode)
+    if parameters.shape != (total,):
+        raise MXNetError(
+            f"RNN: parameters must have shape ({total},) for "
+            f"input_size={input_size} state_size={h} num_layers="
+            f"{num_layers} mode={mode!r} bidirectional={bidirectional}; "
+            f"got {parameters.shape}"
+        )
+
+    # begin_state() defaults are zeros with batch dim 1 (forward-only shape
+    # inference can't resolve the reference's 0-as-unknown); broadcast here.
+    full = (num_layers * dirs, n, h)
+    if state.shape != full:
+        state = jnp.broadcast_to(state, full)
+    if mode == "lstm" and state_cell.shape != full:
+        state_cell = jnp.broadcast_to(state_cell, full)
+
+    def par(key):
+        off, shape = entries[key]
+        size = 1
+        for s in shape:
+            size *= s
+        return parameters[off: off + size].reshape(shape)
+
+    x = data
+    finals_h, finals_c = [], []
+    for layer in range(num_layers):
+        outs = []
+        for d in range(dirs):
+            w_ih = par(("w", layer, d, "i2h"))
+            w_hh = par(("w", layer, d, "h2h"))
+            b_ih = par(("b", layer, d, "i2h"))
+            b_hh = par(("b", layer, d, "h2h"))
+            sidx = layer * dirs + d
+            h0 = state[sidx]
+            c0 = state_cell[sidx] if mode == "lstm" else None
+            xd = x[::-1] if d == 1 else x
+            # one big MXU matmul for the whole sequence's input projection
+            xp = xd @ w_ih.T + b_ih
+            ys, hf, cf = _layer_scan(xp, h0, c0, w_hh, b_hh, mode)
+            if d == 1:
+                ys = ys[::-1]
+            outs.append(ys)
+            finals_h.append(hf)
+            if mode == "lstm":
+                finals_c.append(cf)
+        x = outs[0] if dirs == 1 else jnp.concatenate(outs, axis=-1)
+        if is_train and p > 0.0 and layer < num_layers - 1:
+            keep = 1.0 - p
+            mask = jax.random.bernoulli(
+                jax.random.fold_in(rng, layer), keep, x.shape)
+            x = jnp.where(mask, x / keep, 0.0)
+
+    if not state_outputs:
+        return x
+    hn = jnp.stack(finals_h, axis=0)
+    if mode == "lstm":
+        cn = jnp.stack(finals_c, axis=0)
+        return x, hn, cn
+    return x, hn

@@ -207,6 +207,27 @@ for _n in (
     _RULES[_n] = _label_rule(True)
 
 
+@rule("RNN")
+def _rnn(params, ins):
+    from .rnn_op import rnn_param_size
+
+    mode = params["mode"]
+    h = int(params["state_size"])
+    num_layers = int(params.get("num_layers", 1))
+    bidir = bool(params.get("bidirectional", False))
+    dirs = 2 if bidir else 1
+    data = ins[0]
+    if data is None:
+        raise MXNetError("RNN: data shape required")
+    t, n, input_size = data
+    size = rnn_param_size(input_size, h, num_layers, bidir, mode)
+    out = [data, ins[1] or (size,), ins[2] or (num_layers * dirs, n, h)]
+    if mode == "lstm":
+        cell = ins[3] if len(ins) > 3 and ins[3] else (num_layers * dirs, n, h)
+        out.append(cell)
+    return out, None
+
+
 @rule("softmax_cross_entropy")
 def _sce(params, ins):
     data, label = (ins + [None] * 2)[:2]

@@ -1,0 +1,22 @@
+"""Symbolic RNN cell library (capability parity with the reference
+python/mxnet/rnn/: rnn_cell.py cells, io.py BucketSentenceIter, rnn.py
+checkpoint helpers)."""
+from .rnn_cell import (
+    RNNParams,
+    BaseRNNCell,
+    RNNCell,
+    LSTMCell,
+    GRUCell,
+    FusedRNNCell,
+    SequentialRNNCell,
+    BidirectionalCell,
+    ModifierCell,
+    DropoutCell,
+    ZoneoutCell,
+)
+from .io import BucketSentenceIter, encode_sentences
+from .rnn import (
+    save_rnn_checkpoint,
+    load_rnn_checkpoint,
+    do_rnn_checkpoint,
+)

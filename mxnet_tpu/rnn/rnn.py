@@ -1,0 +1,48 @@
+"""RNN checkpoint helpers (reference python/mxnet/rnn/rnn.py):
+save/load model checkpoints with cell weights unpacked into the
+canonical (unfused, per-gate) layout so fused and unfused models are
+checkpoint-compatible."""
+from __future__ import annotations
+
+from .. import model as _model
+from .. import ndarray as nd
+
+
+def _as_cell_list(cells):
+    return cells if isinstance(cells, (list, tuple)) else [cells]
+
+
+def save_rnn_checkpoint(cells, prefix, epoch, symbol, arg_params,
+                        aux_params):
+    """Save a checkpoint with RNN weights unpacked (reference
+    rnn/rnn.py save_rnn_checkpoint)."""
+    host = {k: v.asnumpy() if hasattr(v, "asnumpy") else v
+            for k, v in arg_params.items()}
+    for cell in _as_cell_list(cells):
+        host = cell.unpack_weights(host)
+    arg_np = {k: nd.array(v) for k, v in host.items()}
+    _model.save_checkpoint(prefix, epoch, symbol, arg_np, aux_params)
+
+
+def load_rnn_checkpoint(cells, prefix, epoch):
+    """Load a checkpoint and pack RNN weights for the given cells
+    (reference rnn/rnn.py load_rnn_checkpoint)."""
+    sym, arg, aux = _model.load_checkpoint(prefix, epoch)
+    host = {k: v.asnumpy() if hasattr(v, "asnumpy") else v
+            for k, v in arg.items()}
+    for cell in _as_cell_list(cells):
+        host = cell.pack_weights(host)
+    arg = {k: nd.array(v) for k, v in host.items()}
+    return sym, arg, aux
+
+
+def do_rnn_checkpoint(cells, prefix, period=1):
+    """Epoch-end callback doing save_rnn_checkpoint (reference
+    rnn/rnn.py do_rnn_checkpoint)."""
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            save_rnn_checkpoint(cells, prefix, iter_no + 1, sym, arg, aux)
+
+    return _callback

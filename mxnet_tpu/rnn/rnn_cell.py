@@ -1,0 +1,813 @@
+"""Symbolic RNN cells.
+
+Capability parity with the reference cell library
+(python/mxnet/rnn/rnn_cell.py:317-881): RNNCell / LSTMCell / GRUCell
+build one-timestep symbolic graphs that `unroll` chains over time;
+FusedRNNCell emits the fused `RNN` op (the cuDNN-RNN analog — here a
+`lax.scan` whose per-layer input projections are single MXU matmuls, see
+ops/rnn_op.py) and converts to/from the unfused layout with
+unpack_weights / pack_weights; Sequential / Bidirectional / Dropout /
+Zoneout compose cells.
+
+TPU-native deviation from the reference: `begin_state` default zero
+states use batch dimension **1** (broadcast at use) instead of the
+reference's 0-meaning-unknown, because shape inference here is forward
+only (jax.eval_shape) — broadcasting a constant initial state is exact,
+and a user-supplied begin_state with a real batch dimension is passed
+through untouched.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import symbol
+from ..base import MXNetError
+from ..ops.rnn_op import MODE_GATES, param_layout, rnn_param_size
+
+
+class RNNParams(object):
+    """Container for cell parameters; get() memoizes Variables by name
+    (reference rnn_cell.py RNNParams)."""
+
+    def __init__(self, prefix=""):
+        self._prefix = prefix
+        self._params = {}
+
+    def get(self, name, **kwargs):
+        name = self._prefix + name
+        if name not in self._params:
+            self._params[name] = symbol.Variable(name, **kwargs)
+        return self._params[name]
+
+
+class BaseRNNCell(object):
+    """Abstract cell: __call__(inputs, states) -> (output, states)."""
+
+    def __init__(self, prefix="", params=None):
+        if params is None:
+            params = RNNParams(prefix)
+            self._own_params = True
+        else:
+            self._own_params = False
+        self._prefix = prefix
+        self._params = params
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self._params
+
+    @property
+    def state_info(self):
+        """List of dicts describing each state: {'shape': ..., '__layout__': ...}."""
+        raise NotImplementedError
+
+    @property
+    def state_shape(self):
+        return [info["shape"] for info in self.state_info]
+
+    @property
+    def _gate_names(self):
+        return ("",)
+
+    def begin_state(self, func=None, **kwargs):
+        """Initial states; default zeros with broadcastable batch dim 1."""
+        assert not self._modified, (
+            "After applying modifier cells (e.g. DropoutCell) the base "
+            "cell cannot be called directly. Call the modifier cell instead."
+        )
+        if func is None:
+            func = symbol.zeros
+        states = []
+        for info in self.state_info:
+            self._init_counter += 1
+            name = f"{self._prefix}begin_state_{self._init_counter}"
+            state = func(name=name, shape=info["shape"], **kwargs)
+            states.append(state)
+        return states
+
+    # ----------------------------------------------- fused<->unfused weights
+    def unpack_weights(self, args):
+        """Split gate-concatenated weights into per-gate entries
+        (reference rnn_cell.py unpack_weights)."""
+        args = dict(args)
+        h = self._num_hidden
+        for group_name in ("i2h", "h2h"):
+            for t in ("weight", "bias"):
+                name = f"{self._prefix}{group_name}_{t}"
+                if name not in args:
+                    continue
+                arr = args.pop(name)
+                for i, gate in enumerate(self._gate_names):
+                    args[f"{self._prefix}{group_name}{gate}_{t}"] = (
+                        arr[i * h: (i + 1) * h].copy()
+                    )
+        return args
+
+    def pack_weights(self, args):
+        args = dict(args)
+        for group_name in ("i2h", "h2h"):
+            for t in ("weight", "bias"):
+                gates = [
+                    f"{self._prefix}{group_name}{gate}_{t}"
+                    for gate in self._gate_names
+                ]
+                if not all(g in args for g in gates):
+                    continue
+                args[f"{self._prefix}{group_name}_{t}"] = np.concatenate(
+                    [np.asarray(args.pop(g)) for g in gates]
+                )
+        return args
+
+    # ------------------------------------------------------------- unroll
+    def unroll(self, length, inputs=None, begin_state=None,
+               input_prefix="", layout="NTC", merge_outputs=None):
+        """Unroll the cell `length` steps (reference rnn_cell.py:254).
+
+        inputs: None (auto Variables t%d_data), a list of per-step
+        symbols, or one symbol with a time axis per `layout`.
+        Returns (outputs, final_states); outputs is a list unless
+        merge_outputs=True (then one symbol with the same layout).
+        """
+        self.reset()
+        axis = layout.find("T")
+        if inputs is None:
+            inputs = [
+                symbol.Variable(f"{input_prefix}t{i}_data")
+                for i in range(length)
+            ]
+        elif isinstance(inputs, symbol.Symbol):
+            assert len(inputs.list_outputs()) == 1, (
+                "unroll doesn't allow grouped symbol as input"
+            )
+            inputs = symbol.SliceChannel(
+                inputs, axis=axis, num_outputs=length, squeeze_axis=1
+            )
+            inputs = [inputs[i] for i in range(length)]
+        else:
+            assert len(inputs) == length
+        if begin_state is None:
+            begin_state = self.begin_state()
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            output, states = self(inputs[i], states)
+            outputs.append(output)
+        if merge_outputs:
+            outputs = [
+                symbol.expand_dims(o, axis=axis) for o in outputs
+            ]
+            outputs = symbol.Concat(*outputs, dim=axis)
+        return outputs, states
+
+    # ------------------------------------------------------------ helpers
+    def _get_activation(self, inputs, activation, **kwargs):
+        if isinstance(activation, str):
+            return symbol.Activation(inputs, act_type=activation, **kwargs)
+        return activation(inputs, **kwargs)
+
+
+class RNNCell(BaseRNNCell):
+    """Vanilla RNN: h' = act(W_i2h x + b + W_h2h h + b) (reference
+    rnn_cell.py RNNCell)."""
+
+    def __init__(self, num_hidden, activation="tanh", prefix="rnn_",
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._activation = activation
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (1, self._num_hidden), "__layout__": "NC"}]
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = f"{self._prefix}t{self._counter}_"
+        i2h = symbol.FullyConnected(
+            data=inputs, weight=self._iW, bias=self._iB,
+            num_hidden=self._num_hidden, name=f"{name}i2h",
+        )
+        h2h = symbol.FullyConnected(
+            data=states[0], weight=self._hW, bias=self._hB,
+            num_hidden=self._num_hidden, name=f"{name}h2h",
+        )
+        output = self._get_activation(
+            i2h + h2h, self._activation, name=f"{name}out"
+        )
+        return output, [output]
+
+
+class LSTMCell(BaseRNNCell):
+    """LSTM cell; gate order [i f c o] matches the fused layout
+    (reference rnn_cell.py LSTMCell; gate order rnn_cell.py:497)."""
+
+    def __init__(self, num_hidden, prefix="lstm_", params=None,
+                 forget_bias=1.0):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._iW = self.params.get("i2h_weight")
+        self._hW = self.params.get("h2h_weight")
+        from .. import initializer as init
+
+        self._iB = self.params.get(
+            "i2h_bias", init=init.LSTMBias(forget_bias=forget_bias)
+        )
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [
+            {"shape": (1, self._num_hidden), "__layout__": "NC"},
+            {"shape": (1, self._num_hidden), "__layout__": "NC"},
+        ]
+
+    @property
+    def _gate_names(self):
+        return ("_i", "_f", "_c", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = f"{self._prefix}t{self._counter}_"
+        i2h = symbol.FullyConnected(
+            data=inputs, weight=self._iW, bias=self._iB,
+            num_hidden=self._num_hidden * 4, name=f"{name}i2h",
+        )
+        h2h = symbol.FullyConnected(
+            data=states[0], weight=self._hW, bias=self._hB,
+            num_hidden=self._num_hidden * 4, name=f"{name}h2h",
+        )
+        gates = i2h + h2h
+        slice_gates = symbol.SliceChannel(
+            gates, num_outputs=4, axis=1, name=f"{name}slice"
+        )
+        in_gate = symbol.Activation(slice_gates[0], act_type="sigmoid")
+        forget_gate = symbol.Activation(slice_gates[1], act_type="sigmoid")
+        in_transform = symbol.Activation(slice_gates[2], act_type="tanh")
+        out_gate = symbol.Activation(slice_gates[3], act_type="sigmoid")
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * symbol.Activation(next_c, act_type="tanh")
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(BaseRNNCell):
+    """GRU cell; gate order [r z o] matches the fused layout (reference
+    rnn_cell.py GRUCell)."""
+
+    def __init__(self, num_hidden, prefix="gru_", params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (1, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("_r", "_z", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = f"{self._prefix}t{self._counter}_"
+        prev_state_h = states[0]
+        i2h = symbol.FullyConnected(
+            data=inputs, weight=self._iW, bias=self._iB,
+            num_hidden=self._num_hidden * 3, name=f"{name}i2h",
+        )
+        h2h = symbol.FullyConnected(
+            data=prev_state_h, weight=self._hW, bias=self._hB,
+            num_hidden=self._num_hidden * 3, name=f"{name}h2h",
+        )
+        i2h_r, i2h_z, i2h = symbol.SliceChannel(
+            i2h, num_outputs=3, axis=1, name=f"{name}i2h_slice"
+        )
+        h2h_r, h2h_z, h2h = symbol.SliceChannel(
+            h2h, num_outputs=3, axis=1, name=f"{name}h2h_slice"
+        )
+        reset_gate = symbol.Activation(
+            i2h_r + h2h_r, act_type="sigmoid", name=f"{name}r_act"
+        )
+        update_gate = symbol.Activation(
+            i2h_z + h2h_z, act_type="sigmoid", name=f"{name}z_act"
+        )
+        next_h_tmp = symbol.Activation(
+            i2h + reset_gate * h2h, act_type="tanh", name=f"{name}h_act"
+        )
+        next_h = (1.0 - update_gate) * next_h_tmp + update_gate * prev_state_h
+        return next_h, [next_h]
+
+
+class FusedRNNCell(BaseRNNCell):
+    """Multi-layer fused RNN over the `RNN` op (reference rnn_cell.py
+    FusedRNNCell, which maps to cuDNN; here the op is a lax.scan — see
+    ops/rnn_op.py). Only usable via unroll()."""
+
+    def __init__(self, num_hidden, num_layers=1, mode="lstm",
+                 bidirectional=False, dropout=0.0, get_next_state=False,
+                 forget_bias=1.0, prefix=None, params=None):
+        if prefix is None:
+            prefix = f"{mode}_"
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._dropout = dropout
+        self._get_next_state = get_next_state
+        self._forget_bias = forget_bias
+        if mode not in MODE_GATES:
+            raise MXNetError(f"unknown RNN mode {mode!r}")
+        initializer = None
+        if mode == "lstm":
+            from .. import initializer as init
+
+            initializer = init.FusedRNN(
+                None, num_hidden, num_layers, mode, bidirectional,
+                forget_bias,
+            )
+        self._parameter = self.params.get("parameters", init=initializer)
+        self._directions = (
+            ["l", "r"] if bidirectional else ["l"]
+        )
+
+    @property
+    def state_info(self):
+        b = self._num_layers * (2 if self._bidirectional else 1)
+        n = (
+            [
+                {"shape": (b, 1, self._num_hidden), "__layout__": "LNC"},
+                {"shape": (b, 1, self._num_hidden), "__layout__": "LNC"},
+            ]
+            if self._mode == "lstm"
+            else [{"shape": (b, 1, self._num_hidden), "__layout__": "LNC"}]
+        )
+        return n
+
+    @property
+    def _gate_names(self):
+        return {
+            "rnn_relu": ("",),
+            "rnn_tanh": ("",),
+            "lstm": ("_i", "_f", "_c", "_o"),
+            "gru": ("_r", "_z", "_o"),
+        }[self._mode]
+
+    @property
+    def _num_gates(self):
+        return len(self._gate_names)
+
+    def _input_size_from_total(self, total):
+        """Solve the layer-0 input size from the flat blob length."""
+        h = self._num_hidden
+        g = self._num_gates
+        L = self._num_layers
+        dirs = 2 if self._bidirectional else 1
+        # total = dirs*g*h*(I + h) + (L-1)*dirs*g*h*(h*dirs + h) + 2*g*h*L*dirs
+        rest = (
+            (L - 1) * dirs * g * h * (h * dirs + h)
+            + 2 * g * h * L * dirs
+            + dirs * g * h * h
+        )
+        rem = total - rest
+        assert rem % (dirs * g * h) == 0, (
+            f"invalid fused parameter size {total}"
+        )
+        return rem // (dirs * g * h)
+
+    def unpack_weights(self, args):
+        """Flat blob -> per-gate numpy arrays named
+        {prefix}{l|r}{layer}_{i2h,h2h}{gate}_{weight,bias} — the same
+        naming the equivalent unfuse()d cell stack uses after its own
+        unpack_weights, so fused and unfused parameters interconvert
+        (reference rnn_cell.py FusedRNNCell.unpack_weights)."""
+        args = dict(args)
+        arr = np.asarray(args.pop(self._prefix + "parameters"))
+        input_size = self._input_size_from_total(arr.size)
+        entries, total = param_layout(
+            input_size, self._num_hidden, self._num_layers,
+            self._bidirectional, self._mode,
+        )
+        assert total == arr.size
+        h = self._num_hidden
+        for (kind, layer, d, part), (off, shape) in entries.items():
+            size = int(np.prod(shape))
+            t = "weight" if kind == "w" else "bias"
+            block = arr[off: off + size].reshape(shape)
+            base = f"{self._prefix}{self._directions[d]}{layer}_{part}"
+            for i, gate in enumerate(self._gate_names):
+                args[f"{base}{gate}_{t}"] = (
+                    block[i * h: (i + 1) * h].copy()
+                )
+        return args
+
+    def pack_weights(self, args):
+        args = dict(args)
+        g0 = self._gate_names[0]
+        probe = np.asarray(args[f"{self._prefix}l0_i2h{g0}_weight"])
+        input_size = probe.shape[1]
+        entries, total = param_layout(
+            input_size, self._num_hidden, self._num_layers,
+            self._bidirectional, self._mode,
+        )
+        arr = np.zeros((total,), dtype=np.float32)
+        for (kind, layer, d, part), (off, shape) in entries.items():
+            t = "weight" if kind == "w" else "bias"
+            base = f"{self._prefix}{self._directions[d]}{layer}_{part}"
+            block = np.concatenate(
+                [
+                    np.asarray(args.pop(f"{base}{gate}_{t}"),
+                               dtype=np.float32)
+                    for gate in self._gate_names
+                ]
+            )
+            size = int(np.prod(shape))
+            arr[off: off + size] = block.reshape(-1)
+        args[self._prefix + "parameters"] = arr
+        return args
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError(
+            "FusedRNNCell cannot be stepped. Please use unroll"
+        )
+
+    def unroll(self, length, inputs=None, begin_state=None,
+               input_prefix="", layout="NTC", merge_outputs=None):
+        self.reset()
+        axis = layout.find("T")
+        if inputs is None:
+            inputs = [
+                symbol.Variable(f"{input_prefix}t{i}_data")
+                for i in range(length)
+            ]
+        if isinstance(inputs, symbol.Symbol):
+            assert len(inputs.list_outputs()) == 1, (
+                "unroll doesn't allow grouped symbol as input"
+            )
+            if axis == 1:
+                inputs = symbol.SwapAxis(inputs, dim1=0, dim2=1)
+        else:
+            assert len(inputs) == length
+            inputs = [symbol.expand_dims(i, axis=0) for i in inputs]
+            inputs = symbol.Concat(*inputs, dim=0)
+        if begin_state is None:
+            begin_state = self.begin_state()
+        states = begin_state
+
+        kwargs = dict(
+            state_size=self._num_hidden,
+            num_layers=self._num_layers,
+            bidirectional=self._bidirectional,
+            p=self._dropout,
+            state_outputs=self._get_next_state,
+            mode=self._mode,
+            name=self._prefix + "rnn",
+        )
+        if self._mode == "lstm":
+            rnn = symbol.RNN(
+                data=inputs, parameters=self._parameter,
+                state=states[0], state_cell=states[1], **kwargs
+            )
+        else:
+            rnn = symbol.RNN(
+                data=inputs, parameters=self._parameter,
+                state=states[0], **kwargs
+            )
+        if not self._get_next_state:
+            outputs, states = rnn, []
+        elif self._mode == "lstm":
+            outputs, states = rnn[0], [rnn[1], rnn[2]]
+        else:
+            outputs, states = rnn[0], [rnn[1]]
+
+        if merge_outputs is None:
+            merge_outputs = False
+        if not merge_outputs:
+            outputs = symbol.SliceChannel(
+                outputs, axis=0, num_outputs=length, squeeze_axis=1
+            )
+            outputs = [outputs[i] for i in range(length)]
+        elif axis == 1:
+            outputs = symbol.SwapAxis(outputs, dim1=0, dim2=1)
+        return outputs, states
+
+    def unfuse(self):
+        """Equivalent SequentialRNNCell of unfused cells (reference
+        rnn_cell.py FusedRNNCell.unfuse)."""
+        stack = SequentialRNNCell()
+        get_cell = {
+            "rnn_relu": lambda pre: RNNCell(
+                self._num_hidden, activation="relu", prefix=pre
+            ),
+            "rnn_tanh": lambda pre: RNNCell(
+                self._num_hidden, activation="tanh", prefix=pre
+            ),
+            "lstm": lambda pre: LSTMCell(self._num_hidden, prefix=pre),
+            "gru": lambda pre: GRUCell(self._num_hidden, prefix=pre),
+        }[self._mode]
+        for i in range(self._num_layers):
+            if self._bidirectional:
+                stack.add(
+                    BidirectionalCell(
+                        get_cell(f"{self._prefix}l{i}_"),
+                        get_cell(f"{self._prefix}r{i}_"),
+                        output_prefix=f"{self._prefix}bi_{i}_",
+                    )
+                )
+            else:
+                stack.add(get_cell(f"{self._prefix}l{i}_"))
+            if self._dropout > 0 and i != self._num_layers - 1:
+                stack.add(DropoutCell(
+                    self._dropout, prefix=f"{self._prefix}_dropout{i}_"
+                ))
+        return stack
+
+
+class SequentialRNNCell(BaseRNNCell):
+    """Stack of cells applied in order each step (reference rnn_cell.py
+    SequentialRNNCell)."""
+
+    def __init__(self, params=None):
+        super().__init__(prefix="", params=params)
+        self._override_cell_params = params is not None
+        self._cells = []
+
+    def add(self, cell):
+        self._cells.append(cell)
+        if self._override_cell_params:
+            assert cell._own_params, (
+                "Either specify params for SequentialRNNCell or child "
+                "cells, not both."
+            )
+            cell.params._params.update(self.params._params)
+        self.params._params.update(cell.params._params)
+
+    @property
+    def state_info(self):
+        return [info for c in self._cells for info in c.state_info]
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return [
+            state for c in self._cells for state in c.begin_state(**kwargs)
+        ]
+
+    def unpack_weights(self, args):
+        for c in self._cells:
+            args = c.unpack_weights(args)
+        return args
+
+    def pack_weights(self, args):
+        for c in self._cells:
+            args = c.pack_weights(args)
+        return args
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        p = 0
+        for cell in self._cells:
+            n = len(cell.state_info)
+            state = states[p: p + n]
+            p += n
+            inputs, state = cell(inputs, state)
+            next_states.extend(state)
+        return inputs, next_states
+
+    def unroll(self, length, inputs=None, begin_state=None,
+               input_prefix="", layout="NTC", merge_outputs=None):
+        """Chain child unrolls (so unroll-only children like
+        BidirectionalCell compose); intermediate stages pass per-step
+        lists, only the last stage honors merge_outputs."""
+        self.reset()
+        if begin_state is None:
+            begin_state = self.begin_state()
+        p = 0
+        next_states = []
+        last = len(self._cells) - 1
+        for i, cell in enumerate(self._cells):
+            n = len(cell.state_info)
+            states = begin_state[p: p + n]
+            p += n
+            inputs, states = cell.unroll(
+                length, inputs=inputs, begin_state=states,
+                input_prefix=input_prefix, layout=layout,
+                merge_outputs=merge_outputs if i == last else None,
+            )
+            next_states.extend(states)
+        return inputs, next_states
+
+    def reset(self):
+        super().reset()
+        for c in getattr(self, "_cells", ()):
+            c.reset()
+
+
+class BidirectionalCell(BaseRNNCell):
+    """Forward + backward cells; unroll-only (reference rnn_cell.py
+    BidirectionalCell)."""
+
+    def __init__(self, l_cell, r_cell, params=None, output_prefix="bi_"):
+        super().__init__(prefix="", params=params)
+        self._output_prefix = output_prefix
+        self._override_cell_params = params is not None
+        if self._override_cell_params:
+            assert l_cell._own_params and r_cell._own_params, (
+                "Either specify params for BidirectionalCell or child "
+                "cells, not both."
+            )
+            l_cell.params._params.update(self.params._params)
+            r_cell.params._params.update(self.params._params)
+        self.params._params.update(l_cell.params._params)
+        self.params._params.update(r_cell.params._params)
+        self._cells = [l_cell, r_cell]
+
+    def unpack_weights(self, args):
+        return self._cells[0].unpack_weights(
+            self._cells[1].unpack_weights(args)
+        )
+
+    def pack_weights(self, args):
+        return self._cells[0].pack_weights(
+            self._cells[1].pack_weights(args)
+        )
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError(
+            "Bidirectional cell cannot be stepped. Please use unroll"
+        )
+
+    @property
+    def state_info(self):
+        return [info for c in self._cells for info in c.state_info]
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return [
+            state for c in self._cells for state in c.begin_state(**kwargs)
+        ]
+
+    def unroll(self, length, inputs=None, begin_state=None,
+               input_prefix="", layout="NTC", merge_outputs=None):
+        self.reset()
+        axis = layout.find("T")
+        if inputs is None:
+            inputs = [
+                symbol.Variable(f"{input_prefix}t{i}_data")
+                for i in range(length)
+            ]
+        elif isinstance(inputs, symbol.Symbol):
+            inputs = symbol.SliceChannel(
+                inputs, axis=axis, num_outputs=length, squeeze_axis=1
+            )
+            inputs = [inputs[i] for i in range(length)]
+        else:
+            assert len(inputs) == length
+        if begin_state is None:
+            begin_state = self.begin_state()
+        states = begin_state
+        l_cell, r_cell = self._cells
+        n_l = len(l_cell.state_info)
+        l_outputs, l_states = l_cell.unroll(
+            length, inputs=inputs, begin_state=states[:n_l],
+            layout=layout, merge_outputs=False,
+        )
+        r_outputs, r_states = r_cell.unroll(
+            length, inputs=list(reversed(inputs)),
+            begin_state=states[n_l:], layout=layout, merge_outputs=False,
+        )
+        r_outputs = list(reversed(r_outputs))
+        outputs = [
+            symbol.Concat(
+                l_o, r_o, dim=1,
+                name=f"{self._output_prefix}t{i}",
+            )
+            for i, (l_o, r_o) in enumerate(zip(l_outputs, r_outputs))
+        ]
+        if merge_outputs:
+            outputs = [
+                symbol.expand_dims(o, axis=axis) for o in outputs
+            ]
+            outputs = symbol.Concat(*outputs, dim=axis)
+        states = l_states + r_states
+        return outputs, states
+
+
+class ModifierCell(BaseRNNCell):
+    """Base for cells that wrap another cell (reference rnn_cell.py
+    ModifierCell)."""
+
+    def __init__(self, base_cell):
+        super().__init__()
+        base_cell._modified = True
+        self.base_cell = base_cell
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self.base_cell.params
+
+    @property
+    def state_info(self):
+        return self.base_cell.state_info
+
+    def begin_state(self, func=None, **kwargs):
+        assert not self._modified
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(func=func, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+    def unpack_weights(self, args):
+        return self.base_cell.unpack_weights(args)
+
+    def pack_weights(self, args):
+        return self.base_cell.pack_weights(args)
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError
+
+
+class DropoutCell(BaseRNNCell):
+    """Applies dropout on the input (reference rnn_cell.py DropoutCell)."""
+
+    def __init__(self, dropout=0.0, prefix="dropout_", params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.dropout = dropout
+
+    @property
+    def state_info(self):
+        return []
+
+    def __call__(self, inputs, states):
+        if self.dropout > 0:
+            inputs = symbol.Dropout(data=inputs, p=self.dropout)
+        return inputs, states
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout regularization on a base cell (reference rnn_cell.py
+    ZoneoutCell): with probability z keep the previous state."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        assert not isinstance(base_cell, FusedRNNCell), (
+            "FusedRNNCell doesn't support zoneout. Please unfuse first."
+        )
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self.prev_output = None
+
+    def reset(self):
+        super().reset()
+        self.prev_output = None
+
+    def __call__(self, inputs, states):
+        cell, p_outputs, p_states = (
+            self.base_cell, self.zoneout_outputs, self.zoneout_states
+        )
+        next_output, next_states = cell(inputs, states)
+
+        def mask(p, like):
+            return symbol.Dropout(
+                symbol.ones_like(like), p=p
+            )
+
+        prev_output = (
+            self.prev_output
+            if self.prev_output is not None
+            else symbol.zeros_like(next_output)
+        )
+        output = (
+            symbol.where(
+                mask(p_outputs, next_output), next_output, prev_output
+            )
+            if p_outputs != 0.0
+            else next_output
+        )
+        states = (
+            [
+                symbol.where(mask(p_states, new_s), new_s, old_s)
+                for new_s, old_s in zip(next_states, states)
+            ]
+            if p_states != 0.0
+            else next_states
+        )
+        self.prev_output = output
+        return output, states

@@ -600,7 +600,7 @@ def _create(opdef, input_syms, params, name=None):
         name = prefix + name
     inputs = []
     params = opdef.normalize_params(params)
-    if opdef.arg_names is not None:
+    if opdef.arg_names is not None or opdef.arg_names_fn is not None:
         given = list(input_syms)
         # positionally fill declared args; auto-create the rest
         needed = _required_inputs(opdef, params)
@@ -640,6 +640,8 @@ def _create(opdef, input_syms, params, name=None):
 def _required_inputs(opdef, params):
     """Declared inputs actually used given params (e.g. no bias when
     no_bias=True, no gamma unless prelu)."""
+    if opdef.arg_names_fn is not None:
+        return list(opdef.arg_names_fn(params))
     names = list(opdef.arg_names)
     if params.get("no_bias") and "bias" in names:
         names.remove("bias")
@@ -648,6 +650,8 @@ def _required_inputs(opdef, params):
     if opdef.name in ("SequenceMask", "SequenceLast", "SequenceReverse") and \
             not params.get("use_sequence_length"):
         names = ["data"]
+    if opdef.name == "RNN" and params.get("mode") != "lstm":
+        names = [n for n in names if n != "state_cell"]
     return names
 
 
@@ -660,22 +664,28 @@ def _make_symbol_function(opdef, func_name):
         params = {}
         # aux states are auto-created (reference ListAuxiliaryStates
         # semantics), so only declared args are valid symbol inputs
-        valid_names = set(opdef.arg_names or ())
         for k, v in kwargs.items():
             if isinstance(v, Symbol):
-                if opdef.arg_names is None:
+                if opdef.arg_names is None and opdef.arg_names_fn is None:
                     raise MXNetError(
                         f"{func_name}: variadic op takes positional "
                         f"symbol inputs only"
                     )
-                if k not in valid_names:
-                    raise MXNetError(
-                        f"{func_name}: unknown input {k!r} "
-                        f"(expected one of {sorted(valid_names)})"
-                    )
                 sym_kwargs[k] = v
             else:
                 params[k] = v
+        if opdef.arg_names_fn is not None:
+            valid_names = set(
+                opdef.arg_names_fn(opdef.normalize_params(params))
+            )
+        else:
+            valid_names = set(opdef.arg_names or ())
+        for k in sym_kwargs:
+            if k not in valid_names:
+                raise MXNetError(
+                    f"{func_name}: unknown input {k!r} "
+                    f"(expected one of {sorted(valid_names)})"
+                )
         if sym_kwargs:
             # slot-exact merge: kwargs pin their named slot; positional
             # args fill remaining slots in declaration order; unfilled

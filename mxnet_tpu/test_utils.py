@@ -1,0 +1,338 @@
+"""Testing utilities — the reference's load-bearing test idioms
+(python/mxnet/test_utils.py): numeric-gradient checking of symbols
+(test_utils.py:300-397), symbolic forward/backward checks against numpy
+references (:473-526), and cross-backend consistency (:676). TPU analog
+of check_consistency: the same symbol evaluated on jax-CPU vs the TPU
+backend (or vs itself in float16) must agree within tolerance.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .context import cpu, current_context
+from .ndarray import NDArray, array
+from .symbol import Symbol
+
+default_dtype = np.float32
+
+
+def default_context():
+    return current_context()
+
+
+def random_arrays(*shapes):
+    """Generate arrays of random float32 data."""
+    arrays = [
+        np.array(np.random.randn(), dtype=default_dtype)
+        if len(s) == 0
+        else np.random.randn(*s).astype(default_dtype)
+        for s in shapes
+    ]
+    if len(arrays) == 1:
+        return arrays[0]
+    return arrays
+
+
+def same(a, b):
+    return np.array_equal(a, b)
+
+
+def reldiff(a, b):
+    """Relative difference |a-b| / (|a|+|b|) (reference
+    test_utils.py reldiff)."""
+    diff = np.sum(np.abs(a - b))
+    norm = np.sum(np.abs(a)) + np.sum(np.abs(b))
+    if diff == 0:
+        return 0
+    return diff / norm
+
+
+def _parse_location(sym, location, ctx):
+    """location: list (arg order) or dict (by name) of numpy/NDArray."""
+    if isinstance(location, dict):
+        wrong = set(location.keys()) - set(sym.list_arguments())
+        if wrong:
+            raise MXNetError(
+                f"locations {wrong} not found in symbol arguments"
+            )
+        location = {
+            k: array(v, ctx=ctx) if not isinstance(v, NDArray) else v
+            for k, v in location.items()
+        }
+    else:
+        location = {
+            k: array(v, ctx=ctx) if not isinstance(v, NDArray) else v
+            for k, v in zip(sym.list_arguments(), location)
+        }
+    return location
+
+
+def _parse_aux_states(sym, aux_states, ctx):
+    if aux_states is None:
+        return {}
+    if isinstance(aux_states, dict):
+        return {
+            k: array(v, ctx=ctx) if not isinstance(v, NDArray) else v
+            for k, v in aux_states.items()
+        }
+    return {
+        k: array(v, ctx=ctx) if not isinstance(v, NDArray) else v
+        for k, v in zip(sym.list_auxiliary_states(), aux_states)
+    }
+
+
+def numeric_grad(executor, location, aux_states=None, eps=1e-4,
+                 use_forward_train=True):
+    """Central finite differences of the scalar sum of executor outputs
+    w.r.t. each location entry (reference test_utils.py numeric_grad)."""
+    approx_grads = {
+        k: np.zeros(v.shape, dtype=np.float32)
+        for k, v in location.items()
+    }
+
+    executor.forward(is_train=use_forward_train)
+    f_base = sum(
+        o.asnumpy().astype(np.float64).sum() for o in executor.outputs
+    )
+
+    for k, v in location.items():
+        old_value = v.asnumpy()
+        flat = old_value.reshape(-1)
+        grad_flat = approx_grads[k].reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps
+            executor.arg_dict[k][:] = old_value.reshape(old_value.shape)
+            executor.forward(is_train=use_forward_train)
+            f_pos = sum(
+                o.asnumpy().astype(np.float64).sum()
+                for o in executor.outputs
+            )
+            flat[i] = orig - eps
+            executor.arg_dict[k][:] = old_value.reshape(old_value.shape)
+            executor.forward(is_train=use_forward_train)
+            f_neg = sum(
+                o.asnumpy().astype(np.float64).sum()
+                for o in executor.outputs
+            )
+            flat[i] = orig
+            executor.arg_dict[k][:] = old_value.reshape(old_value.shape)
+            grad_flat[i] = (f_pos - f_neg) / (2 * eps)
+    return approx_grads
+
+
+def check_numeric_gradient(sym, location, aux_states=None,
+                           numeric_eps=1e-3, rtol=1e-2, atol=None,
+                           grad_nodes=None, use_forward_train=True,
+                           ctx=None):
+    """Verify the symbol's analytic gradients against central finite
+    differences with a random projection (reference
+    test_utils.py:300-397). The random-projection trick: check
+    d(sum(proj * f(x)))/dx instead of the full Jacobian.
+    """
+    if ctx is None:
+        ctx = cpu()
+
+    location = _parse_location(sym, location, ctx)
+    location_npy = {k: v.asnumpy() for k, v in location.items()}
+    aux = _parse_aux_states(sym, aux_states, ctx)
+
+    if grad_nodes is None:
+        grad_nodes = [
+            k for k in sym.list_arguments()
+            if k in location
+        ]
+
+    input_shapes = {k: v.shape for k, v in location.items()}
+    _, out_shapes, _ = sym.infer_shape(**input_shapes)
+    proj = [
+        np.random.uniform(-1.0, 1.0, s).astype(np.float32)
+        for s in out_shapes
+    ]
+
+    # scalar objective: sum_i proj_i * out_i  — build symbolically
+    from . import symbol as S
+
+    outs = [sym[i] if len(sym.list_outputs()) > 1 else sym
+            for i in range(len(out_shapes))]
+    heads = []
+    for i, o in enumerate(outs):
+        pvar = S.Variable(f"__random_proj_{i}__")
+        heads.append(S.sum(o * pvar))
+    objective = S.Group(heads) if len(heads) > 1 else heads[0]
+
+    full_loc = dict(location)
+    for i, p in enumerate(proj):
+        full_loc[f"__random_proj_{i}__"] = array(p, ctx=ctx)
+
+    grad_req = {
+        k: "write" if k in grad_nodes else "null"
+        for k in objective.list_arguments()
+    }
+    args_grad = {
+        k: array(np.zeros(full_loc[k].shape, np.float32), ctx=ctx)
+        for k in grad_nodes
+    }
+    executor = objective.bind(
+        ctx, args=full_loc, args_grad=args_grad, grad_req=grad_req,
+        aux_states=aux if aux else None,
+    )
+    executor.forward(is_train=use_forward_train)
+    executor.backward()
+    symbolic_grads = {
+        k: executor.grad_dict[k].asnumpy() for k in grad_nodes
+    }
+
+    numeric_gradients = numeric_grad(
+        executor,
+        {k: v for k, v in executor.arg_dict.items() if k in grad_nodes},
+        eps=numeric_eps, use_forward_train=use_forward_train,
+    )
+
+    for name in grad_nodes:
+        fd_grad = numeric_gradients[name]
+        sym_grad = symbolic_grads[name]
+        if atol is None:
+            rel = reldiff(fd_grad, sym_grad)
+            if rel > rtol:
+                raise AssertionError(
+                    f"numeric gradient check failed for {name}: "
+                    f"reldiff {rel} > {rtol}\nnumeric:\n{fd_grad}\n"
+                    f"symbolic:\n{sym_grad}"
+                )
+        else:
+            np.testing.assert_allclose(
+                sym_grad, fd_grad, rtol=rtol, atol=atol,
+                err_msg=f"gradient mismatch for {name}",
+            )
+    # restore
+    for k, v in location_npy.items():
+        executor.arg_dict[k][:] = v
+    return symbolic_grads
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-4,
+                           atol=None, aux_states=None, ctx=None):
+    """Forward the symbol and compare outputs to numpy references
+    (reference test_utils.py:473)."""
+    if ctx is None:
+        ctx = cpu()
+    location = _parse_location(sym, location, ctx)
+    aux = _parse_aux_states(sym, aux_states, ctx)
+    executor = sym.bind(
+        ctx, args=location, aux_states=aux if aux else None,
+        grad_req={k: "null" for k in sym.list_arguments()},
+    )
+    outputs = [o.asnumpy() for o in executor.forward()]
+    if isinstance(expected, dict):
+        expected = [
+            expected[k] for k in sym.list_outputs()
+        ]
+    for out, exp in zip(outputs, expected):
+        if atol is None:
+            assert reldiff(out, exp) < rtol, (
+                f"forward mismatch: {out} vs {exp}"
+            )
+        else:
+            np.testing.assert_allclose(out, exp, rtol=rtol, atol=atol)
+    return outputs
+
+
+def check_symbolic_backward(sym, location, out_grads, expected,
+                            rtol=1e-4, atol=None, aux_states=None,
+                            grad_req="write", ctx=None):
+    """Backward the symbol with given head gradients and compare input
+    gradients to numpy references (reference test_utils.py:526)."""
+    if ctx is None:
+        ctx = cpu()
+    location = _parse_location(sym, location, ctx)
+    aux = _parse_aux_states(sym, aux_states, ctx)
+    if isinstance(expected, (list, tuple)):
+        expected = dict(zip(sym.list_arguments(), expected))
+    args_grad = {
+        k: array(np.zeros(location[k].shape, np.float32), ctx=ctx)
+        for k in expected
+    }
+    if isinstance(grad_req, str):
+        grad_req = {
+            k: grad_req if k in expected else "null"
+            for k in sym.list_arguments()
+        }
+    executor = sym.bind(
+        ctx, args=location, args_grad=args_grad, grad_req=grad_req,
+        aux_states=aux if aux else None,
+    )
+    executor.forward(is_train=True)
+    if isinstance(out_grads, (list, tuple)):
+        out_grads = [
+            array(g, ctx=ctx) if not isinstance(g, NDArray) else g
+            for g in out_grads
+        ]
+    elif out_grads is not None:
+        out_grads = [
+            array(out_grads, ctx=ctx)
+            if not isinstance(out_grads, NDArray)
+            else out_grads
+        ]
+    executor.backward(out_grads)
+    grads = {k: v.asnumpy() for k, v in executor.grad_dict.items()
+             if k in expected}
+    for name, exp in expected.items():
+        if atol is None:
+            assert reldiff(grads[name], exp) < rtol, (
+                f"backward mismatch for {name}: {grads[name]} vs {exp}"
+            )
+        else:
+            np.testing.assert_allclose(
+                grads[name], exp, rtol=rtol, atol=atol,
+                err_msg=f"gradient mismatch for {name}",
+            )
+    return grads
+
+
+def check_consistency(sym, ctx_list, scale=1.0, rtol=1e-3, atol=1e-4,
+                      arg_params=None):
+    """Bind the same symbol under multiple contexts/dtype configs and
+    require agreeing outputs (TPU analog of reference test_utils.py:676
+    cpu/gpu/fp16 consistency). Each ctx_list entry is a dict with 'ctx'
+    plus input shapes, e.g. {'ctx': mx.cpu(), 'data': (2, 3)} and
+    optionally 'type_dict'.
+    """
+    if len(ctx_list) < 2:
+        raise MXNetError("check_consistency needs >= 2 contexts")
+    exe_list = []
+    for spec in ctx_list:
+        spec = dict(spec)
+        ctx = spec.pop("ctx")
+        spec.pop("type_dict", None)
+        exe_list.append(
+            sym.simple_bind(ctx=ctx, grad_req="null", **spec)
+        )
+    # same init everywhere
+    arg_names = sym.list_arguments()
+    rs = np.random.RandomState(0)
+    inits = {}
+    for name in arg_names:
+        shape = exe_list[0].arg_dict[name].shape
+        inits[name] = (
+            scale * rs.standard_normal(shape)
+        ).astype(np.float32)
+        if arg_params and name in arg_params:
+            inits[name] = arg_params[name]
+    for exe in exe_list:
+        for name in arg_names:
+            exe.arg_dict[name][:] = inits[name]
+    outputs = [
+        [o.asnumpy() for o in exe.forward(is_train=False)]
+        for exe in exe_list
+    ]
+    ref = outputs[0]
+    for outs in outputs[1:]:
+        for a, b in zip(ref, outs):
+            np.testing.assert_allclose(a, b, rtol=rtol, atol=atol)
+    return outputs
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-8):
+    np.testing.assert_allclose(a, b, rtol=rtol, atol=atol)

@@ -13,3 +13,13 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The axon sitecustomize (TPU tunnel) force-selects jax_platforms
+# "axon,cpu" at interpreter start, overriding JAX_PLATFORMS; pin the
+# config back to cpu so the suite never dials the TPU tunnel.
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass

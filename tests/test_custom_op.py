@@ -1,0 +1,124 @@
+"""Custom-op tests — modeled on the reference's custom-op coverage in
+tests/python/unittest/test_operator.py (test_custom_op) and the three
+generations in python/mxnet/operator.py."""
+import numpy as np
+
+import mxnet_tpu as mx
+import mxnet_tpu.operator as op
+
+
+class _Softmax(op.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        y = np.exp(x - x.max(axis=1, keepdims=True))
+        y /= y.sum(axis=1, keepdims=True)
+        self.assign(out_data[0], req[0], mx.nd.array(y))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        label = in_data[1].asnumpy().ravel().astype(int)
+        y = out_data[0].asnumpy()
+        y[np.arange(label.shape[0]), label] -= 1.0
+        self.assign(in_grad[0], req[0], mx.nd.array(y))
+
+
+@op.register("test_softmax")
+class _SoftmaxProp(op.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return [in_shape[0], (in_shape[0][0],)], [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _Softmax()
+
+
+def test_custom_op_forward_backward():
+    sym = mx.sym.Custom(
+        data=mx.sym.Variable("data"), label=mx.sym.Variable("label"),
+        op_type="test_softmax", name="softmax",
+    )
+    ex = sym.simple_bind(
+        ctx=mx.cpu(), data=(4, 5), label=(4,), grad_req="write"
+    )
+    x = np.random.RandomState(0).rand(4, 5).astype(np.float32)
+    label = np.array([0, 1, 2, 3], np.float32)
+    out = ex.forward(is_train=True, data=x, label=label)[0].asnumpy()
+    ref = np.exp(x - x.max(1, keepdims=True))
+    ref /= ref.sum(1, keepdims=True)
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+    ex.backward()
+    g = ex.grad_dict["data"].asnumpy()
+    expect = ref.copy()
+    expect[np.arange(4), label.astype(int)] -= 1
+    np.testing.assert_allclose(g, expect, rtol=1e-5)
+
+
+def test_custom_op_in_larger_graph():
+    """Custom node composes with built-in ops and grads flow through."""
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=5, name="fc")
+    sm = mx.sym.Custom(
+        data=fc, label=mx.sym.Variable("label"),
+        op_type="test_softmax", name="softmax",
+    )
+    ex = sm.simple_bind(
+        ctx=mx.cpu(), data=(4, 3), label=(4,), grad_req="write"
+    )
+    rs = np.random.RandomState(1)
+    ex.arg_dict["fc_weight"][:] = rs.rand(5, 3).astype(np.float32)
+    ex.arg_dict["fc_bias"][:] = 0.0
+    out = ex.forward(
+        is_train=True, data=rs.rand(4, 3).astype(np.float32),
+        label=np.array([0, 1, 2, 3], np.float32),
+    )
+    ex.backward()
+    assert np.abs(ex.grad_dict["fc_weight"].asnumpy()).sum() > 0
+
+
+def test_numpy_op():
+    class Sq(op.NumpyOp):
+        def forward(self, in_data, out_data):
+            out_data[0][:] = in_data[0] ** 2
+
+        def backward(self, out_grad, in_data, out_data, in_grad):
+            in_grad[0][:] = 2 * in_data[0] * out_grad[0]
+
+    sq = Sq()
+    s = sq(mx.sym.Variable("x"), name="sq")
+    ex = s.simple_bind(ctx=mx.cpu(), x=(3,), grad_req="write")
+    xv = np.array([1.0, 2.0, 3.0], np.float32)
+    np.testing.assert_allclose(
+        ex.forward(is_train=True, x=xv)[0].asnumpy(), xv ** 2
+    )
+    ex.backward(out_grads=mx.nd.array(np.ones(3, np.float32)))
+    np.testing.assert_allclose(
+        ex.grad_dict["x"].asnumpy(), 2 * xv
+    )
+
+
+def test_ndarray_op():
+    class Scale(op.NDArrayOp):
+        def forward(self, in_data, out_data):
+            out_data[0][:] = in_data[0] * 3.0
+
+        def backward(self, out_grad, in_data, out_data, in_grad):
+            in_grad[0][:] = out_grad[0] * 3.0
+
+    sc = Scale()
+    s = sc(mx.sym.Variable("x"), name="scale")
+    ex = s.simple_bind(ctx=mx.cpu(), x=(2, 2), grad_req="write")
+    xv = np.ones((2, 2), np.float32)
+    np.testing.assert_allclose(
+        ex.forward(is_train=True, x=xv)[0].asnumpy(), 3 * xv
+    )
+    ex.backward(out_grads=mx.nd.array(np.ones((2, 2), np.float32)))
+    np.testing.assert_allclose(
+        ex.grad_dict["x"].asnumpy(), 3 * np.ones((2, 2))
+    )

@@ -1,0 +1,209 @@
+"""Operator gradient checks — the reference's backbone test idiom
+(tests/python/unittest/test_operator.py, 103 tests, each op validated
+with check_numeric_gradient / check_symbolic_forward / backward against
+numpy references, via python/mxnet/test_utils.py:300-527)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import test_utils as tu
+
+RS = np.random.RandomState
+
+
+def _rand(*shape, seed=0, scale=1.0):
+    return (RS(seed).rand(*shape).astype(np.float32) - 0.5) * scale
+
+
+def test_fully_connected_grad():
+    x = mx.sym.Variable("x")
+    y = mx.sym.FullyConnected(x, num_hidden=4, name="fc")
+    tu.check_numeric_gradient(
+        y,
+        {
+            "x": _rand(3, 5, seed=0),
+            "fc_weight": _rand(4, 5, seed=1),
+            "fc_bias": _rand(4, seed=2),
+        },
+    )
+
+
+@pytest.mark.parametrize("act", ["relu", "sigmoid", "tanh", "softrelu"])
+def test_activation_grad(act):
+    x = mx.sym.Variable("x")
+    y = mx.sym.Activation(x, act_type=act)
+    # offset away from relu's kink at 0
+    data = _rand(4, 5, seed=3, scale=4.0) + 0.6
+    tu.check_numeric_gradient(y, {"x": data})
+
+
+def test_convolution_grad():
+    x = mx.sym.Variable("x")
+    y = mx.sym.Convolution(
+        x, kernel=(3, 3), num_filter=2, pad=(1, 1), name="conv"
+    )
+    tu.check_numeric_gradient(
+        y,
+        {
+            "x": _rand(1, 2, 5, 5, seed=4),
+            "conv_weight": _rand(2, 2, 3, 3, seed=5),
+            "conv_bias": _rand(2, seed=6),
+        },
+        rtol=2e-2,
+    )
+
+
+def test_pooling_grad():
+    x = mx.sym.Variable("x")
+    y = mx.sym.Pooling(
+        x, kernel=(2, 2), stride=(2, 2), pool_type="avg"
+    )
+    tu.check_numeric_gradient(y, {"x": _rand(1, 2, 4, 4, seed=7)})
+
+
+def test_batchnorm_grad():
+    x = mx.sym.Variable("x")
+    y = mx.sym.BatchNorm(x, name="bn", fix_gamma=False)
+    tu.check_numeric_gradient(
+        y,
+        {
+            "x": _rand(4, 3, seed=8, scale=2.0),
+            "bn_gamma": np.ones(3, np.float32),
+            "bn_beta": np.zeros(3, np.float32),
+        },
+        aux_states={
+            "bn_moving_mean": np.zeros(3, np.float32),
+            "bn_moving_var": np.ones(3, np.float32),
+        },
+        rtol=5e-2,
+    )
+
+
+def test_elemwise_grads():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    for expr in (a + b, a * b, a - b, a / b):
+        tu.check_numeric_gradient(
+            expr,
+            {"a": _rand(3, 4, seed=9) + 2.0, "b": _rand(3, 4, seed=10) + 2.0},
+        )
+
+
+def test_broadcast_grad():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    y = mx.sym.broadcast_add(a, b)
+    tu.check_numeric_gradient(
+        y, {"a": _rand(3, 4, seed=11), "b": _rand(1, 4, seed=12)}
+    )
+
+
+def test_dot_grad():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    y = mx.sym.dot(a, b)
+    tu.check_numeric_gradient(
+        y, {"a": _rand(3, 4, seed=13), "b": _rand(4, 2, seed=14)}
+    )
+
+
+def test_reduce_grads():
+    a = mx.sym.Variable("a")
+    for y in (mx.sym.sum(a, axis=1), mx.sym.mean(a, axis=0),
+              mx.sym.max(a, axis=1)):
+        tu.check_numeric_gradient(
+            y, {"a": _rand(3, 4, seed=15, scale=3.0)}, rtol=2e-2
+        )
+
+
+def test_transpose_reshape_slice_grads():
+    a = mx.sym.Variable("a")
+    for y in (
+        mx.sym.transpose(a),
+        mx.sym.Reshape(a, shape=(4, 3)),
+        mx.sym.slice_axis(a, axis=1, begin=1, end=3),
+    ):
+        tu.check_numeric_gradient(y, {"a": _rand(3, 4, seed=16)})
+
+
+def test_embedding_grad():
+    d = mx.sym.Variable("d")
+    y = mx.sym.Embedding(
+        d, input_dim=6, output_dim=3, name="emb"
+    )
+    tu.check_numeric_gradient(
+        y,
+        {
+            "d": np.array([[0, 2], [1, 5]], np.float32),
+            "emb_weight": _rand(6, 3, seed=17),
+        },
+        grad_nodes=["emb_weight"],
+    )
+
+
+def test_concat_grad():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    y = mx.sym.Concat(a, b, dim=1)
+    tu.check_numeric_gradient(
+        y, {"a": _rand(2, 3, seed=18), "b": _rand(2, 2, seed=19)}
+    )
+
+
+def test_softmax_output_forward():
+    x = mx.sym.Variable("x")
+    l = mx.sym.Variable("l")
+    y = mx.sym.SoftmaxOutput(x, l, name="sm")
+    data = _rand(3, 4, seed=20, scale=2.0)
+    e = np.exp(data - data.max(1, keepdims=True))
+    expected = e / e.sum(1, keepdims=True)
+    tu.check_symbolic_forward(
+        y, {"x": data, "l": np.zeros(3, np.float32)}, [expected]
+    )
+
+
+def test_leaky_relu_grad():
+    x = mx.sym.Variable("x")
+    y = mx.sym.LeakyReLU(x, act_type="leaky", slope=0.25)
+    data = _rand(3, 4, seed=21, scale=4.0) + 0.6
+    tu.check_numeric_gradient(y, {"x": data})
+
+
+def test_where_forward():
+    c = mx.sym.Variable("c")
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    y = mx.sym.where(c, a, b)
+    cv = np.array([[1, 0], [0, 1]], np.float32)
+    av = np.ones((2, 2), np.float32)
+    bv = np.zeros((2, 2), np.float32)
+    tu.check_symbolic_forward(
+        y, {"c": cv, "a": av, "b": bv}, [cv]
+    )
+
+
+def test_rnn_op_grad():
+    """Numeric gradient through the fused RNN op (lstm, 1 layer)."""
+    from mxnet_tpu.ops.rnn_op import rnn_param_size
+
+    T, N, I, H = 3, 2, 3, 4
+    size = rnn_param_size(I, H, 1, False, "lstm")
+    data = mx.sym.Variable("data")
+    params = mx.sym.Variable("p")
+    state = mx.sym.Variable("s")
+    cell = mx.sym.Variable("c")
+    y = mx.sym.RNN(
+        data=data, parameters=params, state=state, state_cell=cell,
+        state_size=H, num_layers=1, mode="lstm",
+    )
+    tu.check_numeric_gradient(
+        y,
+        {
+            "data": _rand(T, N, I, seed=22),
+            "p": _rand(size, seed=23),
+            "s": np.zeros((1, N, H), np.float32),
+            "c": np.zeros((1, N, H), np.float32),
+        },
+        grad_nodes=["data", "p"],
+        rtol=2e-2,
+    )

@@ -45,6 +45,11 @@ from . import parallel
 from . import rnn
 from . import operator
 from . import test_utils
+from . import monitor as _monitor_mod
+from .monitor import Monitor
+from . import profiler
+from . import visualization
+from . import visualization as viz
 from .callback import Speedometer
 
 __version__ = "0.1.0"

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import profiler as _profiler
 from . import random as _random
 from .base import MXNetError
 from .ndarray import NDArray
@@ -142,6 +143,9 @@ class Executor:
             return outs, aux_updates
 
         self._run_graph = run_graph
+        self._plan = plan
+        self._var_names = var_names
+        self._aux_set = aux_set
         self._jit_fwd = {
             True: jax.jit(lambda a, x, r: run_graph(a, x, r, True)),
             False: jax.jit(lambda a, x, r: run_graph(a, x, r, False)),
@@ -178,25 +182,69 @@ class Executor:
             if k not in self.arg_dict:
                 raise MXNetError(f"unknown forward argument {k!r}")
             self.arg_dict[k][:] = v
+        if self._monitor_callback is not None:
+            # monitored (debug) path: eager per-node execution so the
+            # callback sees every intermediate (reference
+            # MXExecutorSetMonitorCallback + ExecuteMonCallback,
+            # graph_executor.cc:758). Not jit'd by design.
+            self._forward_monitored(is_train)
         arg_vals, aux_vals = self._gather_inputs()
         self._rng, rng = jax.random.split(self._rng)
         self._cached_grads = None
-        if is_train and self._grad_names:
-            head_grads = self._default_head_grads(arg_vals, aux_vals, rng)
-            outs, grads, aux_upd = self._jit_train_step(
-                arg_vals, aux_vals, rng, head_grads
-            )
-            self._cached_grads = grads
-        else:
-            outs, aux_upd = self._jit_fwd[bool(is_train)](
-                arg_vals, aux_vals, rng
-            )
+        with _profiler.scope(
+            f"executor_forward[{'train' if is_train else 'eval'}]",
+            "executor",
+        ):
+            if is_train and self._grad_names:
+                head_grads = self._default_head_grads(
+                    arg_vals, aux_vals, rng
+                )
+                outs, grads, aux_upd = self._jit_train_step(
+                    arg_vals, aux_vals, rng, head_grads
+                )
+                self._cached_grads = grads
+            else:
+                outs, aux_upd = self._jit_fwd[bool(is_train)](
+                    arg_vals, aux_vals, rng
+                )
         self._last_inputs = (arg_vals, aux_vals, rng)
         if is_train:
             for name, val in aux_upd.items():
                 self.aux_dict[name]._set_data(val)
         self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
         return self.outputs
+
+    def _forward_monitored(self, is_train):
+        """Eager per-node execution invoking the monitor callback with
+        every node output (debug path; see forward())."""
+        arg_vals, aux_vals = self._gather_inputs()
+        rng = self._rng  # peek; real forward re-splits
+        env = {}
+        for nid, name in self._var_names.items():
+            env[(nid, 0)] = (
+                aux_vals[name] if name in self._aux_set
+                else arg_vals[name]
+            )
+        for opdef, params, n_out, in_keys, nid, node_idx, nname in \
+                self._plan:
+            in_vals = [env[k] for k in in_keys]
+            kwargs = dict(params)
+            if opdef.needs_rng:
+                kwargs["rng"] = jax.random.fold_in(rng, node_idx)
+            if opdef.needs_mode:
+                kwargs["is_train"] = bool(is_train)
+            res = opdef.fn(*in_vals, **kwargs)
+            if not isinstance(res, tuple):
+                res = (res,)
+            for i in range(n_out):
+                env[(nid, i)] = res[i]
+                out_name = (
+                    f"{nname}_output" if n_out == 1
+                    else f"{nname}_output{i}"
+                )
+                self._monitor_callback(
+                    out_name, NDArray(res[i], ctx=self._ctx)
+                )
 
     def _default_head_grads(self, arg_vals, aux_vals, rng):
         if not hasattr(self, "_head_shapes"):

@@ -11,6 +11,9 @@ from .mesh import (
     current_mesh,
     default_mesh,
     set_mesh,
+    make_mesh,
     data_parallel_mesh,
 )
 from .kvstore_tpu import KVStoreTPU
+from .attention import attention, attention_reference
+from .ring_attention import ring_attention, ulysses_attention

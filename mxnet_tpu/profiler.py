@@ -1,0 +1,116 @@
+"""Profiler: Chrome trace-event output + TPU/XLA trace capture.
+
+Capability parity with the reference profiler (src/engine/profiler.{h,cc}
+— OprExecStat records per-op begin/end dumped as Chrome trace-event JSON
+by DumpProfile, python/mxnet/profiler.py facade). TPU-native twist: the
+heavy device-side timeline comes from jax.profiler (XLA trace →
+TensorBoard/Perfetto), while host-side framework events (executor
+forward/backward, io, kvstore push/pull) are recorded here and dumped in
+the same Chrome trace-event JSON format the reference emits, so existing
+chrome://tracing workflows keep working.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+_state = {
+    "mode": "symbolic",
+    "filename": "profile.json",
+    "running": False,
+    "jax_trace_dir": None,
+}
+_events = []
+_lock = threading.Lock()
+_t0 = time.perf_counter()
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    """Configure profiler output (reference profiler.py:10
+    MXSetProfilerConfig). mode: 'symbolic' (executor-level events) or
+    'all' (also imperative ops)."""
+    _state["mode"] = mode
+    _state["filename"] = filename
+
+
+def profiler_set_state(state="stop"):
+    """'run' starts collection, 'stop' ends it and dumps
+    (reference profiler.py:25 MXSetProfilerState)."""
+    if state == "run":
+        _state["running"] = True
+        trace_dir = os.environ.get("MXNET_TPU_XLA_TRACE_DIR")
+        if trace_dir:
+            try:
+                import jax
+
+                jax.profiler.start_trace(trace_dir)
+                _state["jax_trace_dir"] = trace_dir
+            except Exception:
+                _state["jax_trace_dir"] = None
+    elif state == "stop":
+        if _state["jax_trace_dir"]:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            _state["jax_trace_dir"] = None
+        _state["running"] = False
+        dump_profile()
+    else:
+        raise ValueError("state must be 'run' or 'stop'")
+
+
+def is_running():
+    return _state["running"]
+
+
+def record_event(name, category, begin_s, end_s):
+    """Record one host-side event (seconds since profiler import)."""
+    if not _state["running"]:
+        return
+    with _lock:
+        _events.append((name, category, begin_s, end_s))
+
+
+class scope:
+    """Context manager timing a host-side region into the profile."""
+
+    def __init__(self, name, category="host"):
+        self.name = name
+        self.category = category
+
+    def __enter__(self):
+        self._b = time.perf_counter() - _t0
+        return self
+
+    def __exit__(self, *exc):
+        record_event(
+            self.name, self.category, self._b,
+            time.perf_counter() - _t0,
+        )
+        return False
+
+
+def dump_profile():
+    """Write collected events as Chrome trace-event JSON (the reference
+    DumpProfile format, src/engine/profiler.cc:134)."""
+    with _lock:
+        events = list(_events)
+        _events.clear()
+    trace = {"traceEvents": [], "displayTimeUnit": "ms"}
+    for name, cat, b, e in events:
+        trace["traceEvents"].append({
+            "name": name, "cat": cat, "ph": "B",
+            "ts": b * 1e6, "pid": 0, "tid": 0,
+        })
+        trace["traceEvents"].append({
+            "name": name, "cat": cat, "ph": "E",
+            "ts": e * 1e6, "pid": 0, "tid": 0,
+        })
+    with open(_state["filename"], "w") as f:
+        json.dump(trace, f)
+    return _state["filename"]

@@ -1,0 +1,70 @@
+"""Monitor / profiler / visualization tests (reference
+tests/python/unittest/test_profiler.py, test_monitor idioms,
+test_viz.py)."""
+import json
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _net():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    act = mx.sym.Activation(fc, act_type="relu", name="relu")
+    return act
+
+
+def test_monitor_collects_stats():
+    net = _net()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(2, 3))
+    mon = mx.Monitor(interval=1, pattern=".*")
+    mon.install(ex)
+    ex.arg_dict["fc_weight"][:] = np.ones((4, 3), np.float32)
+    mon.tic()
+    ex.forward(data=np.ones((2, 3), np.float32))
+    res = mon.toc()
+    names = [k for _, k, _ in res]
+    assert any("fc_output" in n for n in names)
+    assert any("relu_output" in n for n in names)
+    assert "fc_weight" in names
+
+
+def test_monitor_pattern_filter():
+    net = _net()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(2, 3))
+    mon = mx.Monitor(interval=1, pattern=".*relu.*")
+    mon.install(ex)
+    mon.tic()
+    ex.forward(data=np.ones((2, 3), np.float32))
+    res = mon.toc()
+    names = [k for _, k, _ in res]
+    assert names and all("relu" in n for n in names)
+
+
+def test_profiler_chrome_trace(tmp_path):
+    fn = str(tmp_path / "profile.json")
+    mx.profiler.profiler_set_config(mode="symbolic", filename=fn)
+    mx.profiler.profiler_set_state("run")
+    net = _net()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(2, 3))
+    ex.forward(data=np.ones((2, 3), np.float32))
+    mx.profiler.profiler_set_state("stop")
+    assert os.path.exists(fn)
+    with open(fn) as f:
+        trace = json.load(f)
+    assert "traceEvents" in trace
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert any("executor_forward" in n for n in names)
+
+
+def test_print_summary(capsys):
+    net = mx.sym.SoftmaxOutput(_net(), name="sm")
+    total = mx.visualization.print_summary(
+        net, shape={"data": (2, 3)}
+    )
+    out = capsys.readouterr().out
+    assert "fc(FullyConnected)" in out
+    # fc: 4*3 weight + 4 bias = 16 params
+    assert total == 16

@@ -39,6 +39,10 @@ from . import io
 from . import kvstore as kv
 from . import kvstore
 from . import model
+from . import executor_manager
+from . import feed_forward
+from .feed_forward import FeedForward
+from . import rtc
 from . import module
 from . import module as mod
 from . import parallel

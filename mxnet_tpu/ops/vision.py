@@ -1,0 +1,731 @@
+"""Vision / contrib operator tier.
+
+Covers the reference's hand-written CUDA contrib ops with TPU-idiomatic
+vectorized implementations (no scalar loops — everything is masked
+dense math so XLA can tile it):
+
+- SpatialTransformer + GridGenerator + BilinearSampler
+  (reference src/operator/spatial_transformer-inl.h, grid_generator-inl.h,
+  bilinear_sampler-inl.h)
+- ROIPooling (reference src/operator/roi_pooling-inl.h)
+- Correlation (reference src/operator/correlation-inl.h)
+- MultiBoxPrior / MultiBoxTarget / MultiBoxDetection — SSD anchors,
+  matching, NMS (reference src/operator/contrib/multibox_*.cc/.cu)
+- Proposal — Faster-RCNN RPN proposals (reference
+  src/operator/contrib/proposal-inl.h)
+- fft / ifft (reference src/operator/contrib/fft-inl.h, cuFFT-backed
+  there; jnp.fft → XLA here, complex packed as interleaved re/im)
+- count_sketch (reference src/operator/contrib/count_sketch-inl.h)
+- quantize / dequantize (reference src/operator/contrib/quantize-inl.h)
+
+NMS note: suppression is inherently sequential in the reference's CUDA
+kernel; here it is a lax.fori_loop over the fixed top-k candidates with
+masked IoU updates — static shapes, compiles once.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+from ..base import MXNetError, coerce_bool, coerce_float, coerce_int, coerce_tuple
+
+
+# ---------------------------------------------------- spatial transformer
+
+
+def _affine_grid(theta, out_h, out_w):
+    """theta: (N, 6) affine params -> sampling grid (N, out_h, out_w, 2)
+    in normalized [-1, 1] target coords."""
+    n = theta.shape[0]
+    theta = theta.reshape(n, 2, 3)
+    ys = jnp.linspace(-1.0, 1.0, out_h)
+    xs = jnp.linspace(-1.0, 1.0, out_w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    coords = jnp.stack(
+        [gx.ravel(), gy.ravel(), ones.ravel()], axis=0
+    )  # (3, H*W)
+    out = jnp.einsum("nij,jk->nik", theta, coords)  # (N, 2, H*W)
+    return out.transpose(0, 2, 1).reshape(n, out_h, out_w, 2)
+
+
+def _bilinear_sample(data, grid_xy):
+    """data: (N, C, H, W); grid_xy: (N, out_h, out_w, 2) normalized
+    (x, y) in [-1, 1]. Out-of-bounds samples are zero (reference
+    bilinear_sampler-inl.h border behavior)."""
+    n, c, h, w = data.shape
+    gx = (grid_xy[..., 0] + 1.0) * (w - 1) / 2.0
+    gy = (grid_xy[..., 1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    x1 = x0 + 1
+    y1 = y0 + 1
+
+    def gather(yi, xi):
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        # (N, out_h, out_w) index maps -> gather per batch
+        out = jax.vmap(
+            lambda img, yy, xx: img[:, yy, xx]
+        )(data, yc, xc)  # (N, C, out_h, out_w)
+        valid = (
+            (yi >= 0) & (yi <= h - 1) & (xi >= 0) & (xi <= w - 1)
+        )
+        return out * valid[:, None].astype(data.dtype)
+
+    w00 = (x1 - gx) * (y1 - gy)
+    w01 = (gx - x0) * (y1 - gy)
+    w10 = (x1 - gx) * (gy - y0)
+    w11 = (gx - x0) * (gy - y0)
+    return (
+        gather(y0, x0) * w00[:, None]
+        + gather(y0, x1) * w01[:, None]
+        + gather(y1, x0) * w10[:, None]
+        + gather(y1, x1) * w11[:, None]
+    )
+
+
+@register(
+    "GridGenerator",
+    arg_names=["data"],
+    coerce={"target_shape": coerce_tuple},
+    defaults={"transform_type": "affine"},
+)
+def grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    """affine: data (N, 6) -> grid (N, 2, H, W); warp: data (N, 2, H, W)
+    flow field -> absolute sampling grid."""
+    if transform_type == "affine":
+        h, w = int(target_shape[0]), int(target_shape[1])
+        grid = _affine_grid(data, h, w)  # (N, H, W, 2) xy
+        return grid.transpose(0, 3, 1, 2)
+    if transform_type == "warp":
+        n, _, h, w = data.shape
+        ys = jnp.linspace(-1.0, 1.0, h)
+        xs = jnp.linspace(-1.0, 1.0, w)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy], axis=0)[None]
+        flow = jnp.stack(
+            [data[:, 0] * 2.0 / max(w - 1, 1),
+             data[:, 1] * 2.0 / max(h - 1, 1)],
+            axis=1,
+        )
+        return base + flow
+    raise MXNetError(f"unknown transform_type {transform_type!r}")
+
+
+@register(
+    "BilinearSampler",
+    arg_names=["data", "grid"],
+)
+def bilinear_sampler(data, grid):
+    """data (N, C, H, W), grid (N, 2, out_h, out_w) normalized (x, y)."""
+    return _bilinear_sample(data, grid.transpose(0, 2, 3, 1))
+
+
+@register(
+    "SpatialTransformer",
+    arg_names=["data", "loc"],
+    coerce={"target_shape": coerce_tuple},
+    defaults={"transform_type": "affine", "sampler_type": "bilinear"},
+)
+def spatial_transformer(data, loc, target_shape=(0, 0),
+                        transform_type="affine",
+                        sampler_type="bilinear"):
+    if transform_type != "affine" or sampler_type != "bilinear":
+        raise MXNetError(
+            "SpatialTransformer supports affine + bilinear"
+        )
+    h, w = int(target_shape[0]), int(target_shape[1])
+    grid = _affine_grid(loc, h, w)
+    return _bilinear_sample(data, grid)
+
+
+# ------------------------------------------------------------ roi pooling
+
+
+@register(
+    "ROIPooling",
+    arg_names=["data", "rois"],
+    coerce={"pooled_size": coerce_tuple, "spatial_scale": coerce_float},
+)
+def roi_pooling(data, rois, pooled_size, spatial_scale):
+    """data (N, C, H, W); rois (R, 5) [batch_idx, x1, y1, x2, y2] in
+    image coords. Max-pool each roi into (R, C, ph, pw). Vectorized:
+    each output bin is a masked max over the whole feature map (dense
+    mask instead of the reference's per-bin scalar loops,
+    roi_pooling-inl.h)."""
+    n, c, h, w = data.shape
+    ph, pw = int(pooled_size[0]), int(pooled_size[1])
+    ys = jnp.arange(h, dtype=jnp.float32)
+    xs = jnp.arange(w, dtype=jnp.float32)
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        roi_h = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        roi_w = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        bin_h = roi_h / ph
+        bin_w = roi_w / pw
+        img = data[bidx]  # (C, H, W)
+
+        py = jnp.arange(ph, dtype=jnp.float32)
+        px = jnp.arange(pw, dtype=jnp.float32)
+        ys0 = jnp.floor(y1 + py * bin_h)            # (ph,)
+        ys1 = jnp.ceil(y1 + (py + 1.0) * bin_h)
+        xs0 = jnp.floor(x1 + px * bin_w)            # (pw,)
+        xs1 = jnp.ceil(x1 + (px + 1.0) * bin_w)
+        ymask = (ys[None, :] >= ys0[:, None]) & (
+            ys[None, :] < jnp.maximum(ys1, ys0 + 1.0)[:, None]
+        )  # (ph, H)
+        xmask = (xs[None, :] >= xs0[:, None]) & (
+            xs[None, :] < jnp.maximum(xs1, xs0 + 1.0)[:, None]
+        )  # (pw, W)
+        mask = ymask[:, None, :, None] & xmask[None, :, None, :]
+        # (ph, pw, H, W); masked max over H, W per channel
+        neg = jnp.full((c, h, w), -jnp.inf, data.dtype)
+        vals = jnp.where(mask[:, :, None], img[None, None], neg)
+        out = vals.max(axis=(-1, -2))  # (ph, pw, C)
+        out = jnp.where(jnp.isfinite(out), out, 0.0)
+        return out.transpose(2, 0, 1)  # (C, ph, pw)
+
+    return jax.vmap(one_roi)(rois)
+
+
+# ------------------------------------------------------------- correlation
+
+
+@register(
+    "Correlation",
+    arg_names=["data1", "data2"],
+    num_outputs=1,
+    coerce={
+        "kernel_size": coerce_int,
+        "max_displacement": coerce_int,
+        "stride1": coerce_int,
+        "stride2": coerce_int,
+        "pad_size": coerce_int,
+        "is_multiply": coerce_bool,
+    },
+    defaults={
+        "kernel_size": 1,
+        "max_displacement": 1,
+        "stride1": 1,
+        "stride2": 1,
+        "pad_size": 0,
+        "is_multiply": True,
+    },
+)
+def correlation(data1, data2, kernel_size=1, max_displacement=1,
+                stride1=1, stride2=1, pad_size=0, is_multiply=True):
+    """FlowNet correlation layer (reference correlation-inl.h),
+    simplified to kernel_size=1/stride1=1: output channel per
+    displacement (dy, dx) in the window, value = mean over channels of
+    data1 * shift(data2)."""
+    n, c, h, w = data1.shape
+    d = max_displacement
+    disp = range(-d, d + 1, stride2)
+    p2 = jnp.pad(
+        data2, ((0, 0), (0, 0), (d, d), (d, d))
+    )
+    outs = []
+    for dy in disp:
+        for dx in disp:
+            shifted = lax.dynamic_slice(
+                p2, (0, 0, d + dy, d + dx), (n, c, h, w)
+            )
+            if is_multiply:
+                outs.append((data1 * shifted).mean(axis=1))
+            else:
+                outs.append(
+                    jnp.abs(data1 - shifted).mean(axis=1)
+                )
+    return jnp.stack(outs, axis=1)
+
+
+# ---------------------------------------------------------- multibox (SSD)
+
+
+def _iou_matrix(a, b):
+    """a: (A, 4), b: (G, 4) corner boxes -> (A, G) IoU."""
+    tl = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    br = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum(
+        (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1]), 0.0
+    )
+    area_b = jnp.maximum(
+        (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]), 0.0
+    )
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register(
+    "MultiBoxPrior",
+    arg_names=["data"],
+    coerce={"clip": coerce_bool},
+    defaults={"sizes": (1.0,), "ratios": (1.0,), "clip": False},
+    aliases=("_contrib_MultiBoxPrior",),
+)
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False):
+    """Anchor boxes for SSD (reference contrib/multibox_prior.cc):
+    data (N, C, H, W) -> (1, H*W*(S+R-1), 4) normalized corners."""
+    if isinstance(sizes, str):
+        sizes = tuple(float(x) for x in sizes.strip("()[]").split(","))
+    if isinstance(ratios, str):
+        ratios = tuple(float(x) for x in ratios.strip("()[]").split(","))
+    _, _, h, w = data.shape
+    cy = (jnp.arange(h) + 0.5) / h
+    cx = (jnp.arange(w) + 0.5) / w
+    gy, gx = jnp.meshgrid(cy, cx, indexing="ij")
+    centers = jnp.stack([gx.ravel(), gy.ravel()], axis=-1)  # (HW, 2)
+    whs = []
+    for i, s in enumerate(sizes):
+        whs.append((s * jnp.sqrt(ratios[0]), s / jnp.sqrt(ratios[0])))
+    for r in ratios[1:]:
+        s = sizes[0]
+        whs.append((s * jnp.sqrt(r), s / jnp.sqrt(r)))
+    whs = jnp.asarray(whs, jnp.float32)  # (K, 2) width, height
+    k = whs.shape[0]
+    cs = jnp.repeat(centers, k, axis=0)          # (HW*K, 2)
+    ws = jnp.tile(whs, (centers.shape[0], 1))     # (HW*K, 2)
+    boxes = jnp.concatenate(
+        [cs - ws / 2.0, cs + ws / 2.0], axis=-1
+    )
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes[None]
+
+
+@register(
+    "MultiBoxTarget",
+    arg_names=["anchor", "label", "cls_pred"],
+    num_outputs=3,
+    coerce={
+        "overlap_threshold": coerce_float,
+        "ignore_label": coerce_float,
+        "negative_mining_ratio": coerce_float,
+        "negative_mining_thresh": coerce_float,
+        "minimum_negative_samples": coerce_int,
+    },
+    defaults={
+        "overlap_threshold": 0.5,
+        "ignore_label": -1.0,
+        "negative_mining_ratio": -1.0,
+        "negative_mining_thresh": 0.5,
+        "minimum_negative_samples": 0,
+        "variances": (0.1, 0.1, 0.2, 0.2),
+    },
+    aliases=("_contrib_MultiBoxTarget",),
+    no_grad_inputs=("anchor", "label", "cls_pred"),
+)
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5,
+                    minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """SSD training targets (reference contrib/multibox_target.cc).
+    anchor (1, A, 4); label (B, G, 5) [cls, x1, y1, x2, y2] with cls=-1
+    padding; cls_pred (B, num_cls+1, A). Returns (loc_target (B, A*4),
+    loc_mask (B, A*4), cls_target (B, A))."""
+    if isinstance(variances, str):
+        variances = tuple(
+            float(x) for x in variances.strip("()[]").split(",")
+        )
+    anchors = anchor[0]  # (A, 4)
+    a = anchors.shape[0]
+    var = jnp.asarray(variances, jnp.float32)
+
+    def one_batch(lab):
+        gt_boxes = lab[:, 1:5]
+        gt_cls = lab[:, 0]
+        valid = gt_cls >= 0  # (G,)
+        iou = _iou_matrix(anchors, gt_boxes)  # (A, G)
+        iou = jnp.where(valid[None, :], iou, -1.0)
+        best_gt = iou.argmax(axis=1)              # (A,)
+        best_iou = iou.max(axis=1)
+        # force-match: each gt claims its best anchor
+        best_anchor = iou.argmax(axis=0)          # (G,)
+        forced = jnp.zeros((a,), bool)
+        forced = forced.at[best_anchor].set(valid)
+        gt_of_forced = jnp.zeros((a,), jnp.int32)
+        gt_of_forced = gt_of_forced.at[best_anchor].set(
+            jnp.arange(gt_boxes.shape[0], dtype=jnp.int32)
+        )
+        matched = forced | (best_iou >= overlap_threshold)
+        match_gt = jnp.where(forced, gt_of_forced, best_gt)
+
+        mg_boxes = gt_boxes[match_gt]  # (A, 4)
+        # encode: center offsets scaled by variances
+        aw = anchors[:, 2] - anchors[:, 0]
+        ah = anchors[:, 3] - anchors[:, 1]
+        acx = (anchors[:, 0] + anchors[:, 2]) / 2
+        acy = (anchors[:, 1] + anchors[:, 3]) / 2
+        gw = jnp.maximum(mg_boxes[:, 2] - mg_boxes[:, 0], 1e-8)
+        gh = jnp.maximum(mg_boxes[:, 3] - mg_boxes[:, 1], 1e-8)
+        gcx = (mg_boxes[:, 0] + mg_boxes[:, 2]) / 2
+        gcy = (mg_boxes[:, 1] + mg_boxes[:, 3]) / 2
+        tx = (gcx - acx) / jnp.maximum(aw, 1e-8) / var[0]
+        ty = (gcy - acy) / jnp.maximum(ah, 1e-8) / var[1]
+        tw = jnp.log(gw / jnp.maximum(aw, 1e-8)) / var[2]
+        th = jnp.log(gh / jnp.maximum(ah, 1e-8)) / var[3]
+        loc_t = jnp.stack([tx, ty, tw, th], axis=-1)  # (A, 4)
+        loc_t = jnp.where(matched[:, None], loc_t, 0.0)
+        loc_m = jnp.repeat(
+            matched[:, None].astype(jnp.float32), 4, axis=1
+        )
+        cls_t = jnp.where(
+            matched, gt_cls[match_gt] + 1.0, 0.0
+        )
+        return loc_t.reshape(-1), loc_m.reshape(-1), cls_t
+
+    loc_target, loc_mask, cls_target = jax.vmap(one_batch)(label)
+
+    if negative_mining_ratio > 0:
+        # hard negative mining: keep ratio*num_pos hardest negatives
+        # (highest max non-background confidence), ignore the rest
+        def mine(cls_t, cp):
+            pos = cls_t > 0
+            num_pos = pos.sum()
+            max_k = jnp.maximum(
+                (negative_mining_ratio * num_pos).astype(jnp.int32),
+                minimum_negative_samples,
+            )
+            neg_conf = jnp.where(
+                ~pos, cp[1:, :].max(axis=0) - cp[0, :], -jnp.inf
+            )
+            order = jnp.argsort(-neg_conf)
+            rank = jnp.zeros((a,), jnp.int32).at[order].set(
+                jnp.arange(a, dtype=jnp.int32)
+            )
+            keep_neg = (~pos) & (rank < max_k)
+            return jnp.where(
+                pos | keep_neg, cls_t, ignore_label
+            )
+
+        cls_target = jax.vmap(mine)(cls_target, cls_pred)
+    return loc_target, loc_mask, cls_target
+
+
+def _nms_loop(boxes, scores, classes, iou_thresh, force_suppress):
+    """Greedy NMS over pre-sorted candidates. boxes (K, 4) sorted by
+    descending score; returns keep mask (K,)."""
+    k = boxes.shape[0]
+    iou = _iou_matrix(boxes, boxes)
+    same_cls = (
+        jnp.ones((k, k), bool)
+        if force_suppress
+        else classes[:, None] == classes[None, :]
+    )
+    valid0 = scores > 0
+
+    def body(i, keep):
+        sup = (
+            keep & (iou[i] > iou_thresh) & same_cls[i]
+            & (jnp.arange(k) > i)
+        )
+        return keep & ~jnp.where(keep[i], sup, False)
+
+    keep = lax.fori_loop(0, k, body, valid0)
+    return keep
+
+
+@register(
+    "MultiBoxDetection",
+    arg_names=["cls_prob", "loc_pred", "anchor"],
+    coerce={
+        "clip": coerce_bool,
+        "threshold": coerce_float,
+        "nms_threshold": coerce_float,
+        "force_suppress": coerce_bool,
+        "nms_topk": coerce_int,
+    },
+    defaults={
+        "clip": True,
+        "threshold": 0.01,
+        "nms_threshold": 0.5,
+        "force_suppress": False,
+        "variances": (0.1, 0.1, 0.2, 0.2),
+        "nms_topk": -1,
+    },
+    aliases=("_contrib_MultiBoxDetection",),
+    no_grad_inputs=("cls_prob", "loc_pred", "anchor"),
+)
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                       threshold=0.01, nms_threshold=0.5,
+                       force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """SSD detection decode + NMS (reference
+    contrib/multibox_detection.cc). cls_prob (B, num_cls+1, A),
+    loc_pred (B, A*4), anchor (1, A, 4) -> (B, A, 6)
+    [cls_id, score, x1, y1, x2, y2], suppressed rows cls_id=-1."""
+    if isinstance(variances, str):
+        variances = tuple(
+            float(x) for x in variances.strip("()[]").split(",")
+        )
+    anchors = anchor[0]
+    a = anchors.shape[0]
+    var = jnp.asarray(variances, jnp.float32)
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+
+    def one_batch(cp, lp):
+        deltas = lp.reshape(a, 4)
+        cx = deltas[:, 0] * var[0] * aw + acx
+        cy = deltas[:, 1] * var[1] * ah + acy
+        bw = jnp.exp(deltas[:, 2] * var[2]) * aw
+        bh = jnp.exp(deltas[:, 3] * var[3]) * ah
+        boxes = jnp.stack(
+            [cx - bw / 2, cy - bh / 2, cx + bw / 2, cy + bh / 2],
+            axis=-1,
+        )
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        scores = cp[1:, :]  # (num_cls, A)
+        cls_id = scores.argmax(axis=0)            # (A,)
+        score = scores.max(axis=0)
+        score = jnp.where(score > threshold, score, 0.0)
+        order = jnp.argsort(-score)
+        boxes_s = boxes[order]
+        score_s = score[order]
+        cls_s = cls_id[order]
+        keep = _nms_loop(
+            boxes_s, score_s, cls_s, nms_threshold, force_suppress
+        )
+        out_cls = jnp.where(keep, cls_s.astype(jnp.float32), -1.0)
+        return jnp.concatenate(
+            [out_cls[:, None], score_s[:, None], boxes_s], axis=-1
+        )
+
+    return jax.vmap(one_batch)(cls_prob, loc_pred)
+
+
+# ----------------------------------------------------------------- proposal
+
+
+@register(
+    "Proposal",
+    arg_names=["cls_prob", "bbox_pred", "im_info"],
+    coerce={
+        "rpn_pre_nms_top_n": coerce_int,
+        "rpn_post_nms_top_n": coerce_int,
+        "threshold": coerce_float,
+        "feature_stride": coerce_int,
+        "rpn_min_size": coerce_int,
+        "output_score": coerce_bool,
+    },
+    defaults={
+        "rpn_pre_nms_top_n": 6000,
+        "rpn_post_nms_top_n": 300,
+        "threshold": 0.7,
+        "feature_stride": 16,
+        "rpn_min_size": 16,
+        "scales": (4.0, 8.0, 16.0, 32.0),
+        "ratios": (0.5, 1.0, 2.0),
+        "output_score": False,
+    },
+    aliases=("_contrib_Proposal",),
+    no_grad_inputs=("cls_prob", "bbox_pred", "im_info"),
+)
+def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, feature_stride=16,
+             rpn_min_size=16, scales=(4.0, 8.0, 16.0, 32.0),
+             ratios=(0.5, 1.0, 2.0), output_score=False):
+    """RPN proposals (reference contrib/proposal-inl.h). cls_prob
+    (B, 2*K, H, W); bbox_pred (B, 4*K, H, W); im_info (B, 3)
+    [height, width, scale]. Output (B*post_nms, 5)
+    [batch_idx, x1, y1, x2, y2]."""
+    if isinstance(scales, str):
+        scales = tuple(float(x) for x in scales.strip("()[]").split(","))
+    if isinstance(ratios, str):
+        ratios = tuple(float(x) for x in ratios.strip("()[]").split(","))
+    b, twok, h, w = cls_prob.shape
+    k = twok // 2
+    base = float(feature_stride)
+    # anchors at each feature cell (pixel coords)
+    whs = []
+    for r in ratios:
+        for s in scales:
+            size = base * base
+            ws_ = jnp.sqrt(size / r) * s / base
+            hs_ = ws_ * r
+            whs.append((ws_ * base, hs_ * base))
+    whs = jnp.asarray(whs, jnp.float32)[: k]
+    cy = (jnp.arange(h) + 0.5) * base
+    cx = (jnp.arange(w) + 0.5) * base
+    gy, gx = jnp.meshgrid(cy, cx, indexing="ij")
+    centers = jnp.stack([gx, gy], -1).reshape(-1, 2)  # (HW, 2)
+    cs = jnp.repeat(centers, whs.shape[0], axis=0)
+    ws2 = jnp.tile(whs, (centers.shape[0], 1))
+    anchors = jnp.concatenate(
+        [cs - ws2 / 2, cs + ws2 / 2], axis=-1
+    )  # (HW*K, 4)
+    num = anchors.shape[0]
+    topk = min(rpn_post_nms_top_n, num)
+
+    def one_batch(bi, cp, bp, info):
+        fg = cp[k:, :, :].transpose(1, 2, 0).reshape(-1)  # (HWK,)
+        deltas = (
+            bp.reshape(k, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        )
+        aw = anchors[:, 2] - anchors[:, 0]
+        ah = anchors[:, 3] - anchors[:, 1]
+        acx = (anchors[:, 0] + anchors[:, 2]) / 2
+        acy = (anchors[:, 1] + anchors[:, 3]) / 2
+        cx_ = deltas[:, 0] * aw + acx
+        cy_ = deltas[:, 1] * ah + acy
+        w_ = jnp.exp(jnp.clip(deltas[:, 2], -10, 10)) * aw
+        h_ = jnp.exp(jnp.clip(deltas[:, 3], -10, 10)) * ah
+        boxes = jnp.stack(
+            [cx_ - w_ / 2, cy_ - h_ / 2, cx_ + w_ / 2, cy_ + h_ / 2],
+            -1,
+        )
+        boxes = jnp.stack(
+            [
+                jnp.clip(boxes[:, 0], 0, info[1] - 1),
+                jnp.clip(boxes[:, 1], 0, info[0] - 1),
+                jnp.clip(boxes[:, 2], 0, info[1] - 1),
+                jnp.clip(boxes[:, 3], 0, info[0] - 1),
+            ],
+            -1,
+        )
+        min_size = rpn_min_size * info[2]
+        keep_size = (
+            (boxes[:, 2] - boxes[:, 0] + 1 >= min_size)
+            & (boxes[:, 3] - boxes[:, 1] + 1 >= min_size)
+        )
+        fg = jnp.where(keep_size, fg, -1.0)
+        order = jnp.argsort(-fg)[: min(rpn_pre_nms_top_n, num)]
+        boxes_s = boxes[order]
+        fg_s = fg[order]
+        keep = _nms_loop(
+            boxes_s, jnp.maximum(fg_s, 0.0),
+            jnp.zeros_like(fg_s, jnp.int32), threshold, True
+        )
+        score_for_rank = jnp.where(keep, fg_s, -jnp.inf)
+        sel = jnp.argsort(-score_for_rank)[:topk]
+        out_boxes = boxes_s[sel]
+        out_scores = jnp.where(keep[sel], fg_s[sel], 0.0)
+        out_boxes = out_boxes * keep[sel][:, None]
+        rois = jnp.concatenate(
+            [jnp.full((topk, 1), bi, jnp.float32), out_boxes], -1
+        )
+        return rois, out_scores[:, None]
+
+    rois, scores = jax.vmap(one_batch)(
+        jnp.arange(b, dtype=jnp.float32), cls_prob, bbox_pred, im_info
+    )
+    rois = rois.reshape(b * topk, 5)
+    scores = scores.reshape(b * topk, 1)
+    if output_score:
+        return rois, scores
+    return rois
+
+
+# --------------------------------------------------------------------- fft
+
+
+@register(
+    "fft",
+    arg_names=["data"],
+    coerce={"compute_size": coerce_int},
+    defaults={"compute_size": 128},
+    aliases=("_contrib_fft",),
+)
+def fft(data, compute_size=128):
+    """FFT along the last axis; complex output packed as interleaved
+    [re, im] (reference contrib/fft-inl.h output layout: last dim
+    doubled)."""
+    out = jnp.fft.fft(data.astype(jnp.complex64), axis=-1)
+    re = jnp.real(out)
+    im = jnp.imag(out)
+    packed = jnp.stack([re, im], axis=-1)
+    return packed.reshape(*data.shape[:-1], data.shape[-1] * 2) \
+        .astype(jnp.float32)
+
+
+@register(
+    "ifft",
+    arg_names=["data"],
+    coerce={"compute_size": coerce_int},
+    defaults={"compute_size": 128},
+    aliases=("_contrib_ifft",),
+)
+def ifft(data, compute_size=128):
+    """Inverse of `fft`: interleaved [re, im] input, real output
+    scaled by n (matching cuFFT's unnormalized inverse, which the
+    reference exposes)."""
+    n = data.shape[-1] // 2
+    unpacked = data.reshape(*data.shape[:-1], n, 2)
+    comp = unpacked[..., 0] + 1j * unpacked[..., 1]
+    out = jnp.fft.ifft(comp, axis=-1)
+    return (jnp.real(out) * n).astype(jnp.float32)
+
+
+# ------------------------------------------------------------ count sketch
+
+
+@register(
+    "count_sketch",
+    arg_names=["data", "h", "s"],
+    coerce={"out_dim": coerce_int},
+    aliases=("_contrib_count_sketch",),
+    no_grad_inputs=("h", "s"),
+)
+def count_sketch(data, h, s, out_dim):
+    """Count sketch projection (reference contrib/count_sketch-inl.h):
+    out[:, h[i]] += s[i] * data[:, i]. h (1, in_dim) int hash bucket,
+    s (1, in_dim) ±1 signs."""
+    n, in_dim = data.shape
+    hh = h.reshape(-1).astype(jnp.int32)
+    ss = s.reshape(-1)
+    vals = data * ss[None, :]
+    out = jnp.zeros((n, out_dim), data.dtype)
+    return out.at[:, hh].add(vals)
+
+
+# ----------------------------------------------------------- quantization
+
+
+@register(
+    "quantize",
+    arg_names=["data", "min_range", "max_range"],
+    num_outputs=3,
+    defaults={"out_type": "uint8"},
+    aliases=("_contrib_quantize",),
+    no_grad_inputs=("data", "min_range", "max_range"),
+)
+def quantize(data, min_range, max_range, out_type="uint8"):
+    """Affine-quantize float32 -> uint8 (reference
+    contrib/quantize-inl.h). Returns (quantized, min, max)."""
+    if out_type != "uint8":
+        raise MXNetError("quantize supports out_type='uint8'")
+    mn = min_range.reshape(())
+    mx = max_range.reshape(())
+    scale = 255.0 / jnp.maximum(mx - mn, 1e-8)
+    q = jnp.clip(
+        jnp.round((data - mn) * scale), 0, 255
+    ).astype(jnp.uint8)
+    return q, mn.reshape(1), mx.reshape(1)
+
+
+@register(
+    "dequantize",
+    arg_names=["data", "min_range", "max_range"],
+    defaults={"out_type": "float32"},
+    aliases=("_contrib_dequantize",),
+    no_grad_inputs=("data", "min_range", "max_range"),
+)
+def dequantize(data, min_range, max_range, out_type="float32"):
+    mn = min_range.reshape(())
+    mx = max_range.reshape(())
+    scale = jnp.maximum(mx - mn, 1e-8) / 255.0
+    return data.astype(jnp.float32) * scale + mn

@@ -1,0 +1,98 @@
+"""FeedForward estimator, executor_manager, and RTC/Pallas escape hatch
+tests (reference model.py FeedForward, executor_manager.py, rtc.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _toy_data(n=256, seed=3):
+    rs = np.random.RandomState(seed)
+    X = rs.rand(n, 10).astype(np.float32)
+    y = (X.sum(axis=1) > 5).astype(np.float32)
+    return X, y
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc, act_type="tanh")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=2, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_feedforward_fit_predict_score(tmp_path):
+    X, y = _toy_data()
+    np.random.seed(7)
+    model = mx.FeedForward(
+        _mlp(), ctx=mx.cpu(), num_epoch=20, numpy_batch_size=32,
+        optimizer="sgd", learning_rate=0.5,
+        initializer=mx.init.Xavier(),
+    )
+    model.fit(X, y)
+    acc = model.score(
+        mx.io.NDArrayIter(X, y, batch_size=32)
+    )
+    assert acc > 0.8, f"FeedForward failed to learn: acc={acc}"
+    preds = model.predict(X)
+    assert preds.shape == (256, 2)
+
+    # checkpoint round trip
+    model.save(str(tmp_path / "ff"), 8)
+    loaded = mx.FeedForward.load(str(tmp_path / "ff"), 8, ctx=mx.cpu())
+    preds2 = loaded.predict(X)
+    np.testing.assert_allclose(preds, preds2, rtol=1e-5)
+
+
+def test_feedforward_create():
+    X, y = _toy_data()
+    model = mx.FeedForward.create(
+        _mlp(), X, y, ctx=mx.cpu(), num_epoch=4,
+        learning_rate=0.5, initializer=mx.init.Xavier(),
+    )
+    assert model.arg_params is not None
+
+
+def test_executor_manager_multi_device():
+    X, y = _toy_data()
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    mgr = mx.executor_manager.DataParallelExecutorManager(
+        _mlp(), [mx.cpu(0), mx.cpu(1)], it
+    )
+    arg_params = {}
+    aux_params = {}
+    rs = np.random.RandomState(0)
+    for name in mgr.param_names:
+        shape = None
+    # initialize via set_params
+    arg_shapes, _, _ = _mlp().infer_shape(data=(32, 10))
+    shapes = dict(zip(_mlp().list_arguments(), arg_shapes))
+    init_params = {
+        n: rs.uniform(-0.1, 0.1, shapes[n]).astype(np.float32)
+        for n in mgr.param_names
+    }
+    mgr.set_params(init_params, {})
+    batch = next(iter(it))
+    mgr.load_data_batch(batch)
+    mgr.forward(is_train=True)
+    mgr.backward()
+    m = mx.metric.Accuracy()
+    mgr.update_metric(m, batch.label)
+    assert m.num_inst == 32
+    out = {n: mx.nd.zeros(shapes[n]) for n in mgr.param_names}
+    mgr.copy_to(out, {})
+
+
+def test_pallas_kernel_escape_hatch():
+    def double_kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0
+
+    k = mx.rtc.PallasKernel("double", double_kernel)
+    x = mx.nd.array(np.arange(8, dtype=np.float32))
+    (out,) = k.push([x], out_shapes=[(8,)])
+    np.testing.assert_allclose(out.asnumpy(), np.arange(8) * 2.0)
+
+
+def test_mxrtc_raises():
+    with pytest.raises(mx.MXNetError):
+        mx.rtc.MXRtc("x", [], [], "__global__ void x() {}")

@@ -1,0 +1,183 @@
+"""Vision/contrib op tests vs numpy references (reference coverage:
+test_operator.py spatial transformer / roi pooling / correlation tests,
+tests for contrib multibox & proposal in example/ssd and rcnn)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_grid_generator_identity():
+    theta = np.array([[1, 0, 0, 0, 1, 0]], np.float32)
+    g = nd.GridGenerator(
+        nd.array(theta), transform_type="affine", target_shape=(4, 4)
+    )
+    grid = g.asnumpy()
+    assert grid.shape == (1, 2, 4, 4)
+    np.testing.assert_allclose(
+        grid[0, 0, 0], np.linspace(-1, 1, 4), atol=1e-6
+    )
+
+
+def test_bilinear_sampler_identity():
+    data = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    theta = np.array([[1, 0, 0, 0, 1, 0]], np.float32)
+    grid = nd.GridGenerator(
+        nd.array(theta), transform_type="affine", target_shape=(4, 4)
+    )
+    out = nd.BilinearSampler(nd.array(data), grid).asnumpy()
+    np.testing.assert_allclose(out, data, atol=1e-5)
+
+
+def test_spatial_transformer_identity():
+    data = np.random.RandomState(0).rand(2, 3, 5, 5).astype(np.float32)
+    theta = np.tile(
+        np.array([[1, 0, 0, 0, 1, 0]], np.float32), (2, 1)
+    )
+    out = nd.SpatialTransformer(
+        nd.array(data), nd.array(theta), target_shape=(5, 5),
+        transform_type="affine", sampler_type="bilinear",
+    ).asnumpy()
+    np.testing.assert_allclose(out, data, atol=1e-5)
+
+
+def test_roi_pooling():
+    data = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 0, 3, 3]], np.float32)  # whole image
+    out = nd.ROIPooling(
+        nd.array(data), nd.array(rois), pooled_size=(2, 2),
+        spatial_scale=1.0,
+    ).asnumpy()
+    # max of each quadrant
+    np.testing.assert_allclose(
+        out[0, 0], np.array([[5, 7], [13, 15]], np.float32)
+    )
+
+
+def test_correlation_self():
+    data = np.random.RandomState(1).rand(1, 2, 4, 4).astype(np.float32)
+    out = nd.Correlation(
+        nd.array(data), nd.array(data), max_displacement=1
+    ).asnumpy()
+    assert out.shape == (1, 9, 4, 4)
+    # zero-displacement channel (index 4) equals mean of squares
+    np.testing.assert_allclose(
+        out[0, 4], (data ** 2).mean(axis=1)[0], rtol=1e-5
+    )
+
+
+def test_multibox_prior():
+    data = nd.zeros((1, 3, 4, 4))
+    anchors = nd.MultiBoxPrior(
+        data, sizes=(0.5, 0.25), ratios=(1.0, 2.0), clip=False
+    ).asnumpy()
+    assert anchors.shape == (1, 4 * 4 * 3, 4)
+    # first anchor centered at (0.125, 0.125) with size 0.5
+    np.testing.assert_allclose(
+        anchors[0, 0],
+        [0.125 - 0.25, 0.125 - 0.25, 0.125 + 0.25, 0.125 + 0.25],
+        atol=1e-6,
+    )
+    clipped = nd.MultiBoxPrior(
+        data, sizes=(0.5,), ratios=(1.0,), clip=True
+    ).asnumpy()
+    assert clipped.min() >= 0.0 and clipped.max() <= 1.0
+
+
+def test_multibox_target_and_detection_roundtrip():
+    # one anchor exactly on the gt box -> positive with zero offsets
+    anchors = np.array(
+        [[[0.1, 0.1, 0.4, 0.4], [0.6, 0.6, 0.9, 0.9]]], np.float32
+    )
+    label = np.array(
+        [[[1.0, 0.1, 0.1, 0.4, 0.4]]], np.float32
+    )  # cls 1 at first anchor
+    cls_pred = np.zeros((1, 3, 2), np.float32)
+    loc_t, loc_m, cls_t = nd.MultiBoxTarget(
+        nd.array(anchors), nd.array(label), nd.array(cls_pred)
+    )
+    loc_t, loc_m, cls_t = (
+        loc_t.asnumpy(), loc_m.asnumpy(), cls_t.asnumpy()
+    )
+    np.testing.assert_allclose(cls_t[0], [2.0, 0.0])  # cls+1, bg
+    np.testing.assert_allclose(loc_t[0, :4], 0.0, atol=1e-5)
+    np.testing.assert_allclose(loc_m[0], [1, 1, 1, 1, 0, 0, 0, 0])
+
+    # detection: feed probabilities; matching box should decode back
+    cls_prob = np.array(
+        [[[0.1, 0.9], [0.9, 0.05], [0.0, 0.05]]], np.float32
+    )  # (B=1, cls+1=3, A=2): anchor0 fg class0 p=.9
+    loc_pred = np.zeros((1, 8), np.float32)
+    det = nd.MultiBoxDetection(
+        nd.array(cls_prob), nd.array(loc_pred), nd.array(anchors)
+    ).asnumpy()
+    assert det.shape == (1, 2, 6)
+    best = det[0, 0]
+    assert best[0] == 0.0 and best[1] > 0.8
+    np.testing.assert_allclose(best[2:], anchors[0, 0], atol=1e-5)
+
+
+def test_multibox_detection_nms_suppresses():
+    anchors = np.array(
+        [[[0.1, 0.1, 0.4, 0.4], [0.11, 0.11, 0.41, 0.41]]], np.float32
+    )
+    cls_prob = np.array(
+        [[[0.1, 0.2], [0.9, 0.8]]], np.float32
+    )  # both mostly class 0 fg
+    loc_pred = np.zeros((1, 8), np.float32)
+    det = nd.MultiBoxDetection(
+        nd.array(cls_prob), nd.array(loc_pred), nd.array(anchors),
+        nms_threshold=0.5,
+    ).asnumpy()
+    # second (overlapping, lower score) suppressed
+    assert det[0, 0, 0] == 0.0
+    assert det[0, 1, 0] == -1.0
+
+
+def test_proposal_shapes():
+    b, k, h, w = 1, 3, 4, 4
+    rs = np.random.RandomState(0)
+    cls_prob = rs.rand(b, 2 * k, h, w).astype(np.float32)
+    bbox_pred = (rs.rand(b, 4 * k, h, w).astype(np.float32) - 0.5) * 0.1
+    im_info = np.array([[64, 64, 1.0]], np.float32)
+    rois = nd.Proposal(
+        nd.array(cls_prob), nd.array(bbox_pred), nd.array(im_info),
+        feature_stride=16, scales=(8.0,), ratios=(0.5, 1.0, 2.0),
+        rpn_pre_nms_top_n=48, rpn_post_nms_top_n=8, rpn_min_size=4,
+    ).asnumpy()
+    assert rois.shape == (8, 5)
+    assert (rois[:, 0] == 0).all()
+    assert (rois[:, 1:] >= 0).all()
+    assert (rois[:, [1, 3]] <= 64).all() and (rois[:, [2, 4]] <= 64).all()
+
+
+def test_fft_ifft_roundtrip():
+    x = np.random.RandomState(2).rand(3, 8).astype(np.float32)
+    y = nd.fft(nd.array(x)).asnumpy()
+    assert y.shape == (3, 16)
+    ref = np.fft.fft(x, axis=-1)
+    np.testing.assert_allclose(y[:, 0::2], ref.real, atol=1e-4)
+    np.testing.assert_allclose(y[:, 1::2], ref.imag, atol=1e-4)
+    back = nd.ifft(nd.array(y)).asnumpy()
+    np.testing.assert_allclose(back, x * 8, rtol=1e-4, atol=1e-4)
+
+
+def test_count_sketch():
+    x = np.array([[1.0, 2.0, 3.0]], np.float32)
+    h = np.array([[0, 1, 0]], np.float32)
+    s = np.array([[1, -1, 1]], np.float32)
+    out = nd.count_sketch(
+        nd.array(x), nd.array(h), nd.array(s), out_dim=2
+    ).asnumpy()
+    np.testing.assert_allclose(out, [[4.0, -2.0]])
+
+
+def test_quantize_dequantize():
+    x = np.array([[0.0, 0.5, 1.0]], np.float32)
+    q, mn, mx_ = nd.quantize(
+        nd.array(x), nd.array([0.0]), nd.array([1.0])
+    )
+    np.testing.assert_allclose(q.asnumpy(), [[0, 128, 255]])
+    back = nd.dequantize(q, mn, mx_).asnumpy()
+    np.testing.assert_allclose(back, x, atol=1e-2)

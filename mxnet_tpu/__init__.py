@@ -36,6 +36,9 @@ from . import lr_scheduler
 from . import metric
 from . import callback
 from . import io
+from . import recordio
+from . import image
+from . import native
 from . import kvstore as kv
 from . import kvstore
 from . import model

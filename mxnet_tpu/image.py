@@ -284,6 +284,38 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     return auglist
 
 
+class _NativePrefetchRecord(object):
+    """MXRecordIO-compatible facade over the native prefetching reader."""
+
+    def __init__(self, path, capacity=64):
+        from . import native as _native
+
+        self._native = _native
+        self._path = path
+        self._r = _native.NativePrefetchReader(path, capacity)
+
+    def read(self):
+        return self._r.read()
+
+    def reset(self):
+        self._r.close()
+        self._r = self._native.NativePrefetchReader(self._path)
+
+    def close(self):
+        self._r.close()
+
+
+def _open_sequential_rec(path):
+    try:
+        from . import native as _native
+
+        if _native.available():
+            return _NativePrefetchRecord(path)
+    except Exception:
+        pass
+    return recordio.MXRecordIO(path, "r")
+
+
 class ImageIter(_io.DataIter):
     """Image iterator over .rec files and/or raw image lists with
     augmenters (reference image.py:293-460 + C++ ImageRecordIter)."""
@@ -302,7 +334,10 @@ class ImageIter(_io.DataIter):
                     path_imgidx, path_imgrec, "r")
                 self.imgidx = list(self.imgrec.keys)
             else:
-                self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
+                # sequential scan: prefer the native C++ prefetching
+                # reader (background read-ahead thread, native/
+                # recordio_core.cc — the iter_prefetcher.h analog)
+                self.imgrec = _open_sequential_rec(path_imgrec)
                 self.imgidx = None
         else:
             self.imgrec = None

@@ -1,0 +1,190 @@
+"""ctypes bindings for the native IO core (native/recordio_core.cc).
+
+Compiles the shared library on first use (g++ -O2 -shared; cached next
+to the source, rebuilt when the source is newer). pybind11 is not in
+the image, so the ABI is plain C consumed via ctypes — the same pattern
+as the reference's Python-over-C-API layering (python/mxnet/base.py
+dlopens libmxnet).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+from .base import MXNetError
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "native",
+)
+_SRC = os.path.join(_NATIVE_DIR, "recordio_core.cc")
+_SO = os.path.join(_NATIVE_DIR, "librecordio_core.so")
+
+_lib = None
+_lock = threading.Lock()
+
+
+def _build():
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        _SRC, "-o", _SO,
+    ]
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True
+    )
+    if proc.returncode != 0:
+        raise MXNetError(
+            f"native build failed: {' '.join(cmd)}\n{proc.stderr}"
+        )
+
+
+def get_lib():
+    """Load (building if needed) the native library."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SRC):
+            raise MXNetError(f"native source missing: {_SRC}")
+        if (
+            not os.path.exists(_SO)
+            or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+        ):
+            _build()
+        lib = ctypes.CDLL(_SO)
+        lib.rio_reader_open.restype = ctypes.c_void_p
+        lib.rio_reader_open.argtypes = [ctypes.c_char_p]
+        lib.rio_reader_next.restype = ctypes.c_int64
+        lib.rio_reader_next.argtypes = [ctypes.c_void_p]
+        lib.rio_reader_fetch.restype = None
+        lib.rio_reader_fetch.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8)
+        ]
+        lib.rio_reader_close.restype = None
+        lib.rio_reader_close.argtypes = [ctypes.c_void_p]
+        lib.rio_build_index.restype = ctypes.c_int64
+        lib.rio_build_index.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int64,
+        ]
+        lib.rio_prefetcher_start.restype = ctypes.c_void_p
+        lib.rio_prefetcher_start.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int
+        ]
+        lib.rio_prefetcher_next.restype = ctypes.c_int64
+        lib.rio_prefetcher_next.argtypes = [ctypes.c_void_p]
+        lib.rio_prefetcher_fetch.restype = None
+        lib.rio_prefetcher_fetch.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8)
+        ]
+        lib.rio_prefetcher_stop.restype = None
+        lib.rio_prefetcher_stop.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available():
+    try:
+        get_lib()
+        return True
+    except Exception:
+        return False
+
+
+class NativeRecordReader(object):
+    """Sequential framed reader over the native core."""
+
+    def __init__(self, path):
+        lib = get_lib()
+        self._lib = lib
+        self._h = lib.rio_reader_open(path.encode())
+        if not self._h:
+            raise MXNetError(f"cannot open {path}")
+
+    def read(self):
+        """Next record bytes, or None at EOF."""
+        n = self._lib.rio_reader_next(self._h)
+        if n == -2:
+            raise MXNetError("corrupt recordio file")
+        if n == -1:
+            return None
+        if n == 0:
+            return b""
+        buf = (ctypes.c_uint8 * n)()
+        self._lib.rio_reader_fetch(self._h, buf)
+        return bytes(buf)
+
+    def close(self):
+        if self._h:
+            self._lib.rio_reader_close(self._h)
+            self._h = None
+
+    def __iter__(self):
+        while True:
+            rec = self.read()
+            if rec is None:
+                return
+            yield rec
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativePrefetchReader(object):
+    """Background-thread prefetching reader (the iter_prefetcher.h
+    analog): the native worker reads ahead into a bounded queue while
+    Python consumes."""
+
+    def __init__(self, path, capacity=64, loop=False):
+        lib = get_lib()
+        self._lib = lib
+        self._h = lib.rio_prefetcher_start(
+            path.encode(), capacity, 1 if loop else 0
+        )
+        if not self._h:
+            raise MXNetError(f"cannot start prefetcher on {path}")
+
+    def read(self):
+        n = self._lib.rio_prefetcher_next(self._h)
+        if n < 0:
+            return None
+        if n == 0:
+            return b""
+        buf = (ctypes.c_uint8 * n)()
+        self._lib.rio_prefetcher_fetch(self._h, buf)
+        return bytes(buf)
+
+    def __iter__(self):
+        while True:
+            rec = self.read()
+            if rec is None:
+                return
+            yield rec
+
+    def close(self):
+        if self._h:
+            self._lib.rio_prefetcher_stop(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def build_index(path, max_records=1 << 24):
+    """Offsets of every record (reference MXIndexedRecordIO .idx)."""
+    lib = get_lib()
+    buf = (ctypes.c_uint64 * max_records)()
+    n = lib.rio_build_index(path.encode(), buf, max_records)
+    if n < 0:
+        raise MXNetError(f"cannot index {path}")
+    return list(buf[: min(n, max_records)])

@@ -1,0 +1,273 @@
+// Native IO core: RecordIO framing + threaded prefetching reader.
+//
+// TPU-native replacement for the reference's C++ IO stack capability
+// (src/io/: dmlc recordio framing, iter_prefetcher.h background
+// prefetch thread, dmlc ConcurrentBlockingQueue). The compute path is
+// XLA; this is the host-side runtime piece that keeps the input
+// pipeline off the Python GIL: a worker pool reads and frames records
+// into a bounded blocking queue while the trainer consumes batches.
+//
+// Format (matches mxnet_tpu/recordio.py, which mirrors the dmlc
+// format): record = [magic:4][lrec:4][payload][pad to 4], where lrec's
+// top 3 bits are a continuation flag (1=start, 2=middle, 3=end of a
+// multi-part record whose payload contained the magic) and the low 29
+// bits the part length. Multi-part records are rejoined with the magic
+// inserted between parts.
+//
+// C ABI only (consumed via ctypes; pybind11 not available in image).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+constexpr uint32_t kLenMask = (1u << 29) - 1;
+
+inline uint32_t dec_flag(uint32_t lrec) { return (lrec >> 29) & 7u; }
+inline uint32_t dec_len(uint32_t lrec) { return lrec & kLenMask; }
+
+// ------------------------------------------------------- framed reader
+
+struct Reader {
+  FILE* f = nullptr;
+  std::string err;
+
+  bool ReadWord(uint32_t* out) {
+    return std::fread(out, sizeof(uint32_t), 1, f) == 1;
+  }
+
+  // Read one logical record (rejoining continuations). Returns false on
+  // clean EOF; sets err on corruption.
+  bool Next(std::vector<uint8_t>* out) {
+    out->clear();
+    uint32_t magic;
+    if (!ReadWord(&magic)) return false;  // EOF
+    if (magic != kMagic) {
+      err = "bad magic";
+      return false;
+    }
+    bool more = true;
+    bool first = true;
+    while (more) {
+      if (!first) {
+        // continuation parts are separated by the magic in the payload
+        out->insert(out->end(), reinterpret_cast<const uint8_t*>(&kMagic),
+                    reinterpret_cast<const uint8_t*>(&kMagic) + 4);
+      }
+      uint32_t lrec;
+      if (!ReadWord(&lrec)) {
+        err = "truncated record header";
+        return false;
+      }
+      uint32_t len = dec_len(lrec);
+      uint32_t flag = dec_flag(lrec);
+      size_t base = out->size();
+      out->resize(base + len);
+      if (len && std::fread(out->data() + base, 1, len, f) != len) {
+        err = "truncated payload";
+        return false;
+      }
+      uint32_t pad = (4 - (len & 3)) & 3;
+      if (pad) std::fseek(f, pad, SEEK_CUR);
+      if (flag == 0 || flag == 3) {
+        more = false;  // single-part or final part
+      } else {
+        // expect next part to begin with magic
+        uint32_t m2;
+        if (!ReadWord(&m2) || m2 != kMagic) {
+          err = "missing continuation magic";
+          return false;
+        }
+      }
+      first = false;
+    }
+    return true;
+  }
+};
+
+// -------------------------------------------- bounded blocking queue
+
+class BlockingQueue {
+ public:
+  explicit BlockingQueue(size_t cap) : cap_(cap) {}
+
+  // returns false if queue was shut down
+  bool Push(std::vector<uint8_t>&& v) {
+    std::unique_lock<std::mutex> lk(m_);
+    cv_push_.wait(lk, [&] { return q_.size() < cap_ || done_; });
+    if (done_) return false;
+    q_.emplace_back(std::move(v));
+    cv_pop_.notify_one();
+    return true;
+  }
+
+  // returns false when drained AND no producer remains
+  bool Pop(std::vector<uint8_t>* out) {
+    std::unique_lock<std::mutex> lk(m_);
+    cv_pop_.wait(lk, [&] { return !q_.empty() || producers_ == 0 || done_; });
+    if (q_.empty()) return false;
+    *out = std::move(q_.front());
+    q_.pop_front();
+    cv_push_.notify_one();
+    return true;
+  }
+
+  void AddProducer() {
+    std::lock_guard<std::mutex> lk(m_);
+    ++producers_;
+  }
+
+  void RemoveProducer() {
+    std::lock_guard<std::mutex> lk(m_);
+    if (--producers_ == 0) cv_pop_.notify_all();
+  }
+
+  void Shutdown() {
+    std::lock_guard<std::mutex> lk(m_);
+    done_ = true;
+    cv_push_.notify_all();
+    cv_pop_.notify_all();
+  }
+
+ private:
+  size_t cap_;
+  std::mutex m_;
+  std::condition_variable cv_push_, cv_pop_;
+  std::deque<std::vector<uint8_t>> q_;
+  int producers_ = 0;
+  bool done_ = false;
+};
+
+// ------------------------------------------------------- prefetcher
+
+struct Prefetcher {
+  BlockingQueue queue;
+  std::thread worker;
+  std::atomic<bool> stop{false};
+  std::string path;
+  bool loop;
+
+  Prefetcher(const char* p, size_t capacity, bool loop_)
+      : queue(capacity), path(p), loop(loop_) {
+    // register the producer BEFORE the worker thread starts so a
+    // consumer Pop cannot observe producers_==0 and report EOF early
+    queue.AddProducer();
+  }
+
+  void Run() {
+    do {
+      Reader r;
+      r.f = std::fopen(path.c_str(), "rb");
+      if (!r.f) break;
+      std::vector<uint8_t> rec;
+      while (!stop.load() && r.Next(&rec)) {
+        if (!queue.Push(std::move(rec))) break;
+        rec.clear();
+      }
+      std::fclose(r.f);
+    } while (loop && !stop.load());
+    queue.RemoveProducer();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---- framed sequential reader ----
+
+void* rio_reader_open(const char* path) {
+  auto* r = new Reader();
+  r->f = std::fopen(path, "rb");
+  if (!r->f) {
+    delete r;
+    return nullptr;
+  }
+  return r;
+}
+
+// Returns payload length (>= 0), -1 on EOF, -2 on error. Caller then
+// calls rio_reader_fetch to copy the payload out. next+fetch must be
+// paired on the same thread (g_last is thread_local).
+static thread_local std::vector<uint8_t> g_last;
+
+int64_t rio_reader_next(void* h) {
+  auto* r = static_cast<Reader*>(h);
+  if (!r->Next(&g_last)) {
+    return r->err.empty() ? -1 : -2;
+  }
+  return static_cast<int64_t>(g_last.size());
+}
+
+void rio_reader_fetch(void* h, uint8_t* buf) {
+  (void)h;
+  std::memcpy(buf, g_last.data(), g_last.size());
+}
+
+void rio_reader_close(void* h) {
+  auto* r = static_cast<Reader*>(h);
+  if (r->f) std::fclose(r->f);
+  delete r;
+}
+
+// ---- index builder: offsets of each logical record ----
+
+// Fills offsets (caller-allocated, cap entries); returns record count
+// or -1 on error. If count > cap only cap offsets are written.
+int64_t rio_build_index(const char* path, uint64_t* offsets,
+                        int64_t cap) {
+  Reader r;
+  r.f = std::fopen(path, "rb");
+  if (!r.f) return -1;
+  int64_t n = 0;
+  std::vector<uint8_t> rec;
+  for (;;) {
+    long pos = std::ftell(r.f);
+    if (!r.Next(&rec)) break;
+    if (n < cap) offsets[n] = static_cast<uint64_t>(pos);
+    ++n;
+  }
+  std::fclose(r.f);
+  return r.err.empty() ? n : -1;
+}
+
+// ---- threaded prefetcher ----
+
+void* rio_prefetcher_start(const char* path, int64_t capacity,
+                           int loop) {
+  auto* p = new Prefetcher(path, static_cast<size_t>(capacity),
+                           loop != 0);
+  p->worker = std::thread([p] { p->Run(); });
+  return p;
+}
+
+// Pops the next record into g_last; same protocol as rio_reader_next.
+int64_t rio_prefetcher_next(void* h) {
+  auto* p = static_cast<Prefetcher*>(h);
+  if (!p->queue.Pop(&g_last)) return -1;
+  return static_cast<int64_t>(g_last.size());
+}
+
+void rio_prefetcher_fetch(void* h, uint8_t* buf) {
+  (void)h;
+  std::memcpy(buf, g_last.data(), g_last.size());
+}
+
+void rio_prefetcher_stop(void* h) {
+  auto* p = static_cast<Prefetcher*>(h);
+  p->stop.store(true);
+  p->queue.Shutdown();
+  if (p->worker.joinable()) p->worker.join();
+  delete p;
+}
+
+}  // extern "C"

@@ -1,0 +1,132 @@
+"""Native IO tests: C++ recordio framing vs the Python implementation,
+threaded prefetcher, index builder, im2rec packing, and the
+ImageRecordIter pipeline end to end (reference coverage:
+tests/python/unittest/test_recordio.py + test_io.py)."""
+import os
+import struct
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import native, recordio
+
+MAGIC = struct.pack("<I", 0xCED7230A)
+
+
+@pytest.fixture(scope="module")
+def rec_file(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("rio") / "t.rec")
+    recs = [
+        b"hello",
+        b"x" * 1000,
+        MAGIC + b"tail" + MAGIC,   # multi-part (payload contains magic)
+        b"",
+        b"end",
+    ]
+    w = recordio.MXRecordIO(path, "w")
+    for r in recs:
+        w.write(r)
+    w.close()
+    return path, recs
+
+
+def test_native_reader_matches_python(rec_file):
+    path, recs = rec_file
+    assert list(native.NativeRecordReader(path)) == recs
+    # python reader agrees
+    r = recordio.MXRecordIO(path, "r")
+    got = []
+    while True:
+        s = r.read()
+        if s is None:
+            break
+        got.append(s)
+    assert got == recs
+
+
+def test_native_prefetcher(rec_file):
+    path, recs = rec_file
+    for _ in range(3):  # no startup race
+        assert list(native.NativePrefetchReader(path, capacity=2)) == recs
+
+
+def test_native_index(rec_file):
+    path, recs = rec_file
+    offsets = native.build_index(path)
+    assert len(offsets) == len(recs)
+    assert offsets[0] == 0
+    # offsets strictly increasing
+    assert all(a < b for a, b in zip(offsets, offsets[1:]))
+
+
+def test_im2rec_and_image_record_iter(tmp_path):
+    from PIL import Image
+
+    # build a tiny labeled image tree
+    root = tmp_path / "imgs"
+    for cls in ("a", "b"):
+        (root / cls).mkdir(parents=True)
+        for i in range(6):
+            arr = np.full(
+                (12, 12, 3),
+                40 if cls == "a" else 200, np.uint8,
+            )
+            Image.fromarray(arr).save(root / cls / f"{i}.jpg")
+
+    prefix = str(tmp_path / "data")
+    im2rec = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools", "im2rec.py",
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    subprocess.run(
+        [sys.executable, im2rec, prefix, str(root), "--list",
+         "--recursive"],
+        check=True, env=env,
+    )
+    subprocess.run(
+        [sys.executable, im2rec, prefix, str(root)], check=True, env=env,
+    )
+    assert os.path.exists(prefix + ".rec")
+    assert os.path.exists(prefix + ".idx")
+
+    it = mx.image.ImageRecordIter(
+        path_imgrec=prefix + ".rec", data_shape=(3, 8, 8),
+        batch_size=4, rand_crop=False, rand_mirror=False,
+    )
+    nbatch = 0
+    labels = []
+    for batch in it:
+        assert batch.data[0].shape == (4, 3, 8, 8)
+        labels.extend(batch.label[0].asnumpy().tolist())
+        nbatch += 1
+    assert nbatch == 3  # 12 images / 4
+    assert set(labels) == {0.0, 1.0}
+
+
+def test_native_reader_used_for_sequential(tmp_path):
+    """The sequential .rec path goes through the native prefetcher."""
+    path = str(tmp_path / "x.rec")
+    w = recordio.MXRecordIO(path, "w")
+    header = recordio.IRHeader(0, 1.0, 0, 0)
+    from PIL import Image
+    import io as _pyio
+
+    buf = _pyio.BytesIO()
+    Image.fromarray(
+        np.zeros((8, 8, 3), np.uint8)
+    ).save(buf, format="JPEG")
+    w.write(recordio.pack(header, buf.getvalue()))
+    w.close()
+    from mxnet_tpu.image import _open_sequential_rec, _NativePrefetchRecord
+
+    r = _open_sequential_rec(path)
+    assert isinstance(r, _NativePrefetchRecord)
+    assert r.read() is not None
+    assert r.read() is None
+    r.reset()
+    assert r.read() is not None
+    r.close()

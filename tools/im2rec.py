@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""im2rec: pack an image folder / list file into RecordIO (+ index).
+
+Capability parity with the reference packing tools (tools/im2rec.py and
+tools/im2rec.cc): build a .lst of (index, label, path), then encode
+images into .rec records of IRHeader+JPEG, with an .idx for shuffling /
+sharding. Decode/encode uses PIL (the image already ships it; the
+reference used OpenCV).
+
+Usage:
+  python tools/im2rec.py prefix image_root [--list] [--recursive]
+  python tools/im2rec.py prefix image_root            # pack from prefix.lst
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from mxnet_tpu import recordio  # noqa: E402
+
+_EXTS = {".jpg", ".jpeg", ".png", ".bmp"}
+
+
+def list_images(root, recursive):
+    i = 0
+    cat = {}
+    if recursive:
+        for path, dirs, files in sorted(os.walk(root)):
+            dirs.sort()
+            files.sort()
+            for fname in files:
+                if os.path.splitext(fname)[1].lower() not in _EXTS:
+                    continue
+                label_dir = os.path.relpath(path, root).split(os.sep)[0]
+                if label_dir not in cat:
+                    cat[label_dir] = len(cat)
+                rel = os.path.relpath(os.path.join(path, fname), root)
+                yield i, rel, cat[label_dir]
+                i += 1
+    else:
+        for fname in sorted(os.listdir(root)):
+            if os.path.splitext(fname)[1].lower() in _EXTS:
+                yield i, fname, 0
+                i += 1
+
+
+def write_list(prefix, root, recursive=False, shuffle=False,
+               train_ratio=1.0):
+    items = list(list_images(root, recursive))
+    if shuffle:
+        random.shuffle(items)
+    sep = int(len(items) * train_ratio)
+    outs = (
+        [(prefix + ".lst", items)]
+        if train_ratio >= 1.0
+        else [
+            (prefix + "_train.lst", items[:sep]),
+            (prefix + "_val.lst", items[sep:]),
+        ]
+    )
+    for fname, part in outs:
+        with open(fname, "w") as f:
+            for i, (idx, rel, label) in enumerate(part):
+                f.write(f"{i}\t{label}\t{rel}\n")
+
+
+def read_list(path_in):
+    with open(path_in) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx = int(parts[0])
+            label = [float(x) for x in parts[1:-1]]
+            yield idx, parts[-1], label
+
+
+def pack(prefix, root, quality=95, resize=0):
+    """Pack prefix.lst into prefix.rec + prefix.idx."""
+    from PIL import Image
+    import io as _pyio
+    import numpy as np
+
+    lst = prefix + ".lst"
+    rec = recordio.MXIndexedRecordIO(
+        prefix + ".idx", prefix + ".rec", "w"
+    )
+    count = 0
+    for idx, rel, label in read_list(lst):
+        path = os.path.join(root, rel)
+        img = Image.open(path).convert("RGB")
+        if resize:
+            w, h = img.size
+            if w < h:
+                img = img.resize(
+                    (resize, int(h * resize / w)), Image.BILINEAR
+                )
+            else:
+                img = img.resize(
+                    (int(w * resize / h), resize), Image.BILINEAR
+                )
+        buf = _pyio.BytesIO()
+        img.save(buf, format="JPEG", quality=quality)
+        header = recordio.IRHeader(
+            0, label[0] if len(label) == 1 else np.asarray(label),
+            idx, 0,
+        )
+        rec.write_idx(idx, recordio.pack(header, buf.getvalue()))
+        count += 1
+    rec.close()
+    print(f"packed {count} images into {prefix}.rec")
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix")
+    ap.add_argument("root")
+    ap.add_argument("--list", action="store_true",
+                    help="generate the .lst instead of packing")
+    ap.add_argument("--recursive", action="store_true")
+    ap.add_argument("--shuffle", action="store_true")
+    ap.add_argument("--train-ratio", type=float, default=1.0)
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--resize", type=int, default=0)
+    args = ap.parse_args()
+    if args.list:
+        write_list(
+            args.prefix, args.root, recursive=args.recursive,
+            shuffle=args.shuffle, train_ratio=args.train_ratio,
+        )
+    else:
+        pack(
+            args.prefix, args.root, quality=args.quality,
+            resize=args.resize,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -46,6 +46,8 @@ from . import executor_manager
 from . import feed_forward
 from .feed_forward import FeedForward
 from . import rtc
+from . import predictor
+from .predictor import Predictor
 from . import module
 from . import module as mod
 from . import parallel

@@ -71,13 +71,20 @@ class Context:
 
 
 def _devices_for_platform(platform: str):
+    # process-LOCAL devices: under jax.distributed each process may only
+    # place data on its own devices (global jax.devices() lists peers'
+    # devices too, which are not addressable here)
     try:
-        return jax.devices(platform)
+        return [
+            d for d in jax.local_devices() if d.platform == platform
+        ] or jax.devices(platform)
     except RuntimeError:
         # Experimental TPU tunnels may register under a different platform
         # name; treat any non-cpu accelerator as satisfying 'tpu'.
         if platform == "tpu":
-            accel = [d for d in jax.devices() if d.platform != "cpu"]
+            accel = [
+                d for d in jax.local_devices() if d.platform != "cpu"
+            ]
             return accel
         return []
 

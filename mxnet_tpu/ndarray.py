@@ -625,6 +625,17 @@ def save(fname, data):
             f.write(kb)
 
 
+def load_frombuffer(buf):
+    """Load NDArrays from an in-memory container (reference
+    MXNDArrayLoadFromBuffer, src/c_api/c_api.cc)."""
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".params") as tf:
+        tf.write(buf)
+        tf.flush()
+        return load(tf.name)
+
+
 def load(fname):
     with open(fname, "rb") as f:
         magic, _ = struct.unpack("<QQ", f.read(16))

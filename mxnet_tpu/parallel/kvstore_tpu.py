@@ -38,13 +38,104 @@ import jax.numpy as jnp
 
 from .. import optimizer as opt
 from ..base import MXNetError
-from ..kvstore import KVStore
+from ..kvstore import KVStore, _ctype_key_value, _str_key
+from ..ndarray import NDArray
+
+_dist_initialized = False
+
+
+def maybe_init_distributed():
+    """Initialize jax.distributed from launcher env vars (the analog of
+    ps-lite's InitPSEnv from DMLC_* env vars, kvstore_dist.h:37):
+    MXNET_TPU_COORDINATOR, MXNET_TPU_NUM_WORKERS, MXNET_TPU_WORKER_ID —
+    set by tools/launch.py. No-ops when absent or already initialized."""
+    global _dist_initialized
+    if _dist_initialized:
+        return
+    import os
+
+    coord = os.environ.get("MXNET_TPU_COORDINATOR")
+    n = os.environ.get("MXNET_TPU_NUM_WORKERS")
+    wid = os.environ.get("MXNET_TPU_WORKER_ID")
+    if coord and n and wid:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(n),
+            process_id=int(wid),
+        )
+        _dist_initialized = True
 
 
 class KVStoreTPU(KVStore):
     def __init__(self, kv_type="tpu"):
         super().__init__(kv_type)
+        maybe_init_distributed()
         self._barrier_count = 0
+
+    # --------------------------------------------------- dist push/pull
+    _first_collective_done = False
+
+    @staticmethod
+    def _align_processes(tag):
+        """Coordination-service barrier (no data-plane collectives):
+        lines processes up before the first gloo/ICI collective so
+        per-process jit-compile skew can't exceed the collective
+        context-init deadline. The analog of ps::Postoffice::Barrier
+        at startup (kvstore_dist.h:41)."""
+        try:
+            from jax._src import distributed
+
+            client = distributed.global_state.client
+            if client is not None:
+                client.wait_at_barrier(
+                    f"mxnet_tpu_kv_{tag}", timeout_in_ms=600_000
+                )
+        except Exception:
+            pass
+
+    def _cross_process_sum(self, merged):
+        """Sum the locally-merged value across worker processes — the
+        replacement for ZPush-to-servers + MergeBuf accumulation
+        (kvstore_dist.h:216-230, kvstore_dist_server.h:183). Lowered to
+        an all-gather+sum collective over DCN/ICI rather than zmq."""
+        if jax.process_count() == 1:
+            return merged
+        from jax.experimental import multihost_utils
+
+        if not KVStoreTPU._first_collective_done:
+            self._align_processes("first_allgather")
+            KVStoreTPU._first_collective_done = True
+        # host-staged: committed per-process device arrays can't be
+        # globalized directly; gather the host value then re-place
+        host = merged.asnumpy()
+        g = multihost_utils.process_allgather(host)
+        return NDArray(
+            jnp.asarray(jnp.sum(jnp.asarray(g), axis=0)),
+            ctx=merged.context,
+        )
+
+    def push(self, key, value, priority=0):
+        """Local device reduce, then cross-process all-reduce, then the
+        updater once on the merged value (sync-mode semantics: every
+        worker sees the identical merged gradient, so running the
+        updater everywhere equals the reference's run-once-on-server,
+        kvstore_dist_server.h:136-229)."""
+        keys, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            if k not in self._store:
+                raise MXNetError(f"key {k!r} not initialized")
+            merged = vlist[0]
+            if len(vlist) > 1:
+                dev = vlist[0].context.jax_device()
+                acc = vlist[0]._data
+                for v in vlist[1:]:
+                    acc = acc + jax.device_put(v._data, dev)
+                merged = NDArray(acc, ctx=vlist[0].context)
+            merged = self._cross_process_sum(merged)
+            if self._updater is not None:
+                self._updater(_str_key(k), merged, self._store[k])
+            else:
+                merged.copyto(self._store[k])
 
     @property
     def rank(self):

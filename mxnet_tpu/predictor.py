@@ -1,0 +1,139 @@
+"""Predict-only API (reference src/c_api/c_predict_api.cc +
+include/mxnet/c_predict_api.h): standalone inference from a saved
+symbol JSON + parameter blob, without the training machinery. The
+reference exposes a flat C ABI for embedding (amalgamation builds);
+here the deployable artifact is the same two files, loaded into a
+compiled jit forward — `Predictor` mirrors the C API's verbs
+(SetInput/Forward/GetOutput/Reshape, PartialOut via output_index).
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from . import ndarray as nd
+from . import symbol as sym
+from .base import MXNetError
+from .context import cpu
+
+
+class Predictor(object):
+    """MXPredCreate analog: symbol JSON + params -> bound forward-only
+    executor (c_predict_api.cc MXPredCreatePartialOut)."""
+
+    def __init__(self, symbol_json, param_data, input_shapes, ctx=None,
+                 output_names=None, dev_type="cpu", dev_id=0):
+        if ctx is None:
+            ctx = cpu(dev_id)
+        self._ctx = ctx
+        symbol = (
+            sym.loads(symbol_json)
+            if isinstance(symbol_json, str)
+            else symbol_json
+        )
+        if output_names:
+            # partial-output extraction: rebind on internal outputs
+            internals = symbol.get_internals()
+            outs = [
+                internals[n if n.endswith("_output") else n + "_output"]
+                for n in output_names
+            ]
+            symbol = sym.Group(outs) if len(outs) > 1 else outs[0]
+        self._symbol = symbol
+
+        if isinstance(param_data, (bytes, bytearray)):
+            params = nd.load_frombuffer(bytes(param_data))
+        elif isinstance(param_data, str):
+            params = nd.load(param_data)
+        else:
+            params = dict(param_data)
+        arg_params, aux_params = {}, {}
+        for k, v in params.items():
+            if k.startswith("arg:"):
+                arg_params[k[4:]] = v
+            elif k.startswith("aux:"):
+                aux_params[k[4:]] = v
+            else:
+                arg_params[k] = v
+        self._arg_params = arg_params
+        self._aux_params = aux_params
+        self._input_shapes = dict(input_shapes)
+        self._bind()
+
+    def _bind(self):
+        symbol = self._symbol
+        arg_shapes, _, aux_shapes = symbol.infer_shape(
+            **self._input_shapes
+        )
+        args = {}
+        for name, shape in zip(symbol.list_arguments(), arg_shapes):
+            if name in self._input_shapes:
+                args[name] = nd.zeros(shape, ctx=self._ctx)
+            elif name in self._arg_params:
+                args[name] = self._arg_params[name].copyto(self._ctx) \
+                    if hasattr(self._arg_params[name], "copyto") \
+                    else nd.array(self._arg_params[name], ctx=self._ctx)
+            else:
+                # args that are neither inputs nor saved params (label
+                # inputs of output layers) bind to zeros: inference
+                # ignores them (SoftmaxOutput forward doesn't read the
+                # label)
+                args[name] = nd.zeros(shape, ctx=self._ctx)
+        auxs = {}
+        for name, shape in zip(
+            symbol.list_auxiliary_states(), aux_shapes
+        ):
+            if name in self._aux_params:
+                auxs[name] = nd.array(
+                    self._aux_params[name], ctx=self._ctx
+                )
+            else:
+                auxs[name] = nd.zeros(shape, ctx=self._ctx)
+        self._exec = symbol.bind(
+            self._ctx, args=args,
+            grad_req={k: "null" for k in symbol.list_arguments()},
+            aux_states=auxs,
+        )
+
+    # ----------------------------------------------------- C-API verbs
+    def set_input(self, name, data):
+        """MXPredSetInput."""
+        if name not in self._input_shapes:
+            raise MXNetError(f"{name!r} is not an input")
+        self._exec.arg_dict[name][:] = np.asarray(data, np.float32)
+
+    def forward(self):
+        """MXPredForward."""
+        self._exec.forward(is_train=False)
+
+    def get_output(self, index=0):
+        """MXPredGetOutput -> numpy."""
+        return self._exec.outputs[index].asnumpy()
+
+    @property
+    def num_outputs(self):
+        return len(self._exec.outputs)
+
+    def get_output_shape(self, index=0):
+        """MXPredGetOutputShape."""
+        return tuple(self._exec.outputs[index].shape)
+
+    def reshape(self, new_input_shapes):
+        """MXPredReshapePartialOut: rebind with new input shapes,
+        keeping loaded parameters."""
+        self._input_shapes = dict(new_input_shapes)
+        self._bind()
+
+    @staticmethod
+    def from_checkpoint(prefix, epoch, input_shapes, ctx=None,
+                        output_names=None):
+        """Convenience: load `prefix-symbol.json` +
+        `prefix-%04d.params` (the save_checkpoint artifact)."""
+        with open(f"{prefix}-symbol.json") as f:
+            symbol_json = f.read()
+        params = nd.load(f"{prefix}-{epoch:04d}.params")
+        return Predictor(
+            symbol_json, params, input_shapes, ctx=ctx,
+            output_names=output_names,
+        )

@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Distributed sync-KVStore arithmetic check, run as N local worker
+processes via tools/launch.py (the reference's CI pattern:
+tests/nightly/dist_sync_kvstore.py launched with --launcher local,
+tools/launch.py:49-52).
+
+Each worker pushes rank-dependent values; after a synchronized push the
+pulled value must equal the sum over workers on every process.
+"""
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+)
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    rank = kv.rank
+    nworker = kv.num_workers
+    assert nworker == int(os.environ["MXNET_TPU_NUM_WORKERS"])
+
+    shape = (3, 4)
+    keys = ["k1", "k2"]
+    for k in keys:
+        kv.init(k, mx.nd.zeros(shape))
+
+    # push rank-dependent values; sync store must sum them
+    for k in keys:
+        kv.push(k, mx.nd.ones(shape) * (rank + 1))
+    expected = sum(r + 1 for r in range(nworker))
+    for k in keys:
+        out = mx.nd.zeros(shape)
+        kv.pull(k, out=out)
+        np.testing.assert_allclose(
+            out.asnumpy(), np.full(shape, expected, np.float32)
+        )
+
+    # multi-device push from each worker (device copies sum locally
+    # first, then across workers)
+    kv2 = mx.kv.create("dist_sync")
+    key = "multi"
+    kv.init(key, mx.nd.zeros(shape))
+    kv.push(key, [mx.nd.ones(shape), mx.nd.ones(shape)])
+    out = mx.nd.zeros(shape)
+    kv.pull(key, out=out)
+    np.testing.assert_allclose(
+        out.asnumpy(), np.full(shape, 2 * nworker, np.float32)
+    )
+
+    print(f"worker {rank}/{nworker}: dist_sync_kvstore OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

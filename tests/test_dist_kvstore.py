@@ -1,0 +1,33 @@
+"""Multi-process distributed KVStore test — the reference CI pattern of
+launching dist tests as local processes (tests/nightly/
+dist_sync_kvstore.py via tools/launch.py --launcher local,
+tools/launch.py:49-52)."""
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_dist_sync_kvstore_two_workers():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    # each worker is a fresh interpreter; don't inherit the test
+    # process's virtual 8-device flag (workers default to 1 device)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(ROOT, "tools", "launch.py"),
+            "-n", "2",
+            sys.executable,
+            os.path.join(ROOT, "tests", "nightly",
+                         "dist_sync_kvstore.py"),
+        ],
+        env=env, capture_output=True, text=True, timeout=360,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count("dist_sync_kvstore OK") == 2, (
+        proc.stdout + proc.stderr
+    )

@@ -1,0 +1,64 @@
+"""Predict-only API tests (reference c_predict_api usage:
+tests around MXPredCreate / SetInput / Forward / GetOutput and the
+partial-output path)."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _trained_checkpoint(tmp_path):
+    rs = np.random.RandomState(0)
+    X = rs.rand(128, 6).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(
+            mx.sym.Variable("data"), num_hidden=2, name="fc"
+        ),
+        name="softmax",
+    )
+    mod = mx.mod.Module(net, context=mx.cpu())
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod.fit(it, num_epoch=3, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.3})
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 3)
+    return prefix, net, mod, X
+
+
+def test_predictor_matches_module(tmp_path):
+    prefix, net, mod, X = _trained_checkpoint(tmp_path)
+    pred = mx.Predictor.from_checkpoint(
+        prefix, 3, {"data": (32, 6)}
+    )
+    pred.set_input("data", X[:32])
+    pred.forward()
+    out = pred.get_output(0)
+    assert out.shape == (32, 2)
+
+    it = mx.io.NDArrayIter(X[:32], None, batch_size=32)
+    ref = mod.predict(it).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_reshape(tmp_path):
+    prefix, *_ = _trained_checkpoint(tmp_path)
+    pred = mx.Predictor.from_checkpoint(
+        prefix, 3, {"data": (32, 6)}
+    )
+    pred.reshape({"data": (8, 6)})
+    pred.set_input("data", np.zeros((8, 6), np.float32))
+    pred.forward()
+    assert pred.get_output_shape(0) == (8, 2)
+
+
+def test_predictor_partial_output(tmp_path):
+    prefix, *_ = _trained_checkpoint(tmp_path)
+    with open(prefix + "-symbol.json") as f:
+        sj = f.read()
+    params = mx.nd.load(prefix + "-0003.params")
+    pred = mx.Predictor(
+        sj, params, {"data": (4, 6)}, output_names=["fc"]
+    )
+    pred.set_input("data", np.ones((4, 6), np.float32))
+    pred.forward()
+    assert pred.get_output(0).shape == (4, 2)  # pre-softmax fc output

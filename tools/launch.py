@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Cluster launcher (reference tools/launch.py over dmlc-core trackers).
+
+TPU-native: there are no server/scheduler processes to launch — only N
+worker processes that join a jax.distributed coordination service. The
+'local' launcher (the one the reference's CI uses for distributed tests,
+tools/launch.py:49-52) spawns N local processes with
+MXNET_TPU_COORDINATOR / MXNET_TPU_NUM_WORKERS / MXNET_TPU_WORKER_ID env
+vars; KVStore('dist_sync') picks them up (parallel/kvstore_tpu.py
+maybe_init_distributed). For real multi-host TPU pods, the platform's
+own process-per-host launcher plays this role and jax.distributed
+auto-detects — pass --launcher none to just exec the command.
+
+Usage:
+  python tools/launch.py -n 2 python tests/nightly/dist_sync_kvstore.py
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("--launcher", default="local",
+                    choices=["local", "none"])
+    ap.add_argument("--env", action="append", default=[],
+                    help="extra KEY=VALUE for workers")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command given")
+
+    if args.launcher == "none":
+        os.execvp(args.command[0], args.command)
+
+    port = _free_port()
+    procs = []
+    for wid in range(args.num_workers):
+        env = dict(os.environ)
+        env["MXNET_TPU_COORDINATOR"] = f"127.0.0.1:{port}"
+        env["MXNET_TPU_NUM_WORKERS"] = str(args.num_workers)
+        env["MXNET_TPU_WORKER_ID"] = str(wid)
+        # worker processes on one host must not fight over the TPU
+        # tunnel; multi-process CI runs are CPU-collective tests
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env.setdefault("PALLAS_AXON_POOL_IPS", "")
+        for kv in args.env:
+            k, _, v = kv.partition("=")
+            env[k] = v
+        procs.append(subprocess.Popen(args.command, env=env))
+
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

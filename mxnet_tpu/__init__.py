@@ -35,6 +35,7 @@ from . import optimizer
 from . import lr_scheduler
 from . import metric
 from . import callback
+from . import engine
 from . import io
 from . import recordio
 from . import image

@@ -1,0 +1,138 @@
+"""Dependency engine — host-side async executor.
+
+The reference's ThreadedEngine (include/mxnet/engine.h:75-250,
+src/engine/threaded_engine.cc) schedules every kernel; on TPU, XLA owns
+device scheduling, so the engine's remaining job (SURVEY.md §7) is
+host-side: overlap IO, checkpoint writes, metric host work with device
+compute under the same correctness model — ops declare read/write vars,
+writers are exclusive and ordered, readers run concurrently.
+
+Engines (selected by MXNET_ENGINE_TYPE like the reference's factory,
+src/engine/engine.cc:14-38):
+  ThreadedEngine — native C++ worker pool (native/engine_core.cc)
+  NaiveEngine    — synchronous, executes on the calling thread
+                   (reference src/engine/naive_engine.cc debugging aid)
+"""
+from __future__ import annotations
+
+import ctypes
+import itertools
+import os
+import threading
+
+from .base import MXNetError
+from . import native as _native
+
+_CALLBACK_T = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
+class Var(object):
+    __slots__ = ("id",)
+
+    def __init__(self, vid):
+        self.id = vid
+
+
+class ThreadedEngine(object):
+    """Native threaded dependency engine."""
+
+    def __init__(self, num_workers=4):
+        lib = _native.get_lib_engine()
+        self._lib = lib
+        self._h = lib.eng_create(num_workers)
+        self._cbs = {}
+        self._ticket = itertools.count()
+        self._lock = threading.Lock()
+
+    def new_variable(self):
+        return Var(self._lib.eng_new_var(self._h))
+
+    def push(self, fn, read_vars=(), write_vars=()):
+        """Run fn() once all declared deps resolve (reference
+        Engine::PushAsync, engine.h:147). Vars may not appear in both
+        lists (reference CheckDuplicate, threaded_engine.cc:207)."""
+        rset = {v.id for v in read_vars}
+        wset = {v.id for v in write_vars}
+        if rset & wset:
+            raise MXNetError(
+                "a var cannot be both read and write dependency"
+            )
+        tid = next(self._ticket)
+
+        def trampoline(_arg, _tid=tid, _fn=fn):
+            try:
+                _fn()
+            finally:
+                with self._lock:
+                    self._cbs.pop(_tid, None)
+
+        cb = _CALLBACK_T(trampoline)
+        with self._lock:
+            self._cbs[tid] = cb
+        reads = (ctypes.c_uint64 * max(1, len(rset)))(*sorted(rset))
+        writes = (ctypes.c_uint64 * max(1, len(wset)))(*sorted(wset))
+        self._lib.eng_push(
+            self._h, cb, None, reads, len(rset), writes, len(wset)
+        )
+
+    def wait_for_all(self):
+        self._lib.eng_wait_all(self._h)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.eng_wait_all(self._h)
+                self._lib.eng_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
+class NaiveEngine(object):
+    """Synchronous engine: push executes immediately (reference
+    naive_engine.cc:102)."""
+
+    def __init__(self, num_workers=1):
+        self._n = itertools.count()
+
+    def new_variable(self):
+        return Var(next(self._n))
+
+    def push(self, fn, read_vars=(), write_vars=()):
+        rset = {v.id for v in read_vars}
+        wset = {v.id for v in write_vars}
+        if rset & wset:
+            raise MXNetError(
+                "a var cannot be both read and write dependency"
+            )
+        fn()
+
+    def wait_for_all(self):
+        pass
+
+
+_engine = None
+_engine_lock = threading.Lock()
+
+
+def get():
+    """Singleton engine, type from MXNET_ENGINE_TYPE (reference
+    Engine::Get + factory, src/engine/engine.cc:42)."""
+    global _engine
+    if _engine is None:
+        with _engine_lock:
+            if _engine is None:
+                kind = os.environ.get(
+                    "MXNET_ENGINE_TYPE", "ThreadedEngine"
+                )
+                workers = int(
+                    os.environ.get("MXNET_CPU_WORKER_NTHREADS", "4")
+                )
+                if kind == "NaiveEngine":
+                    _engine = NaiveEngine()
+                else:
+                    try:
+                        _engine = ThreadedEngine(workers)
+                    except Exception:
+                        _engine = NaiveEngine()
+    return _engine

@@ -11,3 +11,5 @@ from .resnet import get_resnet
 from .alexnet import get_alexnet
 from .inception import get_inception_bn
 from .vgg import get_vgg
+from .lstm_lm import get_lstm_lm, lstm_lm_sym_gen
+from .ssd import get_ssd_train, get_ssd_detect

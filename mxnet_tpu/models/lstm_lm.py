@@ -1,0 +1,45 @@
+"""LSTM language model (reference example/rnn/lstm_bucketing.py /
+rnn/rnn.py training graph shape): embedding -> stacked fused LSTM ->
+per-step softmax over the vocabulary. Built on the fused RNN op
+(ops/rnn_op.py), the lax.scan analog of the reference's cuDNN path."""
+from .. import symbol as sym
+from ..rnn import FusedRNNCell
+
+
+def get_lstm_lm(vocab_size, num_embed=128, num_hidden=256,
+                num_layers=2, seq_len=32, dropout=0.0,
+                fused=True):
+    """Returns (symbol, data_names, label_names); data layout NT."""
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    embed = sym.Embedding(
+        data, input_dim=vocab_size, output_dim=num_embed, name="embed"
+    )
+    cell = FusedRNNCell(
+        num_hidden, num_layers=num_layers, mode="lstm",
+        dropout=dropout, prefix="lstm_",
+    )
+    outputs, _ = cell.unroll(
+        seq_len, inputs=embed, layout="NTC", merge_outputs=True
+    )
+    pred = sym.Reshape(outputs, shape=(-1, num_hidden))
+    pred = sym.FullyConnected(
+        pred, num_hidden=vocab_size, name="pred"
+    )
+    label_flat = sym.Reshape(label, shape=(-1,))
+    out = sym.SoftmaxOutput(pred, label_flat, name="softmax")
+    return out, ("data",), ("softmax_label",)
+
+
+def lstm_lm_sym_gen(vocab_size, num_embed=128, num_hidden=256,
+                    num_layers=2, dropout=0.0):
+    """sym_gen for BucketingModule: bucket_key = sequence length
+    (reference lstm_bucketing.py sym_gen)."""
+
+    def sym_gen(seq_len):
+        return get_lstm_lm(
+            vocab_size, num_embed=num_embed, num_hidden=num_hidden,
+            num_layers=num_layers, seq_len=seq_len, dropout=dropout,
+        )
+
+    return sym_gen

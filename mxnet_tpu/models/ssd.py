@@ -1,0 +1,110 @@
+"""SSD single-shot detector (reference example/ssd/symbol_builder.py
+structure over the contrib multibox ops src/operator/contrib/
+multibox_*.cc): a small VGG-ish backbone, multi-scale feature maps,
+per-scale class + box heads, MultiBoxPrior anchors; training graph wires
+MultiBoxTarget into SoftmaxOutput + smooth-L1, inference graph ends in
+MultiBoxDetection."""
+from .. import symbol as sym
+
+
+def _conv_block(data, name, num_filter, pool=True):
+    c = sym.Convolution(
+        data, name=f"{name}_conv", kernel=(3, 3), pad=(1, 1),
+        num_filter=num_filter,
+    )
+    a = sym.Activation(c, act_type="relu", name=f"{name}_relu")
+    if pool:
+        return sym.Pooling(
+            a, pool_type="max", kernel=(2, 2), stride=(2, 2),
+            name=f"{name}_pool",
+        )
+    return a
+
+
+def _multi_scale_features(data, filters=(32, 64, 128)):
+    feats = []
+    x = data
+    for i, f in enumerate(filters):
+        x = _conv_block(x, f"stage{i}", f)
+        feats.append(x)
+    return feats
+
+
+def _heads(feats, num_classes, sizes, ratios):
+    """Per-scale prediction heads -> (cls_preds, loc_preds, anchors)."""
+    cls_list, loc_list, anchor_list = [], [], []
+    for i, feat in enumerate(feats):
+        k = len(sizes[i]) + len(ratios[i]) - 1
+        cls = sym.Convolution(
+            feat, kernel=(3, 3), pad=(1, 1),
+            num_filter=k * (num_classes + 1), name=f"cls_head{i}",
+        )
+        # (N, K*(C+1), H, W) -> (N, A_i, C+1)
+        cls = sym.transpose(cls, axes=(0, 2, 3, 1))
+        cls = sym.Reshape(cls, shape=(0, -1, num_classes + 1))
+        cls_list.append(cls)
+        loc = sym.Convolution(
+            feat, kernel=(3, 3), pad=(1, 1), num_filter=k * 4,
+            name=f"loc_head{i}",
+        )
+        loc = sym.transpose(loc, axes=(0, 2, 3, 1))
+        loc = sym.Reshape(loc, shape=(0, -1))
+        loc_list.append(loc)
+        anchor_list.append(
+            sym.MultiBoxPrior(
+                feat, sizes=sizes[i], ratios=ratios[i], clip=True,
+                name=f"anchors{i}",
+            )
+        )
+    cls_preds = sym.Concat(*cls_list, dim=1, name="cls_preds")
+    cls_preds = sym.transpose(cls_preds, axes=(0, 2, 1))  # (N, C+1, A)
+    loc_preds = sym.Concat(*loc_list, dim=1, name="loc_preds")
+    anchors = sym.Concat(*anchor_list, dim=1, name="anchors")
+    return cls_preds, loc_preds, anchors
+
+
+_DEFAULT_SIZES = ((0.2, 0.272), (0.37, 0.447), (0.54, 0.619))
+_DEFAULT_RATIOS = ((1.0, 2.0, 0.5),) * 3
+
+
+def get_ssd_train(num_classes=2, filters=(32, 64, 128),
+                  sizes=_DEFAULT_SIZES, ratios=_DEFAULT_RATIOS):
+    """Training symbol: outputs [cls_prob, loc_loss, cls_target] like
+    the reference training net (example/ssd/symbol_builder.py)."""
+    data = sym.Variable("data")
+    label = sym.Variable("label")
+    feats = _multi_scale_features(data, filters)
+    cls_preds, loc_preds, anchors = _heads(
+        feats, num_classes, sizes, ratios
+    )
+    loc_target, loc_mask, cls_target = sym.MultiBoxTarget(
+        anchors, label, cls_preds, overlap_threshold=0.5,
+        ignore_label=-1, negative_mining_ratio=3.0, name="target",
+    )
+    cls_prob = sym.SoftmaxOutput(
+        cls_preds, cls_target, multi_output=True,
+        use_ignore=True, ignore_label=-1, name="cls_prob",
+    )
+    loc_diff = loc_mask * (loc_preds - loc_target)
+    loc_loss = sym.MakeLoss(
+        sym.smooth_l1(loc_diff, scalar=1.0), name="loc_loss"
+    )
+    return sym.Group(
+        [cls_prob, loc_loss, sym.BlockGrad(cls_target)]
+    )
+
+
+def get_ssd_detect(num_classes=2, filters=(32, 64, 128),
+                   sizes=_DEFAULT_SIZES, ratios=_DEFAULT_RATIOS,
+                   nms_threshold=0.5, force_suppress=False):
+    """Inference symbol ending in MultiBoxDetection -> (N, A, 6)."""
+    data = sym.Variable("data")
+    feats = _multi_scale_features(data, filters)
+    cls_preds, loc_preds, anchors = _heads(
+        feats, num_classes, sizes, ratios
+    )
+    cls_prob = sym.softmax(cls_preds, axis=1, name="cls_prob")
+    return sym.MultiBoxDetection(
+        cls_prob, loc_preds, anchors, nms_threshold=nms_threshold,
+        force_suppress=force_suppress, name="detection",
+    )

@@ -95,6 +95,57 @@ def available():
         return False
 
 
+_ENGINE_SRC = os.path.join(_NATIVE_DIR, "engine_core.cc")
+_ENGINE_SO = os.path.join(_NATIVE_DIR, "libengine_core.so")
+_engine_lib = None
+
+
+def get_lib_engine():
+    """Load (building if needed) the native dependency engine
+    (native/engine_core.cc)."""
+    global _engine_lib
+    if _engine_lib is not None:
+        return _engine_lib
+    with _lock:
+        if _engine_lib is not None:
+            return _engine_lib
+        if not os.path.exists(_ENGINE_SRC):
+            raise MXNetError(f"native source missing: {_ENGINE_SRC}")
+        if (
+            not os.path.exists(_ENGINE_SO)
+            or os.path.getmtime(_ENGINE_SO)
+            < os.path.getmtime(_ENGINE_SRC)
+        ):
+            proc = subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                 "-pthread", _ENGINE_SRC, "-o", _ENGINE_SO],
+                capture_output=True, text=True,
+            )
+            if proc.returncode != 0:
+                raise MXNetError(
+                    f"native engine build failed:\n{proc.stderr}"
+                )
+        lib = ctypes.CDLL(_ENGINE_SO)
+        lib.eng_create.restype = ctypes.c_void_p
+        lib.eng_create.argtypes = [ctypes.c_int]
+        lib.eng_new_var.restype = ctypes.c_uint64
+        lib.eng_new_var.argtypes = [ctypes.c_void_p]
+        lib.eng_push.restype = None
+        lib.eng_push.argtypes = [
+            ctypes.c_void_p,
+            ctypes.CFUNCTYPE(None, ctypes.c_void_p),
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+        ]
+        lib.eng_wait_all.restype = None
+        lib.eng_wait_all.argtypes = [ctypes.c_void_p]
+        lib.eng_destroy.restype = None
+        lib.eng_destroy.argtypes = [ctypes.c_void_p]
+        _engine_lib = lib
+        return _engine_lib
+
+
 class NativeRecordReader(object):
     """Sequential framed reader over the native core."""
 

@@ -1,0 +1,217 @@
+// Host-side dependency engine.
+//
+// The reference's ThreadedEngine (src/engine/threaded_engine.{h,cc} +
+// threaded_engine_perdevice.cc) schedules EVERY kernel; on TPU, XLA's
+// async dispatch owns device scheduling, so this engine survives in the
+// role SURVEY.md §7 assigns it: the host-side executor that overlaps
+// IO, checkpoint writes, and other host work with device compute, with
+// the same correctness model — ops declare read-vars and write-vars,
+// an op runs once every declared dependency is resolved, concurrent
+// readers are allowed, writers are exclusive and ordered.
+//
+// Design (fresh, not a translation): each var owns a FIFO of grant
+// blocks; a block is either one writer or a group of readers. An op
+// waits on a countdown of ungranted vars; granting the last var moves
+// it to the worker pool's ready queue. Completion releases each var,
+// advancing its queue. C ABI for ctypes; callbacks into Python acquire
+// the GIL via ctypes' callback machinery.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+using Callback = void (*)(void*);
+
+struct Op;
+
+struct ReaderBlock {
+  bool is_write = false;
+  std::vector<Op*> ops;  // readers (many) or one writer
+};
+
+struct Var {
+  std::deque<ReaderBlock> queue;
+  int active = 0;        // currently granted ops on the head block
+  bool head_granted = false;
+};
+
+struct Op {
+  Callback fn;
+  void* arg;
+  std::atomic<int> waiting{0};
+  std::vector<uint64_t> reads, writes;
+};
+
+class Engine {
+ public:
+  explicit Engine(int num_workers) {
+    if (num_workers < 1) num_workers = 1;
+    for (int i = 0; i < num_workers; ++i) {
+      workers_.emplace_back([this] { WorkerLoop(); });
+    }
+  }
+
+  ~Engine() {
+    WaitAll();
+    {
+      std::lock_guard<std::mutex> lk(m_);
+      shutdown_ = true;
+      cv_ready_.notify_all();
+    }
+    for (auto& t : workers_) t.join();
+  }
+
+  uint64_t NewVar() {
+    std::lock_guard<std::mutex> lk(m_);
+    uint64_t id = next_var_++;
+    vars_.emplace(id, Var{});
+    return id;
+  }
+
+  void Push(Callback fn, void* arg, const uint64_t* reads, int nread,
+            const uint64_t* writes, int nwrite) {
+    auto* op = new Op();
+    op->fn = fn;
+    op->arg = arg;
+    op->reads.assign(reads, reads + nread);
+    op->writes.assign(writes, writes + nwrite);
+    // dedup rule (reference engine.h:231-249 CheckDuplicate): a var in
+    // writes must not also appear in reads
+    {
+      std::lock_guard<std::mutex> lk(m_);
+      ++inflight_;
+      int ndeps = nread + nwrite;
+      op->waiting.store(ndeps + 1);  // +1 sentinel released below
+      for (int i = 0; i < nread; ++i) Enqueue(op, reads[i], false);
+      for (int i = 0; i < nwrite; ++i) Enqueue(op, writes[i], true);
+      // sentinel: covers the zero-dependency / all-granted-inline case
+      if (op->waiting.fetch_sub(1) == 1) Ready(op);
+    }
+  }
+
+  void WaitAll() {
+    std::unique_lock<std::mutex> lk(m_);
+    cv_done_.wait(lk, [&] { return inflight_ == 0; });
+  }
+
+ private:
+  // called with m_ held
+  void Enqueue(Op* op, uint64_t var_id, bool is_write) {
+    Var& v = vars_[var_id];
+    bool granted = false;
+    if (is_write) {
+      if (v.queue.empty() && v.active == 0) {
+        // nothing pending: grant immediately as an exclusive head
+        v.queue.push_back({true, {op}});
+        v.head_granted = true;
+        v.active = 1;
+        granted = true;
+      } else {
+        v.queue.push_back({true, {op}});
+      }
+    } else {
+      if (v.queue.empty() && v.active == 0) {
+        v.queue.push_back({false, {op}});
+        v.head_granted = true;
+        v.active = 1;
+        granted = true;
+      } else if (!v.queue.empty() && !v.queue.back().is_write &&
+                 v.queue.size() == 1 && v.head_granted) {
+        // join the currently-granted reader group at the head
+        v.queue.back().ops.push_back(op);
+        ++v.active;
+        granted = true;
+      } else if (!v.queue.empty() && !v.queue.back().is_write) {
+        v.queue.back().ops.push_back(op);
+      } else {
+        v.queue.push_back({false, {op}});
+      }
+    }
+    if (granted) Grant(op);
+  }
+
+  // called with m_ held
+  void Grant(Op* op) {
+    if (op->waiting.fetch_sub(1) == 1) Ready(op);
+  }
+
+  // called with m_ held
+  void Ready(Op* op) {
+    ready_.push_back(op);
+    cv_ready_.notify_one();
+  }
+
+  // called with m_ held
+  void Release(uint64_t var_id) {
+    Var& v = vars_[var_id];
+    if (--v.active == 0) {
+      v.queue.pop_front();
+      v.head_granted = false;
+      if (!v.queue.empty()) {
+        v.head_granted = true;
+        v.active = static_cast<int>(v.queue.front().ops.size());
+        for (Op* o : v.queue.front().ops) Grant(o);
+      }
+    }
+  }
+
+  void WorkerLoop() {
+    for (;;) {
+      Op* op = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(m_);
+        cv_ready_.wait(lk, [&] { return !ready_.empty() || shutdown_; });
+        if (shutdown_ && ready_.empty()) return;
+        op = ready_.front();
+        ready_.pop_front();
+      }
+      op->fn(op->arg);  // Python callback: ctypes re-acquires the GIL
+      {
+        std::lock_guard<std::mutex> lk(m_);
+        for (uint64_t r : op->reads) Release(r);
+        for (uint64_t w : op->writes) Release(w);
+        if (--inflight_ == 0) cv_done_.notify_all();
+      }
+      delete op;
+    }
+  }
+
+  std::mutex m_;
+  std::condition_variable cv_ready_, cv_done_;
+  std::deque<Op*> ready_;
+  std::unordered_map<uint64_t, Var> vars_;
+  std::vector<std::thread> workers_;
+  uint64_t next_var_ = 1;
+  int inflight_ = 0;
+  bool shutdown_ = false;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* eng_create(int num_workers) { return new Engine(num_workers); }
+
+uint64_t eng_new_var(void* h) {
+  return static_cast<Engine*>(h)->NewVar();
+}
+
+void eng_push(void* h, void (*fn)(void*), void* arg,
+              const uint64_t* reads, int nread,
+              const uint64_t* writes, int nwrite) {
+  static_cast<Engine*>(h)->Push(fn, arg, reads, nread, writes, nwrite);
+}
+
+void eng_wait_all(void* h) { static_cast<Engine*>(h)->WaitAll(); }
+
+void eng_destroy(void* h) { delete static_cast<Engine*>(h); }
+
+}  // extern "C"

@@ -1,0 +1,90 @@
+"""Model-zoo tests: each family builds, forwards, and (for the new
+SSD/LSTM-LM additions) trains a step (reference
+example/image-classification + example/ssd + example/rnn parity)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+def test_lstm_lm_forward_backward():
+    net, data_names, label_names = models.get_lstm_lm(
+        vocab_size=20, num_embed=8, num_hidden=16, num_layers=2,
+        seq_len=5,
+    )
+    ex = net.simple_bind(
+        ctx=mx.cpu(), data=(4, 5), softmax_label=(4, 5),
+        grad_req="write",
+    )
+    rs = np.random.RandomState(0)
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr[:] = rs.uniform(-0.1, 0.1, arr.shape)
+    out = ex.forward(
+        is_train=True,
+        data=rs.randint(0, 20, (4, 5)).astype(np.float32),
+        softmax_label=rs.randint(0, 20, (4, 5)).astype(np.float32),
+    )
+    assert out[0].shape == (20, 20)  # (4*5, vocab)
+    ex.backward()
+    g = ex.grad_dict["lstm_parameters"].asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_ssd_train_step():
+    net = models.get_ssd_train(num_classes=2, filters=(8, 16))
+    b = 2
+    ex = net.simple_bind(
+        ctx=mx.cpu(), data=(b, 3, 32, 32), label=(b, 2, 5),
+        grad_req="write",
+    )
+    rs = np.random.RandomState(1)
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "label"):
+            arr[:] = rs.uniform(-0.1, 0.1, arr.shape)
+    label = np.full((b, 2, 5), -1.0, np.float32)
+    label[0, 0] = [0, 0.2, 0.2, 0.6, 0.6]  # one gt box, class 0
+    outs = ex.forward(
+        is_train=True,
+        data=rs.rand(b, 3, 32, 32).astype(np.float32),
+        label=label,
+    )
+    cls_prob, loc_loss, cls_target = outs
+    assert cls_prob.shape[1] == 3  # classes + background
+    assert np.isfinite(loc_loss.asnumpy()).all()
+    # at least the forced match must be positive
+    assert (cls_target.asnumpy() > 0).sum() >= 1
+    ex.backward()
+    g = ex.grad_dict["cls_head0_weight"].asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_ssd_detect_shapes():
+    net = models.get_ssd_detect(num_classes=2, filters=(8, 16))
+    ex = net.simple_bind(ctx=mx.cpu(), data=(1, 3, 32, 32))
+    rs = np.random.RandomState(2)
+    for name, arr in ex.arg_dict.items():
+        if name != "data":
+            arr[:] = rs.uniform(-0.1, 0.1, arr.shape)
+    det = ex.forward(
+        data=rs.rand(1, 3, 32, 32).astype(np.float32)
+    )[0].asnumpy()
+    assert det.ndim == 3 and det.shape[2] == 6
+    # scores within [0, 1]; suppressed rows flagged -1
+    kept = det[det[:, :, 0] >= 0]
+    if kept.size:
+        assert (kept[:, 1] >= 0).all() and (kept[:, 1] <= 1).all()
+
+
+def test_classification_zoo_forward():
+    for build, shape in [
+        (lambda: models.get_mlp(), (2, 784)),
+        (lambda: models.get_lenet(), (2, 1, 28, 28)),
+    ]:
+        net = build()
+        ex = net.simple_bind(
+            ctx=mx.cpu(), data=shape,
+            softmax_label=(shape[0],), grad_req="null",
+        )
+        out = ex.forward()
+        assert out[0].shape[0] == shape[0]

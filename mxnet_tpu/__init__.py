@@ -55,6 +55,11 @@ from . import parallel
 from . import rnn
 from . import operator
 from . import test_utils
+from . import utils
+from . import attribute
+from . import name
+from . import torch_bridge
+from .torch_bridge import th
 from . import monitor as _monitor_mod
 from .monitor import Monitor
 from . import profiler

@@ -1,0 +1,7 @@
+"""Attribute scoping (reference python/mxnet/attribute.py): AttrScope
+context manager applying attrs (ctx_group, lr_mult, ...) to symbols
+created within. Canonical implementation lives in symbol.py; re-exported
+here for API parity."""
+from .symbol import AttrScope  # noqa: F401
+
+current = AttrScope

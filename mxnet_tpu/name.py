@@ -1,0 +1,6 @@
+"""Name management (reference python/mxnet/name.py): NameManager/Prefix
+control auto-generated symbol names. Canonical implementation lives in
+symbol.py; re-exported here for API parity."""
+from .symbol import Prefix  # noqa: F401
+
+NameManager = Prefix

@@ -1,0 +1,84 @@
+"""Torch bridge (reference python/mxnet/torch.py + plugin/torch/):
+call torch tensor functions on NDArrays. The reference shipped a
+compiled TorchModule/TorchCriterion bridge; here torch (CPU build in
+the image) interoperates at the array level — NDArray <-> torch.Tensor
+zero-copy via numpy where possible — and `th.<fn>` applies any torch
+function to NDArrays, returning NDArrays."""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray, array
+
+
+def _torch():
+    try:
+        import torch
+
+        return torch
+    except ImportError as e:  # pragma: no cover
+        raise MXNetError("torch is not available") from e
+
+
+def to_torch(x):
+    """NDArray -> torch.Tensor (host copy)."""
+    torch = _torch()
+    if isinstance(x, NDArray):
+        return torch.from_numpy(x.asnumpy())
+    return torch.as_tensor(x)
+
+
+def from_torch(t, ctx=None):
+    """torch.Tensor -> NDArray."""
+    return array(np.asarray(t.detach().cpu().numpy()), ctx=ctx)
+
+
+class _TorchNamespace(object):
+    """th.add(a, b), th.nn.functional.relu(x), ... on NDArrays."""
+
+    def __init__(self, mod=None):
+        self._mod = mod
+
+    def __getattr__(self, name):
+        torch = _torch()
+        target = getattr(self._mod or torch, name)
+        if callable(target):
+            def wrapped(*args, **kwargs):
+                conv = [
+                    to_torch(a) if isinstance(a, NDArray) else a
+                    for a in args
+                ]
+                out = target(*conv, **kwargs)
+                torch_mod = _torch()
+                if isinstance(out, torch_mod.Tensor):
+                    return from_torch(out)
+                if isinstance(out, (list, tuple)):
+                    return type(out)(
+                        from_torch(o)
+                        if isinstance(o, torch_mod.Tensor) else o
+                        for o in out
+                    )
+                return out
+
+            return wrapped
+        # submodule (e.g. th.nn.functional)
+        return _TorchNamespace(target)
+
+
+th = _TorchNamespace()
+
+
+def torch_module(module):
+    """Wrap a torch.nn.Module as a callable on NDArrays (the
+    TorchModule plugin capability, plugin/torch/torch_module-inl.h)."""
+    def call(*inputs):
+        torch = _torch()
+        tins = [to_torch(x) for x in inputs]
+        with torch.no_grad():
+            out = module(*tins)
+        if isinstance(out, torch.Tensor):
+            return from_torch(out)
+        return [from_torch(o) for o in out]
+
+    return call

@@ -1,0 +1,103 @@
+"""Utilities: the runtime config/flag system.
+
+The reference reads ~25 MXNET_* env vars via dmlc::GetEnv at point of
+use (docs/how_to/env_var.md; SURVEY.md §5 config tiers). Here every
+supported variable is declared in one registry with type, default, and
+help, read through typed getters — `mxnet_tpu.utils.getenv(name)` —
+so `describe_env()` prints the live configuration (the env_var.md
+analog, generated instead of hand-written).
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from ..base import MXNetError
+
+
+@dataclass
+class EnvVar:
+    name: str
+    type: type
+    default: object
+    help: str
+
+
+_ENV_REGISTRY: dict[str, EnvVar] = {}
+
+
+def register_env(name, type_, default, help_):
+    _ENV_REGISTRY[name] = EnvVar(name, type_, default, help_)
+
+
+def getenv(name):
+    """Typed read of a registered MXNET_* variable."""
+    if name not in _ENV_REGISTRY:
+        raise MXNetError(f"unknown env var {name!r}")
+    spec = _ENV_REGISTRY[name]
+    raw = os.environ.get(name)
+    if raw is None:
+        return spec.default
+    if spec.type is bool:
+        return raw not in ("0", "false", "False", "")
+    return spec.type(raw)
+
+
+def describe_env():
+    """All registered vars with current values (env_var.md analog)."""
+    lines = []
+    for spec in sorted(_ENV_REGISTRY.values(), key=lambda s: s.name):
+        cur = getenv(spec.name)
+        lines.append(
+            f"{spec.name}={cur!r} (default {spec.default!r}) — "
+            f"{spec.help}"
+        )
+    return "\n".join(lines)
+
+
+# ---- the supported surface (reference docs/how_to/env_var.md) ----
+register_env(
+    "MXNET_ENGINE_TYPE", str, "ThreadedEngine",
+    "host-side engine implementation: ThreadedEngine | NaiveEngine "
+    "(reference src/engine/engine.cc:14)",
+)
+register_env(
+    "MXNET_CPU_WORKER_NTHREADS", int, 4,
+    "worker threads of the host engine / data pipeline "
+    "(reference env_var.md)",
+)
+register_env(
+    "MXNET_KVSTORE_REDUCTION_NTHREADS", int, 4,
+    "threads for CPU-side gradient reduction (reference comm.h)",
+)
+register_env(
+    "MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN", int, 0,
+    "unused: XLA compiles the whole graph as one computation (the "
+    "logical endpoint of the reference's bulk-exec segments, "
+    "graph_executor.cc:678); kept for CLI compat",
+)
+register_env(
+    "MXNET_ENABLE_GPU_P2P", bool, True,
+    "unused on TPU (ICI is always peer-to-peer); kept for CLI compat",
+)
+register_env(
+    "MXNET_TPU_COORDINATOR", str, "",
+    "jax.distributed coordinator address (set by tools/launch.py)",
+)
+register_env(
+    "MXNET_TPU_NUM_WORKERS", int, 1,
+    "worker process count (set by tools/launch.py)",
+)
+register_env(
+    "MXNET_TPU_WORKER_ID", int, 0,
+    "this process's worker id (set by tools/launch.py)",
+)
+register_env(
+    "MXNET_TPU_XLA_TRACE_DIR", str, "",
+    "when set, profiler_set_state('run') also captures an XLA device "
+    "trace via jax.profiler into this directory",
+)
+register_env(
+    "MXNET_EXEC_NUM_TEMP", int, 1,
+    "unused: XLA plans temp buffers (reference resource.cc); compat",
+)

@@ -17,3 +17,5 @@ from .mesh import (
 from .kvstore_tpu import KVStoreTPU
 from .attention import attention, attention_reference
 from .ring_attention import ring_attention, ulysses_attention
+from .pipeline import pipeline_apply
+from .moe import moe_ffn, top1_gating, init_moe_params

@@ -1,0 +1,98 @@
+"""Pipeline parallelism over a mesh 'pipe' axis.
+
+New capability vs the reference (SURVEY.md §2.5: its only model
+parallelism was ctx-group graph surgery with _CrossDeviceCopy inserts,
+graph_executor.cc:242-318, example/model-parallel-lstm). TPU-native
+design: every stage's weights live on its own mesh slice; microbatches
+stream through the ring with `lax.ppermute` activations transfers (ICI
+neighbor hops) under `shard_map` — the standard GPipe-style schedule
+expressed as a collective program, compiled once by XLA.
+
+The schedule: with S stages and M microbatches, run S+M-1 ticks; at
+tick t, stage s processes microbatch t-s (bubble at the ends). Each
+device holds ONE stage; the activation buffer rotates by one stage per
+tick.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def _stage_apply(fn, params, x, stage_idx):
+    """Apply the per-stage fn with this device's stage params."""
+    return fn(params, x, stage_idx)
+
+
+def pipeline_apply(fn, stage_params, microbatches, mesh,
+                   axis_name="pipe"):
+    """Run a pipeline of S stages over M microbatches.
+
+    fn(params_for_stage, x, stage_index) -> y   (same shape as x)
+    stage_params: pytree whose leaves have leading dim S (stage-major;
+      sharded over `axis_name`).
+    microbatches: (M, ...) array of microbatch inputs (replicated).
+    Returns (M, ...) outputs after the last stage.
+    """
+    s = mesh.shape[axis_name]
+    m = microbatches.shape[0]
+
+    def shard_fn(params, mb):
+        # params leaves: (1, ...) local stage slice; mb: (M, ...) full
+        idx = jax.lax.axis_index(axis_name)
+        local = jax.tree_util.tree_map(lambda p: p[0], params)
+        ticks = s + m - 1
+        x_shape = mb.shape[1:]
+        buf = jnp.zeros(x_shape, mb.dtype)  # activation held here
+        buf = jax.lax.pcast(buf, (axis_name,), to="varying")
+        outs = jnp.zeros((m,) + x_shape, mb.dtype)
+        outs = jax.lax.pcast(outs, (axis_name,), to="varying")
+
+        def tick(t, carry):
+            buf, outs = carry
+            # stage 0 ingests microbatch t; other stages use the
+            # activation that just arrived from the left neighbor
+            mb_idx = jnp.clip(t, 0, m - 1)
+            x_in = jnp.where(
+                idx == 0,
+                mb[mb_idx],
+                buf,
+            )
+            active = (t - idx >= 0) & (t - idx < m)
+            y = _stage_apply(fn, local, x_in, idx)
+            y = jnp.where(active, y, buf)
+            # last stage writes its finished microbatch t-(S-1)
+            done_idx = jnp.clip(t - (s - 1), 0, m - 1)
+            write = (idx == s - 1) & (t >= s - 1)
+            outs = jnp.where(
+                write,
+                outs.at[done_idx].set(y),
+                outs,
+            )
+            # rotate activations one stage to the right
+            perm = [(i, (i + 1) % s) for i in range(s)]
+            buf_next = jax.lax.ppermute(y, axis_name, perm)
+            return buf_next, outs
+
+        buf, outs = jax.lax.fori_loop(0, ticks, tick, (buf, outs))
+        # only the last stage holds real outputs; broadcast them
+        outs = jax.lax.psum(
+            jnp.where(idx == s - 1, outs, jnp.zeros_like(outs)),
+            axis_name,
+        )
+        return outs
+
+    spec_params = jax.tree_util.tree_map(
+        lambda _: P(axis_name), stage_params
+    )
+    fn_sharded = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(spec_params, P()),
+        out_specs=P(),
+    )
+    return fn_sharded(stage_params, microbatches)

@@ -1,0 +1,132 @@
+"""Pipeline-parallel and MoE/expert-parallel tests on the virtual CPU
+mesh (new capabilities mandated by SURVEY.md §2.5/§5)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.parallel import (
+    init_moe_params,
+    make_mesh,
+    moe_ffn,
+    pipeline_apply,
+    top1_gating,
+)
+
+
+def test_pipeline_matches_sequential():
+    mesh = make_mesh({"pipe": 4})
+    s, m, d = 4, 6, 8
+    rs = np.random.RandomState(0)
+    # stage s: x -> tanh(x @ W_s)
+    ws = jnp.asarray(
+        rs.standard_normal((s, d, d)).astype(np.float32) * 0.5
+    )
+    mbs = jnp.asarray(
+        rs.standard_normal((m, 2, d)).astype(np.float32)
+    )
+
+    def stage_fn(params, x, stage_idx):
+        return jnp.tanh(x @ params)
+
+    out = pipeline_apply(
+        stage_fn, ws, mbs, mesh, axis_name="pipe"
+    )
+
+    ref = mbs
+    for i in range(s):
+        ref = jnp.tanh(ref @ ws[i])
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_pipeline_under_jit():
+    mesh = make_mesh({"pipe": 2})
+    s, m, d = 2, 3, 4
+    ws = jnp.ones((s, d, d), jnp.float32) * 0.1
+    mbs = jnp.ones((m, 2, d), jnp.float32)
+
+    def stage_fn(params, x, stage_idx):
+        return x @ params
+
+    f = jax.jit(
+        lambda w, b: pipeline_apply(stage_fn, w, b, mesh, "pipe")
+    )
+    out = f(ws, mbs)
+    ref = mbs @ ws[0] @ ws[1]
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-5
+    )
+
+
+def test_top1_gating_capacity():
+    logits = jnp.asarray(
+        [[10.0, 0.0], [10.0, 0.0], [10.0, 0.0], [0.0, 10.0]]
+    )
+    dispatch, combine, aux = top1_gating(logits, 2, capacity=2)
+    # 3 tokens want expert 0 but capacity 2: third dropped
+    routed_e0 = dispatch[:, 0, :].sum()
+    assert float(routed_e0) == 2.0
+    assert float(dispatch[:, 1, :].sum()) == 1.0
+    assert np.isfinite(float(aux))
+
+
+def test_moe_ffn_single_vs_dense():
+    """With one expert and ample capacity, MoE == plain FFN."""
+    rs = np.random.RandomState(1)
+    t, d, f = 8, 4, 16
+    x = jnp.asarray(rs.standard_normal((t, d)).astype(np.float32))
+    params = init_moe_params(jax.random.PRNGKey(0), d, f, 1)
+    out, aux = moe_ffn(
+        x, params["router_w"], params["w1"], params["w2"],
+        capacity_factor=2.0,
+    )
+    ref = jax.nn.relu(x @ params["w1"][0]) @ params["w2"][0]
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_moe_expert_parallel_matches_local():
+    mesh = make_mesh({"expert": 4})
+    rs = np.random.RandomState(2)
+    t, d, f, e = 16, 8, 16, 4
+    x = jnp.asarray(rs.standard_normal((t, d)).astype(np.float32))
+    params = init_moe_params(jax.random.PRNGKey(1), d, f, e)
+
+    out_local, aux_local = moe_ffn(
+        x, params["router_w"], params["w1"], params["w2"],
+        capacity_factor=2.0,
+    )
+    out_ep, aux_ep = jax.jit(
+        lambda x, p: moe_ffn(
+            x, p["router_w"], p["w1"], p["w2"], capacity_factor=2.0,
+            mesh=mesh, axis_name="expert",
+        )
+    )(x, params)
+    np.testing.assert_allclose(
+        np.asarray(out_ep), np.asarray(out_local), rtol=1e-4,
+        atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        float(aux_ep), float(aux_local), rtol=1e-5
+    )
+
+
+def test_moe_grads_flow():
+    rs = np.random.RandomState(3)
+    t, d, f, e = 8, 4, 8, 2
+    x = jnp.asarray(rs.standard_normal((t, d)).astype(np.float32))
+    params = init_moe_params(jax.random.PRNGKey(2), d, f, e)
+
+    def loss(p):
+        out, aux = moe_ffn(
+            x, p["router_w"], p["w1"], p["w2"], capacity_factor=2.0
+        )
+        return jnp.mean(out ** 2) + 0.01 * aux
+
+    grads = jax.grad(loss)(params)
+    for k, g in grads.items():
+        assert np.abs(np.asarray(g)).sum() > 0, k

@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Multi-process data-parallel training with the distributed KVStore
+(the reference's dist_sync workflow, example/image-classification with
+--kv-store dist_sync via tools/launch.py):
+
+  python tools/launch.py -n 2 python examples/distributed/dist_train.py
+
+Each worker trains on its shard of the data; gradients sync through
+KVStore('dist_sync') push/pull (jax.distributed collectives under the
+hood)."""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+)
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    kv = mx.kv.create("dist_sync")
+    rank, nworker = kv.rank, kv.num_workers
+
+    rs = np.random.RandomState(0)
+    X = rs.rand(512, 784).astype(np.float32)
+    y = rs.randint(0, 10, 512).astype(np.float32)
+    # shard by worker (reference: part_index/num_parts)
+    shard = slice(rank * len(X) // nworker,
+                  (rank + 1) * len(X) // nworker)
+    it = mx.io.NDArrayIter(
+        X[shard], y[shard], batch_size=32, shuffle=True
+    )
+
+    net = models.get_mlp()
+    mod = mx.mod.Module(net, context=mx.default_context())
+    mod.fit(
+        it, num_epoch=2, kvstore=kv, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.05},
+        initializer=mx.init.Xavier(),
+    )
+    print(f"worker {rank}/{nworker} done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

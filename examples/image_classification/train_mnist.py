@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Train MLP/LeNet on MNIST (reference
+example/image-classification/train_mnist.py CLI shape). Uses the real
+MNIST idx files when --data-dir has them, else a synthetic stand-in so
+the example always runs.
+
+  python examples/image_classification/train_mnist.py \
+      --network lenet --batch-size 64 --lr 0.1 --num-epochs 2
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+)
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+def get_iters(args):
+    mnist = os.path.join(args.data_dir, "train-images-idx3-ubyte")
+    if os.path.exists(mnist) or os.path.exists(mnist + ".gz"):
+        flat = args.network == "mlp"
+        train = mx.io.MNISTIter(
+            image=mnist,
+            label=os.path.join(
+                args.data_dir, "train-labels-idx1-ubyte"
+            ),
+            batch_size=args.batch_size, flat=flat, shuffle=True,
+        )
+        return train, None
+    logging.warning("MNIST not found in %s; using synthetic data",
+                    args.data_dir)
+    rs = np.random.RandomState(0)
+    n = 2048
+    if args.network == "mlp":
+        X = rs.rand(n, 784).astype(np.float32)
+    else:
+        X = rs.rand(n, 1, 28, 28).astype(np.float32)
+    y = rs.randint(0, 10, n).astype(np.float32)
+    return mx.io.NDArrayIter(
+        X, y, batch_size=args.batch_size, shuffle=True
+    ), None
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", default="mlp",
+                    choices=["mlp", "lenet"])
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--num-epochs", type=int, default=2)
+    ap.add_argument("--kv-store", default="local")
+    ap.add_argument("--data-dir", default="data/mnist")
+    ap.add_argument("--model-prefix", default=None)
+    ap.add_argument("--gpus", default=None,
+                    help="unused; kept for reference CLI compat")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    net = (
+        models.get_mlp() if args.network == "mlp"
+        else models.get_lenet()
+    )
+    train, val = get_iters(args)
+    mod = mx.mod.Module(net, context=mx.default_context())
+    cbs = [mx.callback.Speedometer(args.batch_size, 50)]
+    epoch_cbs = []
+    if args.model_prefix:
+        epoch_cbs.append(mx.callback.do_checkpoint(args.model_prefix))
+    mod.fit(
+        train, eval_data=val, num_epoch=args.num_epochs,
+        optimizer="sgd",
+        optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+        initializer=mx.init.Xavier(),
+        kvstore=args.kv_store,
+        batch_end_callback=cbs,
+        epoch_end_callback=epoch_cbs or None,
+    )
+    m = mx.metric.Accuracy()
+    train.reset()
+    print("final train accuracy:", mod.score(train, m))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""LSTM language model with bucketing (reference
+example/rnn/lstm_bucketing.py). Trains on PTB-format text when
+--data points at a file, else a synthetic corpus.
+
+  python examples/rnn/lstm_bucketing.py --num-epochs 2
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+)
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import rnn
+from mxnet_tpu.models import lstm_lm_sym_gen
+
+
+def load_corpus(path, batch_size, buckets):
+    if path and os.path.exists(path):
+        with open(path) as f:
+            sentences = [line.split() for line in f]
+        coded, vocab = rnn.encode_sentences(
+            sentences, invalid_label=0, start_label=1
+        )
+    else:
+        logging.warning("no corpus; generating synthetic sentences")
+        rs = np.random.RandomState(0)
+        vocab = {i: i for i in range(50)}
+        coded = [
+            list(rs.randint(1, 50, size=rs.randint(3, 15)))
+            for _ in range(400)
+        ]
+    it = rnn.BucketSentenceIter(
+        coded, batch_size, buckets=buckets, invalid_label=0
+    )
+    return it, len(vocab) + 1
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", default=None)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--num-hidden", type=int, default=64)
+    ap.add_argument("--num-embed", type=int, default=32)
+    ap.add_argument("--num-layers", type=int, default=2)
+    ap.add_argument("--num-epochs", type=int, default=2)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--buckets", default="8,16")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    buckets = [int(b) for b in args.buckets.split(",")]
+    it, vocab_size = load_corpus(args.data, args.batch_size, buckets)
+
+    mod = mx.mod.BucketingModule(
+        lstm_lm_sym_gen(
+            vocab_size, num_embed=args.num_embed,
+            num_hidden=args.num_hidden, num_layers=args.num_layers,
+        ),
+        default_bucket_key=it.default_bucket_key,
+        context=mx.default_context(),
+    )
+    mod.fit(
+        it, num_epoch=args.num_epochs, optimizer="sgd",
+        optimizer_params={"learning_rate": args.lr},
+        initializer=mx.init.Xavier(),
+        eval_metric=mx.metric.Perplexity(0),
+        batch_end_callback=[
+            mx.callback.Speedometer(args.batch_size, 20)
+        ],
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""SSD detection on a synthetic shapes dataset (reference example/ssd/
+train.py in miniature): bright squares on dark background, one class.
+Demonstrates the MultiBoxPrior/Target/Detection pipeline end to end.
+
+  python examples/ssd/train_ssd_toy.py --num-epochs 2
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+)
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import get_ssd_detect, get_ssd_train
+
+
+def make_dataset(n, size=32, seed=0):
+    rs = np.random.RandomState(seed)
+    X = rs.rand(n, 3, size, size).astype(np.float32) * 0.1
+    labels = np.full((n, 2, 5), -1.0, np.float32)
+    for i in range(n):
+        w = rs.randint(8, 16)
+        x0 = rs.randint(0, size - w)
+        y0 = rs.randint(0, size - w)
+        X[i, :, y0: y0 + w, x0: x0 + w] = 1.0
+        labels[i, 0] = [
+            0, x0 / size, y0 / size, (x0 + w) / size, (y0 + w) / size
+        ]
+    return X, labels
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--num-epochs", type=int, default=2)
+    ap.add_argument("--lr", type=float, default=0.05)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    X, labels = make_dataset(128)
+    it = mx.io.NDArrayIter(
+        X, labels, batch_size=args.batch_size,
+        label_name="label", shuffle=True,
+    )
+    net = get_ssd_train(num_classes=1, filters=(16, 32))
+    mod = mx.mod.Module(
+        net, label_names=["label"], context=mx.default_context()
+    )
+    mod.bind(
+        data_shapes=it.provide_data, label_shapes=it.provide_label
+    )
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(
+        optimizer="sgd",
+        optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+    )
+    for epoch in range(args.num_epochs):
+        it.reset()
+        losses = []
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+            loc_loss = mod.get_outputs()[1].asnumpy()
+            losses.append(float(loc_loss.mean()))
+        logging.info(
+            "epoch %d: mean loc loss %.5f", epoch, np.mean(losses)
+        )
+
+    # inference: rebind detect net with trained weights
+    det_net = get_ssd_detect(num_classes=1, filters=(16, 32))
+    arg_params, aux_params = mod.get_params()
+    det = mx.mod.Module(det_net, label_names=None,
+                        context=mx.default_context())
+    det.bind(
+        data_shapes=[("data", (1, 3, 32, 32))], for_training=False
+    )
+    det.set_params(arg_params, aux_params, allow_missing=True)
+    batch = mx.io.DataBatch([mx.nd.array(X[:1])], [])
+    det.forward(batch, is_train=False)
+    out = det.get_outputs()[0].asnumpy()
+    kept = out[0][out[0, :, 0] >= 0]
+    print("top detections (cls, score, box):")
+    print(kept[:3])
+
+
+if __name__ == "__main__":
+    main()

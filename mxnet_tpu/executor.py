@@ -152,6 +152,16 @@ class Executor:
         }
 
         grad_names = list(self._grad_names)
+        # memory mirror: rematerialize forward activations in backward
+        # instead of keeping them — jax.checkpoint is the analog of the
+        # reference's MXNET_BACKWARD_DO_MIRROR / memonger (trades ~10%
+        # speed for much smaller activation memory,
+        # example/image-classification/README.md:352-359)
+        import os as _os
+
+        mirror = _os.environ.get("MXNET_BACKWARD_DO_MIRROR", "0") not in (
+            "0", "", "false",
+        )
 
         def train_step(arg_vals, aux_vals, rng, head_grads):
             grad_vals = {k: arg_vals[k] for k in grad_names}
@@ -165,6 +175,8 @@ class Executor:
                 )
                 return outs, aux_upd
 
+            if mirror:
+                f = jax.checkpoint(f)
             outs, vjp_fn, aux_upd = jax.vjp(f, grad_vals, has_aux=True)
             (grads,) = vjp_fn(head_grads)
             return outs, grads, aux_upd

@@ -101,3 +101,9 @@ register_env(
     "MXNET_EXEC_NUM_TEMP", int, 1,
     "unused: XLA plans temp buffers (reference resource.cc); compat",
 )
+register_env(
+    "MXNET_BACKWARD_DO_MIRROR", bool, False,
+    "rematerialize forward activations during backward "
+    "(jax.checkpoint) — the reference's memory-mirror/memonger "
+    "(README.md:352-359): ~10% slower, much less activation memory",
+)

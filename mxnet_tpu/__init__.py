@@ -43,6 +43,7 @@ from . import native
 from . import kvstore as kv
 from . import kvstore
 from . import model
+from . import fault
 from . import executor_manager
 from . import feed_forward
 from .feed_forward import FeedForward

@@ -92,11 +92,21 @@ class Executor:
         nodes = _topo(sym._outputs)
         node_ids = {id(n): i for i, n in enumerate(nodes)}
         heads = [(id(n), i) for n, i in sym._outputs]
+        # ctx-group model parallelism (reference PlaceDevice pass +
+        # __ctx_group__ attrs, graph_executor.cc:242-318): map each
+        # node's group to a concrete device; run_graph inserts
+        # device_put at group boundaries — the _CrossDeviceCopy analog,
+        # expressed as sharding annotations inside the single jit
+        # computation instead of graph surgery.
+        group_dev = {
+            g: c.jax_device() for g, c in self._group2ctx.items()
+        }
         plan = []
         for n in nodes:
             if n.is_variable:
                 continue
             params = n.op.normalize_params(n.attrs)
+            grp = n._extra_attrs.get("__ctx_group__")
             plan.append(
                 (
                     n.op,
@@ -106,6 +116,7 @@ class Executor:
                     id(n),
                     node_ids[id(n)],
                     n.name,
+                    group_dev.get(grp),
                 )
             )
         var_names = {
@@ -120,8 +131,13 @@ class Executor:
                     aux_vals[name] if name in aux_set else arg_vals[name]
                 )
             aux_updates = {}
-            for opdef, params, n_out, in_keys, nid, node_idx, nname in plan:
+            for (opdef, params, n_out, in_keys, nid, node_idx, nname,
+                 dev) in plan:
                 in_vals = [env[k] for k in in_keys]
+                if dev is not None:
+                    in_vals = [
+                        jax.device_put(v, dev) for v in in_vals
+                    ]
                 kwargs = dict(params)
                 if opdef.needs_rng:
                     kwargs["rng"] = jax.random.fold_in(rng, node_idx)
@@ -237,9 +253,11 @@ class Executor:
                 aux_vals[name] if name in self._aux_set
                 else arg_vals[name]
             )
-        for opdef, params, n_out, in_keys, nid, node_idx, nname in \
-                self._plan:
+        for (opdef, params, n_out, in_keys, nid, node_idx, nname,
+             dev) in self._plan:
             in_vals = [env[k] for k in in_keys]
+            if dev is not None:
+                in_vals = [jax.device_put(v, dev) for v in in_vals]
             kwargs = dict(params)
             if opdef.needs_rng:
                 kwargs["rng"] = jax.random.fold_in(rng, node_idx)

@@ -1,0 +1,84 @@
+"""Fault-tolerance tests: crash at an injected epoch, restart, resume
+from the newest checkpoint, finish — the checkpoint-and-restart
+orchestration SURVEY.md §5 requires the rebuild to add."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import fault
+
+
+def _data():
+    rs = np.random.RandomState(0)
+    X = rs.rand(128, 10).astype(np.float32)
+    y = (X.sum(axis=1) > 5).astype(np.float32)
+    return mx.io.NDArrayIter(X, y, batch_size=32)
+
+
+def _net():
+    return mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(
+            mx.sym.Variable("data"), num_hidden=2, name="fc"
+        ),
+        name="softmax",
+    )
+
+
+def test_crash_and_resume(tmp_path):
+    prefix = str(tmp_path / "job")
+    it = _data()
+
+    # first run dies at epoch 2 via injected fault
+    mod = mx.mod.Module(_net(), context=mx.cpu())
+    with pytest.raises(RuntimeError, match="fault-injection"):
+        fault.fit_auto_resume(
+            mod, it, prefix, num_epoch=5,
+            fault_injector=fault.FaultInjector("epoch:2"),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+        )
+    assert fault.latest_checkpoint(prefix) == 2
+
+    # second run resumes at epoch 2 and completes
+    it.reset()
+    mod2 = mx.mod.Module(_net(), context=mx.cpu())
+    end = fault.fit_auto_resume(
+        mod2, it, prefix, num_epoch=5,
+        fault_injector=fault.FaultInjector(""),
+        optimizer="sgd",
+        optimizer_params={"learning_rate": 0.5},
+    )
+    assert end == 5
+    assert fault.latest_checkpoint(prefix) == 5
+
+    # resumed params at epoch 3 must derive from the epoch-2 checkpoint:
+    # train a fresh run to 5 and verify the resumed one still learned
+    m = mx.metric.Accuracy()
+    it.reset()
+    acc = mod2.score(it, m)[0][1]
+    assert acc > 0.5
+
+
+def test_already_complete_noop(tmp_path):
+    prefix = str(tmp_path / "job")
+    it = _data()
+    mod = mx.mod.Module(_net(), context=mx.cpu())
+    fault.fit_auto_resume(
+        mod, it, prefix, num_epoch=2, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.5},
+    )
+    # re-invoking with the same target epoch resumes-to-done instantly
+    mod2 = mx.mod.Module(_net(), context=mx.cpu())
+    end = fault.fit_auto_resume(
+        mod2, it, prefix, num_epoch=2, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.5},
+    )
+    assert end == 2
+
+
+def test_fault_injector_spec_parsing(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_FAULT_INJECT", "epoch:3")
+    fi = fault.FaultInjector()
+    fi.maybe_fail(2)  # no-op
+    with pytest.raises(RuntimeError):
+        fi.maybe_fail(3)

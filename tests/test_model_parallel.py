@@ -1,0 +1,82 @@
+"""Ctx-group model parallelism on two CPU contexts — the reference's
+device-free multi-device test idiom (tests/python/unittest/
+test_model_parallel.py + test_multi_device_exec.py: mx.cpu(0)/mx.cpu(1)
+instead of GPUs)."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _net():
+    with mx.AttrScope(ctx_group="dev1"):
+        data = mx.sym.Variable("data")
+        fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+        act1 = mx.sym.Activation(fc1, act_type="relu", name="act1")
+    with mx.AttrScope(ctx_group="dev2"):
+        fc2 = mx.sym.FullyConnected(act1, num_hidden=4, name="fc2")
+        net = mx.sym.SoftmaxOutput(fc2, name="softmax")
+    return net
+
+
+def test_ctx_group_forward_backward():
+    net = _net()
+    group2ctx = {"dev1": mx.cpu(0), "dev2": mx.cpu(1)}
+    ex = net.simple_bind(
+        ctx=mx.cpu(0), group2ctx=group2ctx, grad_req="write",
+        data=(4, 6), softmax_label=(4,),
+    )
+    rs = np.random.RandomState(0)
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr[:] = rs.uniform(-0.5, 0.5, arr.shape)
+    out = ex.forward(
+        is_train=True,
+        data=rs.rand(4, 6).astype(np.float32),
+        softmax_label=np.array([0, 1, 2, 3], np.float32),
+    )
+    assert out[0].shape == (4, 4)
+    ex.backward()
+    assert np.abs(ex.grad_dict["fc1_weight"].asnumpy()).sum() > 0
+    assert np.abs(ex.grad_dict["fc2_weight"].asnumpy()).sum() > 0
+
+
+def test_ctx_group_matches_single_device():
+    """Placement must not change the math (reference
+    test_model_parallel.py core assertion)."""
+    net = _net()
+    rs = np.random.RandomState(1)
+    inits = {}
+
+    def bind(group2ctx):
+        ex = net.simple_bind(
+            ctx=mx.cpu(0), group2ctx=group2ctx, grad_req="write",
+            data=(4, 6), softmax_label=(4,),
+        )
+        for name, arr in ex.arg_dict.items():
+            if name not in ("data", "softmax_label"):
+                if name not in inits:
+                    inits[name] = rs.uniform(
+                        -0.5, 0.5, arr.shape
+                    ).astype(np.float32)
+                arr[:] = inits[name]
+        return ex
+
+    data = rs.rand(4, 6).astype(np.float32)
+    label = np.array([0, 1, 2, 3], np.float32)
+    ex_mp = bind({"dev1": mx.cpu(0), "dev2": mx.cpu(1)})
+    ex_sd = bind(None)
+    out_mp = ex_mp.forward(
+        is_train=True, data=data, softmax_label=label
+    )[0].asnumpy()
+    out_sd = ex_sd.forward(
+        is_train=True, data=data, softmax_label=label
+    )[0].asnumpy()
+    np.testing.assert_allclose(out_mp, out_sd, rtol=1e-5, atol=1e-6)
+    ex_mp.backward()
+    ex_sd.backward()
+    for name in ("fc1_weight", "fc2_weight"):
+        np.testing.assert_allclose(
+            ex_mp.grad_dict[name].asnumpy(),
+            ex_sd.grad_dict[name].asnumpy(),
+            rtol=1e-5, atol=1e-6,
+        )

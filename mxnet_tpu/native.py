@@ -95,6 +95,36 @@ def available():
         return False
 
 
+_PREDICT_SRC = os.path.join(_NATIVE_DIR, "capi_predict.cc")
+_PREDICT_SO = os.path.join(_NATIVE_DIR, "libmxtpu_predict.so")
+
+
+def build_predict_lib():
+    """Build the embeddable C predict API (native/capi_predict.cc) —
+    the amalgamation/libmxnet_predict analog. Returns the .so path."""
+    if (
+        os.path.exists(_PREDICT_SO)
+        and os.path.getmtime(_PREDICT_SO)
+        >= os.path.getmtime(_PREDICT_SRC)
+    ):
+        return _PREDICT_SO
+    cfg = subprocess.run(
+        ["python3-config", "--includes", "--ldflags", "--embed"],
+        capture_output=True, text=True,
+    )
+    flags = cfg.stdout.split()
+    cmd = (
+        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _PREDICT_SRC]
+        + flags + ["-o", _PREDICT_SO]
+    )
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise MXNetError(
+            f"predict lib build failed: {' '.join(cmd)}\n{proc.stderr}"
+        )
+    return _PREDICT_SO
+
+
 _ENGINE_SRC = os.path.join(_NATIVE_DIR, "engine_core.cc")
 _ENGINE_SO = os.path.join(_NATIVE_DIR, "libengine_core.so")
 _engine_lib = None

@@ -97,6 +97,13 @@ class Predictor(object):
         )
 
     # ----------------------------------------------------- C-API verbs
+    def _reshape_input(self, name, flat):
+        """Reshape a flat buffer to the declared input shape (used by
+        the embedded C API, native/capi_predict.cc)."""
+        return np.asarray(flat, np.float32).reshape(
+            self._input_shapes[name]
+        )
+
     def set_input(self, name, data):
         """MXPredSetInput."""
         if name not in self._input_shapes:

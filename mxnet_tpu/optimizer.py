@@ -130,6 +130,32 @@ class Optimizer:
             wd *= self.wd_mult.get(self.idx2name[index], 1.0)
         return wd
 
+    # ------------------------------------------------- fused (traced) form
+    def _wd_for(self, name):
+        """Static per-parameter weight decay for the fused train step
+        (name-keyed twin of _get_wd)."""
+        return self.wd * self.wd_mult.get(name, 1.0)
+
+    def _lr_mult_for(self, name):
+        """Static per-parameter lr multiplier for the fused train step."""
+        return self.lr_mult.get(name, 1.0)
+
+    def apply_dense(self, name, weight, grad, state, lr, t):
+        """Pure-jax update of one parameter inside the fused train step.
+
+        weight/grad are jnp arrays, state is a pytree shaped like
+        create_state's result (jnp leaves), lr is a traced scalar that
+        already includes this parameter's lr multiplier, and t is the
+        traced update count (for bias correction). Returns
+        (new_weight, new_state). Optimizers whose update cannot be
+        traced (host-side randomness, delayed-gradient bookkeeping)
+        leave this unimplemented and fall back to the per-parameter
+        eager path.
+        """
+        raise NotImplementedError(
+            f"{type(self).__name__} has no fused (traced) update"
+        )
+
 
 # `mx.optimizer.Optimizer.create_optimizer` alias (reference keeps both)
 create = Optimizer.create_optimizer
@@ -172,6 +198,18 @@ class SGD(Optimizer):
         else:
             _fused("sgd_update", [weight, grad], kwargs, 0)
 
+    def apply_dense(self, name, weight, grad, state, lr, t):
+        from .ops.optimizer_ops import sgd_mom_update, sgd_update
+
+        kw = {"lr": lr, "wd": self._wd_for(name),
+              "rescale_grad": self.rescale_grad,
+              "clip_gradient": self.clip_gradient or -1.0}
+        if state is None:
+            return sgd_update(weight, grad, **kw), None
+        w, m = sgd_mom_update(weight, grad, state,
+                              momentum=self.momentum, **kw)
+        return w, m
+
 
 @register
 class NAG(SGD):
@@ -196,6 +234,19 @@ class NAG(SGD):
             weight._set_data(
                 weight._data - lr * (grad_v + wd * weight._data)
             )
+
+    def apply_dense(self, name, weight, grad, state, lr, t):
+        import jax.numpy as jnp
+
+        wd = self._wd_for(name)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        if state is None:
+            return weight - lr * (g + wd * weight), None
+        mom = self.momentum * state + g + wd * weight
+        look = g + self.momentum * mom
+        return weight - lr * (look + wd * weight), mom
 
 
 @register
@@ -298,6 +349,22 @@ class Adam(Optimizer):
              "clip_gradient": self.clip_gradient or -1.0}, 2,
         )
 
+    def apply_dense(self, name, weight, grad, state, lr, t):
+        import jax.numpy as jnp
+
+        from .ops.optimizer_ops import adam_update
+
+        tf = t.astype(jnp.float32)
+        lr_t = lr * jnp.sqrt(1.0 - self.beta2 ** tf) / (1.0 - self.beta1 ** tf)
+        mean, var = state
+        w, m, v = adam_update(
+            weight, grad, mean, var, lr=lr_t, beta1=self.beta1,
+            beta2=self.beta2, epsilon=self.epsilon, wd=self._wd_for(name),
+            rescale_grad=self.rescale_grad,
+            clip_gradient=self.clip_gradient or -1.0,
+        )
+        return w, (m, v)
+
 
 @register
 class AdaGrad(Optimizer):
@@ -326,6 +393,19 @@ class AdaGrad(Optimizer):
             - lr * (g / jnp.sqrt(history + self.float_stable_eps)
                     + wd * weight._data)
         )
+
+    def apply_dense(self, name, weight, grad, state, lr, t):
+        import jax.numpy as jnp
+
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        hist = state + g * g
+        w = weight - lr * (
+            g / jnp.sqrt(hist + self.float_stable_eps)
+            + self._wd_for(name) * weight
+        )
+        return w, hist
 
 
 @register
@@ -366,6 +446,21 @@ class RMSProp(Optimizer):
                    dict(kwargs, gamma2=self.gamma2), 3)
         else:
             _fused("rmsprop_update", [weight, grad, state], kwargs, 1)
+
+    def apply_dense(self, name, weight, grad, state, lr, t):
+        from .ops.optimizer_ops import rmsprop_update, rmspropalex_update
+
+        kw = {"lr": lr, "gamma1": self.gamma1, "epsilon": self.epsilon,
+              "wd": self._wd_for(name), "rescale_grad": self.rescale_grad,
+              "clip_gradient": self.clip_gradient or -1.0,
+              "clip_weights": self.clip_weights or -1.0}
+        if self.centered:
+            n, g, delta = state
+            w, n2, g2, d2 = rmspropalex_update(
+                weight, grad, n, g, delta, gamma2=self.gamma2, **kw)
+            return w, (n2, g2, d2)
+        w, n2 = rmsprop_update(weight, grad, state, **kw)
+        return w, n2
 
 
 @register

@@ -115,7 +115,7 @@ def main():
         num_layers, image, classes, iters = 18, (3, 32, 32), 16, 3
     else:
         batch = int(os.environ.get("BENCH_BATCH", "256"))
-        num_layers, image, classes, iters = 50, (3, 224, 224), 1000, 20
+        num_layers, image, classes, iters = 50, (3, 224, 224), 1000, 50
     dtype = os.environ.get("BENCH_DTYPE",
                            "bfloat16" if on_accel else "float32")
 

@@ -210,14 +210,16 @@ class Executor:
             if k not in self.arg_dict:
                 raise MXNetError(f"unknown forward argument {k!r}")
             self.arg_dict[k][:] = v
+        arg_vals, aux_vals = self._gather_inputs()
+        self._rng, rng = jax.random.split(self._rng)
         if self._monitor_callback is not None:
             # monitored (debug) path: eager per-node execution so the
             # callback sees every intermediate (reference
             # MXExecutorSetMonitorCallback + ExecuteMonCallback,
-            # graph_executor.cc:758). Not jit'd by design.
-            self._forward_monitored(is_train)
-        arg_vals, aux_vals = self._gather_inputs()
-        self._rng, rng = jax.random.split(self._rng)
+            # graph_executor.cc:758). Not jit'd by design. Uses the SAME
+            # key as the jit pass below so monitored statistics of
+            # stochastic ops (Dropout) reflect the executed draw.
+            self._forward_monitored(is_train, rng, arg_vals, aux_vals)
         self._cached_grads = None
         with _profiler.scope(
             f"executor_forward[{'train' if is_train else 'eval'}]",
@@ -242,11 +244,10 @@ class Executor:
         self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
         return self.outputs
 
-    def _forward_monitored(self, is_train):
+    def _forward_monitored(self, is_train, rng, arg_vals, aux_vals):
         """Eager per-node execution invoking the monitor callback with
-        every node output (debug path; see forward())."""
-        arg_vals, aux_vals = self._gather_inputs()
-        rng = self._rng  # peek; real forward re-splits
+        every node output (debug path; see forward()). `rng` is the
+        same key the jit forward will use."""
         env = {}
         for nid, name in self._var_names.items():
             env[(nid, 0)] = (
@@ -369,6 +370,19 @@ class Executor:
         return Executor(self._symbol, self._ctx, new_args, new_grads,
                         self._grad_req, new_aux,
                         group2ctx=self._group2ctx)
+
+    def release_arrays(self):
+        """Drop all buffer references (args/grads/auxs/outputs), keeping
+        only the traced graph. Used by the fused train step, which owns
+        its own copies of the training state — without this, parameters
+        and gradients would stay resident an extra time."""
+        self.arg_dict = {}
+        self.grad_dict = {}
+        self.aux_dict = {}
+        self.arg_arrays = []
+        self.grad_arrays = []
+        self.aux_arrays = []
+        self.outputs = []
 
     def set_monitor_callback(self, callback):
         self._monitor_callback = callback

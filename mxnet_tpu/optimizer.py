@@ -586,7 +586,23 @@ class Updater:
                 return tuple(_to_nd(i) for i in x)
             return x
 
-        self.states = {k: _to_nd(v) for k, v in pickle.loads(states).items()}
+        obj = pickle.loads(states)
+        if isinstance(obj, dict) and obj.get("format") == \
+                "mxnet_tpu/fused_v1":
+            # fused-train-step checkpoint ({name: state}): replicate
+            # each name's state into EVERY index the eager path uses
+            # for it (one per device; _to_nd per slot so device copies
+            # never alias one state array)
+            name2idxs: dict = {}
+            for i, n in self.optimizer.idx2name.items():
+                name2idxs.setdefault(n, []).append(i)
+            self.states = {
+                i: _to_nd(v)
+                for n, v in obj["states"].items()
+                for i in name2idxs.get(n, ())
+            }
+            return
+        self.states = {k: _to_nd(v) for k, v in obj.items()}
 
     def get_states(self):
         def _to_np(x):

@@ -1,0 +1,337 @@
+"""FusedTrainStep: forward + backward + all-reduce + optimizer update in
+ONE donated XLA computation.
+
+This is the TPU-native replacement for the reference's training data
+plane, where three separate mechanisms cooperate per step:
+
+  - GraphExecutor::Forward/Backward pushes cached engine ops
+    (src/executor/graph_executor.cc:780-832),
+  - KVStore push/pull wraps ZPush/ZPull in engine async ops so comm
+    overlaps compute (src/kvstore/kvstore_dist.h:111-123,
+    python/mxnet/model.py:88-97 priority-ordered push/pull),
+  - the optimizer runs per-parameter fused kernels
+    (src/operator/optimizer_op-inl.h).
+
+Here all three collapse into a single jit: the loss graph's vjp produces
+gradients, GSPMD inserts the cross-device all-reduce when the batch is
+sharded over a mesh axis (gradients of replicated parameters against a
+sharded batch ARE the psum — no host hop, no parameter server), and the
+optimizer's traced `apply_dense` updates weights and state in the same
+computation. Buffers for parameters, optimizer state, and aux state are
+donated, so the update is in-place at the XLA level — the analog of the
+reference's PlanMemory/inplace-addto passes.
+
+Mixed precision (the reference trains fp16 via cuDNN,
+tests/python/train/test_dtype.py): `compute_dtype=bfloat16` keeps fp32
+master weights and casts weights/activations to bf16 for the fwd/bwd
+compute; gradient cotangents come back through the cast (fp32), and aux
+(e.g. BatchNorm running stats) updates are cast back to their master
+dtype. Labels are never cast (class indices above 256 are not bf16-
+representable).
+"""
+from __future__ import annotations
+
+import logging
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+
+
+def _to_jnp_tree(tree):
+    """Map NDArray leaves of a pytree (None / NDArray / tuple) to jnp."""
+    if tree is None:
+        return None
+    if isinstance(tree, NDArray):
+        return tree._data
+    if isinstance(tree, (tuple, list)):
+        return tuple(_to_jnp_tree(t) for t in tree)
+    return jnp.asarray(tree)
+
+
+class FusedTrainStep:
+    """One donated jit over (params, opt_states, auxs).
+
+    Owns the training state while active: parameters, optimizer state and
+    aux arrays live as jax Arrays inside this object, and the Module
+    flushes them back into executor NDArrays only when a non-fused code
+    path (eval forward, get_params, checkpointing) needs them.
+    """
+
+    def __init__(self, executor, optimizer, param_names, label_names=(),
+                 mesh=None, data_axis="data", compute_dtype=None,
+                 logger=logging):
+        self._ex = executor
+        self._opt = optimizer
+        self._logger = logger
+        self._mesh = mesh
+        self._data_axis = data_axis
+        self._compute_dtype = (
+            jnp.dtype(compute_dtype) if compute_dtype is not None else None
+        )
+
+        arg_names = executor._arg_names
+        pset = set(param_names)
+        self._param_names = [n for n in arg_names if n in pset]
+        self._trainable = [
+            n for n in self._param_names
+            if executor._grad_req.get(n, "null") != "null"
+        ]
+        self._data_names = [n for n in arg_names if n not in pset]
+        self._label_names = set(label_names)
+        self._aux_names = list(executor._aux_names)
+
+        # Take over the training state from the executor — as COPIES:
+        # step() donates these buffers to XLA, and donating an array the
+        # executor/module still references would invalidate it under
+        # the caller's feet.
+        self.params = {
+            n: jnp.copy(executor.arg_dict[n]._data)
+            for n in self._param_names
+        }
+        self.auxs = {
+            n: jnp.copy(executor.aux_dict[n]._data)
+            for n in self._aux_names
+        }
+        self.states = {
+            n: _to_jnp_tree(
+                optimizer.create_state(i, executor.arg_dict[n])
+            )
+            for i, n in enumerate(self._trainable)
+        }
+        self._base_rng = executor._rng
+        self._t = 0  # steps taken through this fused step
+
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self._repl = NamedSharding(mesh, P())
+            self._batch_sh = NamedSharding(mesh, P(data_axis))
+            put = lambda t: jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, self._repl), t
+            )
+            self.params = put(self.params)
+            self.auxs = put(self.auxs)
+            self.states = put(self.states)
+        else:
+            self._repl = None
+            self._batch_sh = None
+
+        self._jitted = self._build()
+        self._compiled = None  # AOT executable, built on first run
+
+    # ------------------------------------------------------------ build
+    def _build(self):
+        run = self._ex._run_graph
+        opt = self._opt
+        trainable = list(self._trainable)
+        cdt = self._compute_dtype
+        labels = self._label_names
+
+        def cast_c(x):
+            """master -> compute dtype (params, auxs, float data)"""
+            if cdt is not None and jnp.issubdtype(x.dtype, jnp.floating):
+                return x.astype(cdt)
+            return x
+
+        def step(params, states, auxs, data, lr, t):
+            rng = jax.random.fold_in(self._base_rng, t)
+            train_p = {k: params[k] for k in trainable}
+            frozen_p = {
+                k: v for k, v in params.items() if k not in train_p
+            }
+            data_c = {
+                k: (v if k in labels else cast_c(v))
+                for k, v in data.items()
+            }
+            auxs_c = {k: cast_c(v) for k, v in auxs.items()}
+            frozen_c = {k: cast_c(v) for k, v in frozen_p.items()}
+
+            def fwd(tp):
+                tp_c = {k: cast_c(v) for k, v in tp.items()}
+                return run(
+                    {**frozen_c, **tp_c, **data_c}, auxs_c, rng, True
+                )
+
+            outs, vjp_fn, aux_upd = jax.vjp(fwd, train_p, has_aux=True)
+            (grads,) = vjp_fn([jnp.ones_like(o) for o in outs])
+
+            new_params = dict(params)
+            new_states = dict(states)
+            for name in trainable:
+                w = params[name]
+                g = grads[name].astype(w.dtype)
+                lr_p = lr * opt._lr_mult_for(name)
+                w2, s2 = opt.apply_dense(
+                    name, w, g, states[name], lr_p, t
+                )
+                new_params[name] = w2
+                new_states[name] = s2
+            new_auxs = {
+                **auxs,
+                **{
+                    k: v.astype(auxs[k].dtype)
+                    for k, v in aux_upd.items()
+                    if k in auxs
+                },
+            }
+            return outs, new_params, new_states, new_auxs
+
+        kwargs = {"donate_argnums": (0, 1, 2)}
+        if self._mesh is not None:
+            kwargs["in_shardings"] = (
+                self._repl, self._repl, self._repl, self._batch_sh,
+                None, None,
+            )
+            # outputs keep whatever layout XLA picks (batch-sharded in
+            # practice); pinning them could fail on rank-0 outputs
+            kwargs["out_shardings"] = (
+                None, self._repl, self._repl, self._repl,
+            )
+        return jax.jit(step, **kwargs)
+
+    # -------------------------------------------------------------- run
+    def _place_data(self, data_vals):
+        if self._batch_sh is None:
+            return data_vals
+        return {
+            k: jax.device_put(v, self._batch_sh)
+            for k, v in data_vals.items()
+        }
+
+    def step(self, data_vals):
+        """Run one fused step on {name: jnp array} batch inputs. Returns
+        the forward outputs; params/states/auxs are advanced in place."""
+        self._t += 1
+        opt = self._opt
+        opt.num_update += 1
+        lr = (
+            opt.lr_scheduler(opt.num_update)
+            if opt.lr_scheduler is not None else opt.lr
+        )
+        args = (
+            self.params, self.states, self.auxs,
+            self._place_data(data_vals),
+            np.float32(lr), np.int32(self._t),
+        )
+        if self._compiled is None:
+            try:
+                self._compiled = self._jitted.lower(*args).compile()
+            except Exception:  # fall back to dispatch-compiled jit
+                self._compiled = False
+        fn = self._compiled if self._compiled else self._jitted
+        try:
+            outs, self.params, self.states, self.auxs = fn(*args)
+        except (TypeError, ValueError):
+            # shape/dtype drift (e.g. a differently-sized final batch):
+            # the AOT executable is exact-shape; re-dispatch through jit
+            outs, self.params, self.states, self.auxs = self._jitted(*args)
+        return outs
+
+    def sync(self):
+        """Fence: wait until all queued steps have executed.
+
+        Uses a host fetch of one parameter element rather than
+        block_until_ready — remote-dispatch backends (the axon TPU
+        tunnel) acknowledge enqueue, not completion, so only a value
+        round-trip is a true barrier."""
+        jax.block_until_ready(self.params)
+        if self.params:
+            leaf = next(iter(self.params.values()))
+            np.asarray(jax.device_get(jnp.ravel(leaf)[0]))
+
+    # --------------------------------------------------------- teardown
+    def load_params(self, arg_params, aux_params):
+        """Replace the owned parameters/auxs from NDArray dicts (the
+        Module calls this when params changed outside the fused step —
+        set_params, init_params(force_init), an eager update)."""
+        def place(x):
+            x = jnp.copy(jnp.asarray(x))
+            if self._repl is not None:
+                x = jax.device_put(x, self._repl)
+            return x
+
+        for n in self._param_names:
+            self.params[n] = place(arg_params[n]._data)
+        for n in self._aux_names:
+            self.auxs[n] = place(aux_params[n]._data)
+
+    def snapshot(self):
+        """(params, auxs) as safe-to-expose copies: the live buffers
+        will be donated by the next step(), so callers must never hold
+        references to them. In mesh mode the copies are materialized on
+        a single device so eager executors can consume them."""
+        if self._mesh is None:
+            leaf = jnp.copy
+        else:
+            dev0 = self._mesh.devices.flat[0]
+            leaf = lambda v: jax.device_put(v, dev0)
+        cp = lambda t: {k: leaf(v) for k, v in t.items()}
+        return cp(self.params), cp(self.auxs)
+
+    # ------------------------------------------------------ diagnostics
+    def flops(self):
+        """FLOPs of one compiled train step, from XLA cost analysis."""
+        if not self._compiled:
+            return 0.0
+        try:
+            cost = self._compiled.cost_analysis()
+            if isinstance(cost, list):
+                cost = cost[0]
+            return float(cost.get("flops", 0.0))
+        except Exception:
+            return 0.0
+
+    # ------------------------------------------ optimizer state save/load
+    STATE_FORMAT = "mxnet_tpu/fused_v1"
+
+    def get_states(self):
+        host = jax.tree_util.tree_map(np.asarray, self.states)
+        return pickle.dumps(
+            {"format": self.STATE_FORMAT, "t": self._t, "states": host}
+        )
+
+    def set_states(self, blob):
+        obj = pickle.loads(blob)
+        if isinstance(obj, dict) and obj.get("format") == \
+                self.STATE_FORMAT:
+            t, host = obj["t"], obj["states"]
+        elif isinstance(obj, dict):
+            # eager Updater checkpoint ({index: state}): translate
+            # indices to parameter names through the optimizer's map
+            idx2name = self._opt.idx2name
+            host = {
+                idx2name[i]: v for i, v in obj.items()
+                if idx2name.get(i) in self.states
+            }
+            missing = set(self.states) - set(host)
+            if missing:
+                raise MXNetError(
+                    f"optimizer state file lacks entries for {missing}"
+                )
+            t = self._opt.num_update
+        else:
+            raise MXNetError("unrecognized optimizer state format")
+
+        tmpl = self.states
+        new = jax.tree_util.tree_map(jnp.asarray, host)
+        if self._repl is not None:
+            new = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, self._repl), new
+            )
+        if jax.tree_util.tree_structure(new) != \
+                jax.tree_util.tree_structure(tmpl):
+            raise MXNetError("optimizer state structure mismatch")
+        self._t = t
+        self.states = new
+
+
+def supports_fused(optimizer):
+    """True when the optimizer overrides the traced apply_dense form."""
+    from ..optimizer import Optimizer
+
+    return type(optimizer).apply_dense is not Optimizer.apply_dense

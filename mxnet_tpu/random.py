@@ -22,8 +22,15 @@ def _key():
 
 
 def seed(seed_state: int):
-    """Seed the global PRNG (analog of MXRandomSeed)."""
+    """Seed the global PRNG (analog of MXRandomSeed).
+
+    Also seeds numpy's global generator: host-side samplers
+    (initializers, test utilities) draw from numpy, and the reference's
+    MXRandomSeed controls initializer draws the same way."""
+    import numpy as _np
+
     _state.key = jax.random.PRNGKey(int(seed_state))
+    _np.random.seed(int(seed_state) & 0xFFFFFFFF)
 
 
 def next_key():

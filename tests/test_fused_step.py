@@ -1,0 +1,384 @@
+"""Fused train step (parallel/dp_step.py): the one-donated-jit
+forward+backward+update path behind Module.fit / KVStore('tpu').
+
+Covers VERDICT r1 items 1 (fused step behind the user API) and 3 (bf16
+mixed precision). The reference's equivalent training semantics live in
+python/mxnet/model.py:88-97 (push/pull per step) and
+src/kvstore/kvstore_dist.h:111-123 (overlapped comm); here the whole
+step is a single XLA computation, so equality with the eager path is
+the correctness bar.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _mlp(hidden=32, classes=10):
+    d = mx.sym.Variable("data")
+    f1 = mx.sym.FullyConnected(d, name="fc1", num_hidden=hidden)
+    a1 = mx.sym.Activation(f1, name="relu1", act_type="relu")
+    f2 = mx.sym.FullyConnected(a1, name="fc2", num_hidden=classes)
+    return mx.sym.SoftmaxOutput(f2, name="softmax")
+
+
+def _data(batch=64, feat=20, classes=10, seed=0):
+    rs = np.random.RandomState(seed)
+    X = rs.uniform(-1, 1, (batch, feat)).astype("float32")
+    Y = rs.randint(0, classes, (batch,)).astype("float32")
+    return mx.io.DataBatch(data=[mx.nd.array(X)], label=[mx.nd.array(Y)])
+
+
+def _train(fused, steps=6, ctxs=None, kv=None, dtype=None, optimizer="sgd",
+           opt_params=(("learning_rate", 0.1), ("momentum", 0.9)),
+           batch=None):
+    mod = mx.mod.Module(_mlp(), context=ctxs or [mx.cpu()])
+    mod.bind(data_shapes=[("data", (64, 20))],
+             label_shapes=[("softmax_label", (64,))])
+    mx.random.seed(7)
+    mod.init_params(mx.initializer.Uniform(0.07))
+    mod.init_optimizer(kvstore=kv, optimizer=optimizer,
+                       optimizer_params=opt_params)
+    if not fused:
+        mod._disable_fused("test")
+    else:
+        assert mod._fused_step is not None, "fused step should be active"
+    if dtype is not None:
+        mod.cast_compute(dtype)
+    b = batch if batch is not None else _data()
+    for _ in range(steps):
+        mod.forward_backward(b)
+        mod.update()
+    mod.sync()
+    args, auxs = mod.get_params()
+    return mod, {k: v.asnumpy() for k, v in args.items()}
+
+
+def test_fused_matches_eager_single_device():
+    _, p_eager = _train(False)
+    _, p_fused = _train(True)
+    for k in p_eager:
+        np.testing.assert_allclose(p_eager[k], p_fused[k],
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_fused_matches_eager_adam():
+    _, p_eager = _train(False, optimizer="adam",
+                        opt_params=(("learning_rate", 0.01),))
+    _, p_fused = _train(True, optimizer="adam",
+                        opt_params=(("learning_rate", 0.01),))
+    for k in p_eager:
+        np.testing.assert_allclose(p_eager[k], p_fused[k],
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_fused_mesh_dp_matches_eager():
+    """KVStore('tpu') + multiple contexts = one jit over the device
+    mesh; gradients psum across the data axis inside the step."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs multiple virtual devices")
+    ctxs = [mx.Context("cpu", i) for i in range(4)]
+    _, p_eager = _train(False)
+    mod, p_mesh = _train(True, ctxs=ctxs, kv="tpu")
+    assert mod._fused_step._mesh is not None
+    for k in p_eager:
+        np.testing.assert_allclose(p_eager[k], p_mesh[k],
+                                   rtol=3e-4, atol=3e-5)
+
+
+def test_fused_bf16_trains():
+    """bf16 compute with fp32 masters converges in the same direction
+    as fp32 (loose tolerance tier, SURVEY hard part (f))."""
+    import jax.numpy as jnp
+
+    _, p32 = _train(False, steps=10)
+    mod, p16 = _train(True, steps=10, dtype=jnp.bfloat16)
+    assert mod._fused_step._compute_dtype == jnp.bfloat16
+    for k in p32:
+        assert p16[k].dtype == np.float32  # masters stay fp32
+        np.testing.assert_allclose(p32[k], p16[k], rtol=0.15, atol=0.02)
+
+
+def test_fused_optimizer_state_roundtrip(tmp_path):
+    fname = str(tmp_path / "opt.states")
+    mod, _ = _train(True, steps=3)
+    mod.save_optimizer_states(fname)
+    st = mod._fused_step.states["fc1_weight"]
+    mod2, _ = _train(True, steps=0)
+    mod2.load_optimizer_states(fname)
+    np.testing.assert_allclose(
+        np.asarray(mod2._fused_step.states["fc1_weight"]),
+        np.asarray(st))
+    assert mod2._fused_step._t == mod._fused_step._t
+
+
+def test_fused_get_outputs_before_update():
+    """forward -> get_outputs -> backward -> update falls back to the
+    eager lifecycle without corrupting parameters."""
+    mod = mx.mod.Module(_mlp(), context=[mx.cpu()])
+    mod.bind(data_shapes=[("data", (64, 20))],
+             label_shapes=[("softmax_label", (64,))])
+    mx.random.seed(7)
+    mod.init_params(mx.initializer.Uniform(0.07))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),))
+    b = _data()
+    mod.forward(b, is_train=True)
+    outs = mod.get_outputs()
+    assert outs[0].shape == (64, 10)
+    probs = outs[0].asnumpy()
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-4)
+    mod.backward()
+    mod.update()
+    # parameters actually moved
+    args, _ = mod.get_params()
+    ref = mx.mod.Module(_mlp(), context=[mx.cpu()])
+    ref.bind(data_shapes=[("data", (64, 20))],
+             label_shapes=[("softmax_label", (64,))])
+    mx.random.seed(7)
+    ref.init_params(mx.initializer.Uniform(0.07))
+    assert not np.allclose(args["fc1_weight"].asnumpy(),
+                           ref._arg_params["fc1_weight"].asnumpy())
+
+
+def test_fused_checkpoint_roundtrip(tmp_path):
+    prefix = str(tmp_path / "model")
+    mod, p1 = _train(True, steps=4)
+    mod.save_checkpoint(prefix, 4)
+    sym, args, auxs = mx.model.load_checkpoint(prefix, 4)
+    for k, v in args.items():
+        np.testing.assert_allclose(v.asnumpy(), p1[k])
+
+
+def test_fused_flops_reported():
+    mod, _ = _train(True, steps=1)
+    assert mod.train_step_flops() > 0
+
+
+def test_fused_lr_scheduler_steps():
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    mod, _ = _train(
+        True, steps=5,
+        opt_params=(("learning_rate", 0.4), ("lr_scheduler", sched)))
+    assert mod._optimizer.num_update == 5
+
+
+def test_fused_set_params_after_init_optimizer():
+    """set_params while the fused step is active must not be reverted
+    by the next fused update (code-review r2 finding)."""
+    mod, _ = _train(True, steps=2)
+    args, auxs = mod.get_params()
+    new_args = {k: mx.nd.array(np.full(v.shape, 0.01, "float32"))
+                for k, v in args.items()}
+    mod.set_params(new_args, auxs)
+    b = _data(seed=3)
+    mod.forward_backward(b)
+    mod.update()
+    mod.sync()
+    got, _ = mod.get_params()
+    # one SGD step from the 0.01-constant weights, NOT from the old
+    # trajectory: fc2_bias moved but fc1 values stay near 0.01
+    assert abs(got["fc1_weight"].asnumpy().mean() - 0.01) < 5e-3
+    assert not np.allclose(got["fc2_bias"].asnumpy(),
+                           new_args["fc2_bias"].asnumpy())
+
+
+def test_fused_eager_interleave_not_reverted():
+    """An eager update (monitor-style lifecycle) between fused steps
+    must survive the next fused step."""
+    mod, _ = _train(True, steps=2)
+    b = _data(seed=4)
+    # eager lifecycle: forward -> get_outputs -> backward -> update
+    mod.forward(b, is_train=True)
+    mod.get_outputs()
+    mod.backward()
+    mod.update()
+    eager_params = {k: v.asnumpy()
+                    for k, v in mod.get_params()[0].items()}
+    # now a fused step
+    mod.forward_backward(_data(seed=5))
+    mod.update()
+    mod.sync()
+    fused_params = {k: v.asnumpy()
+                    for k, v in mod.get_params()[0].items()}
+    for k in eager_params:
+        assert not np.allclose(eager_params[k], fused_params[k]) or \
+            "bias" in k
+    # the fused step must have STARTED from eager_params: re-derive by
+    # running the same batch through a fresh module seeded with them
+    ref = mx.mod.Module(_mlp(), context=[mx.cpu()])
+    ref.bind(data_shapes=[("data", (64, 20))],
+             label_shapes=[("softmax_label", (64,))])
+    ref.init_params(mx.initializer.Uniform(0.07))
+    ref.set_params({k: mx.nd.array(v) for k, v in eager_params.items()},
+                   {})
+    ref.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),
+                                         ("momentum", 0.9)))
+    ref._disable_fused("ref")
+    ref.forward_backward(_data(seed=5))
+    ref.update()
+    ref_params = {k: v.asnumpy() for k, v in ref.get_params()[0].items()}
+    # momentum state differs (fused kept its own), so compare loosely:
+    # directionally the same step, not the old pre-eager trajectory
+    for k in ref_params:
+        np.testing.assert_allclose(ref_params[k], fused_params[k],
+                                   rtol=0.5, atol=0.05)
+
+
+def test_fused_update_metric_before_update():
+    """forward -> update_metric must reflect THIS batch even when the
+    batch is staged for the fused step."""
+    mod, _ = _train(True, steps=1)
+    b = _data(seed=6)
+    mod.forward(b, is_train=True)
+    m = mx.metric.Accuracy()
+    mod.update_metric(m, b.label)
+    assert m.num_inst == 64
+
+
+def test_fused_reinit_optimizer_preserves_progress():
+    """init_optimizer(force_init=True) mid-training must keep the fused
+    step's trained parameters."""
+    mod, p_before = _train(True, steps=3)
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=True)
+    got, _ = mod.get_params()
+    for k in p_before:
+        np.testing.assert_allclose(got[k].asnumpy(), p_before[k])
+
+
+def test_fused_respects_grad_req_add():
+    """grad_req='add' (gradient accumulation) must keep the eager path."""
+    mod = mx.mod.Module(_mlp(), context=[mx.cpu()])
+    mod.bind(data_shapes=[("data", (64, 20))],
+             label_shapes=[("softmax_label", (64,))], grad_req="add")
+    mod.init_params(mx.initializer.Uniform(0.07))
+    mod.init_optimizer(optimizer="sgd")
+    assert mod._fused_step is None
+
+
+def test_fused_cast_compute_after_set_params():
+    """cast_compute must not resurrect pre-set_params weights."""
+    import jax.numpy as jnp
+
+    mod, _ = _train(True, steps=2)
+    args, auxs = mod.get_params()
+    new_args = {k: mx.nd.array(np.full(v.shape, 0.02, "float32"))
+                for k, v in args.items()}
+    mod.set_params(new_args, auxs)
+    mod.cast_compute(jnp.bfloat16)
+    fs = mod._fused_step
+    np.testing.assert_allclose(
+        np.asarray(fs.params["fc1_weight"]), 0.02, rtol=1e-6)
+
+
+def test_fused_mesh_partial_batch_falls_back():
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs multiple virtual devices")
+    ctxs = [mx.Context("cpu", i) for i in range(4)]
+    mod, _ = _train(True, ctxs=ctxs, kv="tpu", steps=1)
+    odd = _data(batch=62)  # 62 % 4 != 0
+    mod.forward(odd, is_train=True)
+    assert mod._staged_vals is None  # fell back to eager
+
+
+def test_fused_backward_then_get_outputs_then_update():
+    """forward -> backward -> get_outputs -> update must use THIS
+    batch's gradients on the eager fallback path."""
+    _, p_eager = _train(False, steps=1)
+    mod, _ = _train(True, steps=0)
+    b = _data()
+    mod.forward(b, is_train=True)
+    mod.backward()
+    mod.get_outputs()  # materializes eagerly, incl. the backward
+    mod.update()
+    mod.sync()
+    got = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+    for k in p_eager:
+        np.testing.assert_allclose(p_eager[k], got[k],
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_optimizer_state_cross_format(tmp_path):
+    """Fused-saved optimizer states load into an eager module and
+    vice versa."""
+    f_fused = str(tmp_path / "fused.states")
+    f_eager = str(tmp_path / "eager.states")
+    m1, _ = _train(True, steps=3)
+    m1.save_optimizer_states(f_fused)
+    m2, _ = _train(False, steps=3)
+    m2.save_optimizer_states(f_eager)
+    # cross-load both directions
+    m3, _ = _train(False, steps=0)
+    m3.load_optimizer_states(f_fused)
+    mom = m3._updater.states
+    assert len(mom) > 0
+    m4, _ = _train(True, steps=0)
+    m4.load_optimizer_states(f_eager)
+    np.testing.assert_allclose(
+        np.asarray(m4._fused_step.states["fc1_weight"]),
+        np.asarray(m1._fused_step.states["fc1_weight"]), rtol=2e-4,
+        atol=1e-6)
+
+
+def test_disable_fused_transfers_optimizer_state():
+    """Bucketing/monitor-style _disable_fused must hand momentum to the
+    eager updater, not zero it."""
+    mod, _ = _train(True, steps=3)
+    st = np.asarray(mod._fused_step.states["fc1_weight"])
+    mod._disable_fused("test transfer")
+    assert mod._updater is not None
+    # updater slots are index-keyed; find fc1_weight's index
+    idx = {n: i for i, n in mod._optimizer.idx2name.items()}["fc1_weight"]
+    np.testing.assert_allclose(
+        mod._updater.states[idx].asnumpy(), st, rtol=1e-6)
+
+
+def test_updater_fused_states_replicated_per_device():
+    """A fused checkpoint loaded into a multi-device eager module must
+    fill every per-device state slot."""
+    import pickle
+
+    from mxnet_tpu.optimizer import SGD, Updater
+
+    opt = SGD(momentum=0.9,
+              param_idx2name={0: "w", 1: "w"})  # 2 device slots
+    upd = Updater(opt)
+    blob = pickle.dumps({
+        "format": "mxnet_tpu/fused_v1", "t": 3,
+        "states": {"w": np.ones((2, 2), np.float32)},
+    })
+    upd.set_states(blob)
+    assert set(upd.states) == {0, 1}
+    assert upd.states[0] is not upd.states[1]
+
+
+def test_bucketing_grad_req_threaded(tmp_path):
+    import mxnet_tpu as mx
+    from mxnet_tpu.io import DataBatch, DataDesc
+
+    def gen(key):
+        d = mx.sym.Variable("data")
+        # pooled to a fixed width so fc_shared is shape-invariant
+        # across buckets (real bucketing's sharing contract)
+        pooled = mx.sym.mean(d, axis=1, keepdims=True)
+        f = mx.sym.FullyConnected(pooled, name="fc_shared",
+                                  num_hidden=4)
+        return mx.sym.SoftmaxOutput(f, name="softmax"), ("data",), \
+            ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(gen, default_bucket_key=10,
+                                 context=mx.cpu())
+    mod.bind([DataDesc("data", (4, 10))],
+             [DataDesc("softmax_label", (4,))], grad_req="add")
+    mod.init_params()
+    mod.switch_bucket(6, [DataDesc("data", (4, 6))],
+                      [DataDesc("softmax_label", (4,))])
+    assert mod._buckets[6]._exec_group.grad_req["fc_shared_weight"] \
+        == "add"

@@ -41,6 +41,12 @@ class ThreadedEngine(object):
         self._lib = lib
         self._h = lib.eng_create(num_workers)
         self._cbs = {}
+        # provably-safe deferred cleanup of ctypes thunks (see push):
+        # _prev_on_thread maps worker thread id -> tid of the last
+        # callback that STARTED there; _safe collects tids whose thunk
+        # has fully unwound and may be freed
+        self._prev_on_thread = {}
+        self._safe = []
         self._ticket = itertools.count()
         self._lock = threading.Lock()
 
@@ -60,14 +66,26 @@ class ThreadedEngine(object):
         tid = next(self._ticket)
 
         def trampoline(_arg, _tid=tid, _fn=fn):
-            try:
-                _fn()
-            finally:
-                with self._lock:
-                    self._cbs.pop(_tid, None)
+            # The callback's own thunk may not be freed from inside
+            # itself (the worker thread returns through the libffi
+            # closure after this function exits — freeing here is a
+            # use-after-free). Instead: each worker runs callbacks
+            # sequentially, so when THIS trampoline starts, the
+            # previous callback on the same worker thread has fully
+            # unwound — retire that one.
+            ident = threading.get_ident()
+            with self._lock:
+                prev = self._prev_on_thread.get(ident)
+                if prev is not None:
+                    self._safe.append(prev)
+                self._prev_on_thread[ident] = _tid
+            _fn()
 
         cb = _CALLBACK_T(trampoline)
         with self._lock:
+            for t in self._safe:
+                self._cbs.pop(t, None)
+            self._safe.clear()
             self._cbs[tid] = cb
         reads = (ctypes.c_uint64 * max(1, len(rset)))(*sorted(rset))
         writes = (ctypes.c_uint64 * max(1, len(wset)))(*sorted(wset))
@@ -77,6 +95,13 @@ class ThreadedEngine(object):
 
     def wait_for_all(self):
         self._lib.eng_wait_all(self._h)
+        # eng_wait_all returns only after every op's completion count
+        # was decremented, which the C worker does AFTER the callback
+        # thunk has returned — so every callback is freeable
+        with self._lock:
+            self._cbs.clear()
+            self._safe.clear()
+            self._prev_on_thread.clear()
 
     def __del__(self):
         try:

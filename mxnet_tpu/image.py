@@ -292,6 +292,7 @@ class _NativePrefetchRecord(object):
 
         self._native = _native
         self._path = path
+        self._capacity = capacity
         self._r = _native.NativePrefetchReader(path, capacity)
 
     def read(self):
@@ -299,7 +300,9 @@ class _NativePrefetchRecord(object):
 
     def reset(self):
         self._r.close()
-        self._r = self._native.NativePrefetchReader(self._path)
+        self._r = self._native.NativePrefetchReader(
+            self._path, self._capacity
+        )
 
     def close(self):
         self._r.close()

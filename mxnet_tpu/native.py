@@ -83,6 +83,10 @@ def get_lib():
         ]
         lib.rio_prefetcher_stop.restype = None
         lib.rio_prefetcher_stop.argtypes = [ctypes.c_void_p]
+        lib.rio_prefetcher_error.restype = ctypes.c_int64
+        lib.rio_prefetcher_error.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64
+        ]
         _lib = lib
         return _lib
 
@@ -226,6 +230,8 @@ class NativePrefetchReader(object):
     def __init__(self, path, capacity=64, loop=False):
         lib = get_lib()
         self._lib = lib
+        self._path = path
+        self.capacity = capacity
         self._h = lib.rio_prefetcher_start(
             path.encode(), capacity, 1 if loop else 0
         )
@@ -234,6 +240,15 @@ class NativePrefetchReader(object):
 
     def read(self):
         n = self._lib.rio_prefetcher_next(self._h)
+        if n == -2:
+            # worker hit an error (corrupt framing / unreadable file);
+            # surface it instead of a silently truncated epoch
+            msg = ctypes.create_string_buffer(512)
+            self._lib.rio_prefetcher_error(self._h, msg, 512)
+            raise MXNetError(
+                f"recordio prefetch failed on {self._path}: "
+                f"{msg.value.decode() or 'unknown error'}"
+            )
         if n < 0:
             return None
         if n == 0:

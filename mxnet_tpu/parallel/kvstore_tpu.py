@@ -114,6 +114,28 @@ class KVStoreTPU(KVStore):
             ctx=merged.context,
         )
 
+    def init(self, key, value):
+        """Store the value, broadcasting rank-0's copy to all worker
+        processes first. The reference pushes init to the server so all
+        workers start from one weight (kvstore_dist.h Push with init;
+        ADVICE r1: without this, rank-dependent seeding — a common user
+        pattern — silently diverges replicas forever)."""
+        super().init(key, value)
+        if jax.process_count() == 1:
+            return
+        from jax.experimental import multihost_utils
+
+        if not KVStoreTPU._first_collective_done:
+            self._align_processes("first_broadcast")
+            KVStoreTPU._first_collective_done = True
+        keys, _ = _ctype_key_value(key, value)
+        for k in keys:
+            stored = self._store[k]
+            host = multihost_utils.broadcast_one_to_all(stored.asnumpy())
+            self._store[k] = NDArray(
+                jnp.asarray(host), ctx=stored.context
+            )
+
     def push(self, key, value, priority=0):
         """Local device reduce, then cross-process all-reduce, then the
         updater once on the merged value (sync-mode semantics: every

@@ -155,6 +155,8 @@ struct Prefetcher {
   std::atomic<bool> stop{false};
   std::string path;
   bool loop;
+  std::mutex err_m;
+  std::string err;  // sticky: set once by the worker, read by consumer
 
   Prefetcher(const char* p, size_t capacity, bool loop_)
       : queue(capacity), path(p), loop(loop_) {
@@ -163,17 +165,35 @@ struct Prefetcher {
     queue.AddProducer();
   }
 
+  void SetErr(const std::string& e) {
+    std::lock_guard<std::mutex> lk(err_m);
+    if (err.empty()) err = e;
+  }
+
+  bool HasErr() {
+    std::lock_guard<std::mutex> lk(err_m);
+    return !err.empty();
+  }
+
   void Run() {
     do {
       Reader r;
       r.f = std::fopen(path.c_str(), "rb");
-      if (!r.f) break;
+      if (!r.f) {
+        SetErr("cannot open file");
+        break;
+      }
       std::vector<uint8_t> rec;
       while (!stop.load() && r.Next(&rec)) {
         if (!queue.Push(std::move(rec))) break;
         rec.clear();
       }
       std::fclose(r.f);
+      if (!r.err.empty()) {
+        // a corrupt file must surface as an error, not a short epoch
+        SetErr(r.err);
+        break;
+      }
     } while (loop && !stop.load());
     queue.RemoveProducer();
   }
@@ -250,11 +270,25 @@ void* rio_prefetcher_start(const char* path, int64_t capacity,
   return p;
 }
 
-// Pops the next record into g_last; same protocol as rio_reader_next.
+// Pops the next record into g_last; same protocol as rio_reader_next
+// (-1 clean EOF, -2 error — e.g. corrupt file or failed open).
 int64_t rio_prefetcher_next(void* h) {
   auto* p = static_cast<Prefetcher*>(h);
-  if (!p->queue.Pop(&g_last)) return -1;
+  if (!p->queue.Pop(&g_last)) {
+    return p->HasErr() ? -2 : -1;
+  }
   return static_cast<int64_t>(g_last.size());
+}
+
+// Copies the worker's error message (empty string when none).
+int64_t rio_prefetcher_error(void* h, char* buf, int64_t cap) {
+  auto* p = static_cast<Prefetcher*>(h);
+  std::lock_guard<std::mutex> lk(p->err_m);
+  int64_t n = static_cast<int64_t>(p->err.size());
+  if (n >= cap) n = cap - 1;
+  if (n > 0) std::memcpy(buf, p->err.data(), static_cast<size_t>(n));
+  if (cap > 0) buf[n] = '\0';
+  return n;
 }
 
 void rio_prefetcher_fetch(void* h, uint8_t* buf) {

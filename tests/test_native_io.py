@@ -130,3 +130,51 @@ def test_native_reader_used_for_sequential(tmp_path):
     r.reset()
     assert r.read() is not None
     r.close()
+
+
+def test_prefetch_corrupt_file_raises(tmp_path):
+    """ADVICE r1: a corrupt .rec must raise through the prefetcher, not
+    silently truncate the epoch."""
+    import pytest
+
+    from mxnet_tpu import recordio
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.native import NativePrefetchReader, available
+
+    if not available():
+        pytest.skip("native core unavailable")
+    path = str(tmp_path / "bad.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(4):
+        w.write(b"payload-%d" % i)
+    w.close()
+    with open(path, "r+b") as f:
+        f.seek(20)
+        f.write(b"\xde\xad\xbe\xef")  # clobber framing mid-file
+
+    r = NativePrefetchReader(path)
+    with pytest.raises(MXNetError, match="prefetch failed"):
+        for _ in range(10):
+            if r.read() is None:
+                raise AssertionError("EOF reported instead of error")
+    r.close()
+
+
+def test_prefetch_capacity_survives_reset(tmp_path):
+    from mxnet_tpu import recordio
+    from mxnet_tpu.image import _NativePrefetchRecord
+    from mxnet_tpu.native import available
+
+    import pytest
+
+    if not available():
+        pytest.skip("native core unavailable")
+    path = str(tmp_path / "ok.rec")
+    w = recordio.MXRecordIO(path, "w")
+    w.write(b"x")
+    w.close()
+    r = _NativePrefetchRecord(path, capacity=7)
+    assert r._r.capacity == 7
+    r.reset()
+    assert r._r.capacity == 7
+    r.close()

@@ -1,0 +1,79 @@
+"""Cross-context consistency tier (SURVEY §4 idiom 2).
+
+The reference binds the same symbol on cpu/gpu/fp16 variants and
+requires agreeing outputs (tests/python/gpu/test_operator_gpu.py:242-285
+via test_utils.check_consistency). The TPU analogs available on the
+virtual CPU mesh: two distinct CPU device contexts, and an fp32-vs-bf16
+compute comparison at a loose tolerance tier.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import test_utils as tu
+
+
+def _two_ctx():
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 virtual devices")
+    return [mx.Context("cpu", 0), mx.Context("cpu", 1)]
+
+
+def test_mlp_consistency_across_devices():
+    c0, c1 = _two_ctx()
+    net = mx.sym.FullyConnected(
+        mx.sym.Variable("data"), num_hidden=8, name="fc")
+    net = mx.sym.Activation(net, act_type="tanh")
+    tu.check_consistency(
+        net,
+        [{"ctx": c0, "data": (4, 6)}, {"ctx": c1, "data": (4, 6)}],
+    )
+
+
+def test_conv_bn_consistency_across_devices():
+    c0, c1 = _two_ctx()
+    d = mx.sym.Variable("data")
+    net = mx.sym.Convolution(d, kernel=(3, 3), num_filter=4, pad=(1, 1),
+                             name="conv")
+    net = mx.sym.BatchNorm(net, name="bn")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    tu.check_consistency(
+        net,
+        [{"ctx": c0, "data": (2, 3, 8, 8)},
+         {"ctx": c1, "data": (2, 3, 8, 8)}],
+    )
+
+
+@pytest.mark.parametrize("op", ["dot", "conv"])
+def test_bf16_vs_fp32_tolerance_tier(op):
+    """fp32 and bf16 compute agree within the bf16 tier (SURVEY hard
+    part (f): tolerance tuning on bf16-default hardware)."""
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(0)
+    if op == "dot":
+        a = rs.standard_normal((16, 32)).astype(np.float32)
+        b = rs.standard_normal((32, 8)).astype(np.float32)
+        f32 = a @ b
+        b16 = np.asarray(
+            jnp.asarray(a, jnp.bfloat16) @ jnp.asarray(b, jnp.bfloat16),
+            np.float32)
+    else:
+        from mxnet_tpu.ops import registry
+
+        conv = registry.get("Convolution")
+        x = rs.standard_normal((1, 3, 8, 8)).astype(np.float32)
+        w = rs.standard_normal((4, 3, 3, 3)).astype(np.float32)
+        bias = np.zeros(4, np.float32)
+        params = conv.normalize_params(
+            {"kernel": (3, 3), "num_filter": 4})
+        f32 = np.asarray(conv.fn(x, w, bias, **params))
+        b16 = np.asarray(
+            conv.fn(jnp.asarray(x, jnp.bfloat16),
+                    jnp.asarray(w, jnp.bfloat16),
+                    jnp.asarray(bias, jnp.bfloat16), **params),
+            np.float32)
+    np.testing.assert_allclose(f32, b16, rtol=0.05, atol=0.05)

@@ -1,0 +1,310 @@
+// Header-only C++ frontend over the mxnet_tpu C API.
+//
+// The analog of the reference's cpp-package
+// (cpp-package/include/mxnet-cpp/: NDArray/Symbol/Executor/Optimizer
+// classes over c_api.h). One header, RAII handles, exceptions on error.
+//
+// Link against native/libmxtpu_c.so (built by
+// mxnet_tpu.native.build_core_lib) plus the python3 embed flags.
+//
+// Example (cpp-package/example/mlp.cc): builds an MLP symbolically,
+// binds an executor, and trains with fused sgd_update through
+// ImperativeInvokeInto — end to end from C++.
+
+#ifndef MXNET_TPU_CPP_MXTPUCPP_HPP_
+#define MXNET_TPU_CPP_MXTPUCPP_HPP_
+
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "../../../native/mxnet_tpu_c_api.h"
+
+namespace mxtpu {
+
+inline void Check(int rc, const char* what) {
+  if (rc != 0) {
+    throw std::runtime_error(std::string(what) + ": " +
+                             MXTpuGetLastError());
+  }
+}
+
+// RAII wrapper for any API handle.
+class Handle {
+ public:
+  Handle() = default;
+  explicit Handle(void* h) : h_(h) {}
+  Handle(const Handle&) = delete;
+  Handle& operator=(const Handle&) = delete;
+  Handle(Handle&& o) noexcept : h_(o.h_) { o.h_ = nullptr; }
+  Handle& operator=(Handle&& o) noexcept {
+    if (this != &o) {
+      Reset();
+      h_ = o.h_;
+      o.h_ = nullptr;
+    }
+    return *this;
+  }
+  ~Handle() { Reset(); }
+  void Reset() {
+    if (h_ != nullptr) MXTpuHandleFree(h_);
+    h_ = nullptr;
+  }
+  void* get() const { return h_; }
+  explicit operator bool() const { return h_ != nullptr; }
+
+ private:
+  void* h_ = nullptr;
+};
+
+using KWArgs = std::map<std::string, std::string>;
+
+inline std::pair<std::vector<const char*>, std::vector<const char*>>
+KwPtrs(const KWArgs& kw) {
+  std::vector<const char*> keys, vals;
+  for (const auto& it : kw) {
+    keys.push_back(it.first.c_str());
+    vals.push_back(it.second.c_str());
+  }
+  return {std::move(keys), std::move(vals)};
+}
+
+class NDArray {
+ public:
+  NDArray() = default;
+  explicit NDArray(void* raw) : h_(raw) {}
+  NDArray(const std::vector<int>& shape,
+          const std::vector<float>& data) {
+    void* out = nullptr;
+    Check(MXTpuNDArrayCreate(shape.data(),
+                             static_cast<int>(shape.size()),
+                             data.data(), &out),
+          "NDArrayCreate");
+    h_ = Handle(out);
+  }
+  static NDArray Zeros(const std::vector<int>& shape) {
+    void* out = nullptr;
+    Check(MXTpuNDArrayZeros(shape.data(),
+                            static_cast<int>(shape.size()), &out),
+          "NDArrayZeros");
+    return NDArray(out);
+  }
+
+  std::vector<int> Shape() const {
+    int ndim = 0;
+    std::vector<int> dims(16);
+    Check(MXTpuNDArrayGetShape(h_.get(), dims.data(),
+                               static_cast<int>(dims.size()), &ndim),
+          "NDArrayGetShape");
+    if (ndim > static_cast<int>(dims.size())) {
+      dims.resize(static_cast<size_t>(ndim));
+      Check(MXTpuNDArrayGetShape(h_.get(), dims.data(), ndim, &ndim),
+            "NDArrayGetShape");
+    }
+    dims.resize(static_cast<size_t>(ndim));
+    return dims;
+  }
+
+  std::vector<float> Data() const {
+    long n = 1;
+    for (int d : Shape()) n *= d;
+    std::vector<float> buf(static_cast<size_t>(n));
+    Check(MXTpuNDArrayCopyOut(h_.get(), buf.data(), n) < 0 ? -1 : 0,
+          "NDArrayCopyOut");
+    return buf;
+  }
+
+  void Set(const std::vector<float>& data) {
+    Check(MXTpuNDArrayCopyIn(h_.get(), data.data(),
+                             static_cast<long>(data.size())),
+          "NDArrayCopyIn");
+  }
+
+  void* get() const { return h_.get(); }
+
+ private:
+  Handle h_;
+};
+
+// Imperative op call producing new arrays.
+inline std::vector<NDArray> Invoke(const std::string& op,
+                                   const std::vector<void*>& inputs,
+                                   const KWArgs& kw = {}) {
+  auto ptrs = KwPtrs(kw);
+  int num_out = 0;
+  void** outs = nullptr;
+  Check(MXTpuImperativeInvoke(
+            op.c_str(), static_cast<int>(inputs.size()),
+            const_cast<void**>(inputs.data()),
+            static_cast<int>(ptrs.first.size()), ptrs.first.data(),
+            ptrs.second.data(), &num_out, &outs),
+        op.c_str());
+  std::vector<NDArray> result;
+  for (int i = 0; i < num_out; ++i) result.emplace_back(outs[i]);
+  return result;
+}
+
+// Imperative op call writing into existing arrays (fused updates).
+inline void InvokeInto(const std::string& op,
+                       const std::vector<void*>& inputs,
+                       const std::vector<void*>& outputs,
+                       const KWArgs& kw = {}) {
+  auto ptrs = KwPtrs(kw);
+  Check(MXTpuImperativeInvokeInto(
+            op.c_str(), static_cast<int>(inputs.size()),
+            const_cast<void**>(inputs.data()),
+            static_cast<int>(ptrs.first.size()), ptrs.first.data(),
+            ptrs.second.data(), static_cast<int>(outputs.size()),
+            const_cast<void**>(outputs.data())),
+        op.c_str());
+}
+
+class Symbol {
+ public:
+  Symbol() = default;
+  explicit Symbol(void* raw) : h_(raw) {}
+
+  static Symbol Variable(const std::string& name) {
+    void* out = nullptr;
+    Check(MXTpuSymbolCreateVariable(name.c_str(), &out), "Variable");
+    return Symbol(out);
+  }
+
+  // Op node: inputs are (input_name -> symbol), params are strings.
+  static Symbol Create(
+      const std::string& op,
+      const std::vector<std::pair<std::string, const Symbol*>>& inputs,
+      const KWArgs& params = {}, const std::string& name = "") {
+    auto ptrs = KwPtrs(params);
+    std::vector<const char*> in_keys;
+    std::vector<void*> in_syms;
+    for (const auto& it : inputs) {
+      in_keys.push_back(it.first.c_str());
+      in_syms.push_back(it.second->h_.get());
+    }
+    void* out = nullptr;
+    Check(MXTpuSymbolCreate(
+              op.c_str(), static_cast<int>(ptrs.first.size()),
+              ptrs.first.data(), ptrs.second.data(), name.c_str(),
+              static_cast<int>(in_keys.size()), in_keys.data(),
+              in_syms.data(), &out),
+          op.c_str());
+    return Symbol(out);
+  }
+
+  std::string ToJSON() const {
+    const char* js = nullptr;
+    Check(MXTpuSymbolToJSON(h_.get(), &js), "SymbolToJSON");
+    return std::string(js);
+  }
+
+  std::vector<std::string> List(const std::string& kind) const {
+    int n = 0;
+    const char** names = nullptr;
+    Check(MXTpuSymbolList(h_.get(), kind.c_str(), &n, &names),
+          "SymbolList");
+    return std::vector<std::string>(names, names + n);
+  }
+  std::vector<std::string> ListArguments() const { return List("arg"); }
+  std::vector<std::string> ListOutputs() const { return List("out"); }
+
+  void* get() const { return h_.get(); }
+
+ private:
+  Handle h_;
+};
+
+class Executor {
+ public:
+  Executor(const Symbol& sym, const std::string& ctx_type, int dev_id,
+           const std::string& grad_req,
+           const std::map<std::string, std::vector<int>>& shapes) {
+    std::vector<const char*> names;
+    std::vector<int> ind{0}, data;
+    for (const auto& it : shapes) {
+      names.push_back(it.first.c_str());
+      data.insert(data.end(), it.second.begin(), it.second.end());
+      ind.push_back(static_cast<int>(data.size()));
+    }
+    void* out = nullptr;
+    Check(MXTpuExecutorSimpleBind(
+              sym.get(), ctx_type.c_str(), dev_id, grad_req.c_str(),
+              static_cast<int>(names.size()), names.data(), ind.data(),
+              data.data(), &out),
+          "SimpleBind");
+    h_ = Handle(out);
+  }
+
+  void Forward(bool is_train) {
+    Check(MXTpuExecutorForward(h_.get(), is_train ? 1 : 0), "Forward");
+  }
+  void Backward() {
+    Check(MXTpuExecutorBackward(h_.get()), "Backward");
+  }
+
+  std::vector<NDArray> Outputs() const {
+    int n = 0;
+    void** outs = nullptr;
+    Check(MXTpuExecutorOutputs(h_.get(), &n, &outs), "Outputs");
+    std::vector<NDArray> result;
+    for (int i = 0; i < n; ++i) result.emplace_back(outs[i]);
+    return result;
+  }
+
+  NDArray Arg(const std::string& name) const {
+    return Array(name, "arg");
+  }
+  NDArray Grad(const std::string& name) const {
+    return Array(name, "grad");
+  }
+
+ private:
+  NDArray Array(const std::string& name, const std::string& kind) const {
+    void* out = nullptr;
+    Check(MXTpuExecutorArray(h_.get(), name.c_str(), kind.c_str(),
+                             &out),
+          "ExecutorArray");
+    return NDArray(out);
+  }
+
+  Handle h_;
+};
+
+// Minimal optimizer over fused update ops (the cpp-package Optimizer
+// analog): sgd with optional momentum, updating executor arrays
+// in place through InvokeInto.
+class SGDOptimizer {
+ public:
+  explicit SGDOptimizer(float lr, float momentum = 0.0f,
+                        float wd = 0.0f, float rescale = 1.0f)
+      : lr_(lr), momentum_(momentum), wd_(wd), rescale_(rescale) {}
+
+  void Update(NDArray* weight, const NDArray& grad) {
+    KWArgs kw{{"lr", std::to_string(lr_)},
+              {"wd", std::to_string(wd_)},
+              {"rescale_grad", std::to_string(rescale_)}};
+    if (momentum_ == 0.0f) {
+      InvokeInto("sgd_update", {weight->get(), grad.get()},
+                 {weight->get()}, kw);
+      return;
+    }
+    kw["momentum"] = std::to_string(momentum_);
+    void* key = weight->get();
+    if (mom_.find(key) == mom_.end()) {
+      mom_.emplace(key, NDArray::Zeros(weight->Shape()));
+    }
+    NDArray& m = mom_.at(key);
+    InvokeInto("sgd_mom_update", {weight->get(), grad.get(), m.get()},
+               {weight->get(), m.get()}, kw);
+  }
+
+ private:
+  float lr_, momentum_, wd_, rescale_;
+  std::map<void*, NDArray> mom_;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXNET_TPU_CPP_MXTPUCPP_HPP_

@@ -1,0 +1,159 @@
+"""Support shim for the embeddable C API (native/capi_core.cc).
+
+The reference's C API (include/mxnet/c_api.h, 119 functions) sits UNDER
+its Python frontend; here the layering inverts — the C library embeds
+CPython and marshals into these flat helpers, which accept/return only
+simple types plus NDArray/Symbol/Executor objects (whose PyObject* are
+the C handles). Keeping the marshaling surface here keeps the C side to
+reference-counting and argument packing.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import ndarray as nd
+from .base import MXNetError
+
+
+# ------------------------------------------------------------- ndarray
+
+def ndarray_from_data(shape, flat):
+    arr = np.asarray(flat, np.float32).reshape(tuple(shape))
+    return nd.array(arr)
+
+
+def ndarray_zeros(shape):
+    return nd.zeros(tuple(shape))
+
+
+def ndarray_shape(a):
+    return list(a.shape)
+
+
+def ndarray_to_list(a):
+    return np.asarray(a.asnumpy(), np.float32).ravel().tolist()
+
+
+def ndarray_copy_from(a, flat):
+    a[:] = np.asarray(flat, np.float32).reshape(a.shape)
+
+
+def ndarray_save(fname, handles, keys):
+    if keys:
+        nd.save(fname, dict(zip(keys, handles)))
+    else:
+        nd.save(fname, list(handles))
+
+
+def ndarray_load(fname):
+    """-> (keys list (may be empty), values list)"""
+    data = nd.load(fname)
+    if isinstance(data, dict):
+        return list(data.keys()), list(data.values())
+    return [], list(data)
+
+
+# ---------------------------------------------------------- imperative
+
+def invoke(op_name, inputs, params):
+    """Run a registered op imperatively; returns list of NDArrays
+    (the MXImperativeInvoke analog, reference
+    src/c_api/c_api_ndarray.cc:322)."""
+    fn = getattr(nd, op_name, None)
+    if fn is None or not callable(fn):
+        raise MXNetError(f"unknown imperative op {op_name!r}")
+    out = fn(*inputs, **params)
+    return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+def invoke_into(op_name, inputs, params, outputs):
+    """Imperative invoke writing results into existing NDArrays (the
+    reference's out-array form, used by fused optimizer updates)."""
+    res = invoke(op_name, inputs, params)
+    if len(res) < len(outputs):
+        raise MXNetError(
+            f"{op_name}: {len(res)} outputs < {len(outputs)} requested")
+    for dst, src in zip(outputs, res):
+        dst._set_data(src._data)
+    return len(outputs)
+
+
+# -------------------------------------------------------------- symbol
+
+def symbol_variable(name):
+    from . import symbol as sym
+
+    return sym.Variable(name)
+
+
+def symbol_create(op_name, params, name, input_keys, input_syms):
+    """Create+compose an op symbol (the CreateAtomicSymbol+Compose pair
+    collapsed — our symbols compose at construction)."""
+    from . import symbol as sym
+
+    fn = getattr(sym, op_name, None)
+    if fn is None or not callable(fn):
+        raise MXNetError(f"unknown symbol op {op_name!r}")
+    kwargs = dict(zip(input_keys, input_syms))
+    kwargs.update(params)
+    if name:
+        kwargs["name"] = name
+    return fn(**kwargs)
+
+
+def symbol_from_json(js):
+    from . import symbol as sym
+
+    return sym.loads(js)
+
+
+def symbol_to_json(s):
+    return s.tojson()
+
+
+def symbol_list(s, kind):
+    if kind == "arg":
+        return s.list_arguments()
+    if kind == "out":
+        return s.list_outputs()
+    if kind == "aux":
+        return s.list_auxiliary_states()
+    raise MXNetError(f"unknown list kind {kind!r}")
+
+
+def symbol_infer_shape(s, names, shapes):
+    arg_shapes, out_shapes, aux_shapes = s.infer_shape(
+        **{n: tuple(sh) for n, sh in zip(names, shapes)})
+    to_l = lambda xs: [list(x) for x in xs]
+    return to_l(arg_shapes), to_l(out_shapes), to_l(aux_shapes)
+
+
+# ------------------------------------------------------------ executor
+
+def executor_bind(s, ctx_type, dev_id, grad_req, names, shapes):
+    from . import context as ctx
+
+    c = ctx.Context(ctx_type, dev_id)
+    return s.simple_bind(
+        ctx=c, grad_req=grad_req,
+        **{n: tuple(sh) for n, sh in zip(names, shapes)})
+
+
+def executor_forward(ex, is_train):
+    ex.forward(is_train=bool(is_train))
+
+
+def executor_backward(ex):
+    ex.backward()
+
+
+def executor_outputs(ex):
+    return list(ex.outputs)
+
+
+def executor_arg(ex, name, kind):
+    table = {"arg": ex.arg_dict, "grad": ex.grad_dict,
+             "aux": ex.aux_dict}[kind]
+    if name not in table:
+        raise MXNetError(f"no {kind} array named {name!r}")
+    return table[name]

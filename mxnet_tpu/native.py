@@ -103,30 +103,53 @@ _PREDICT_SRC = os.path.join(_NATIVE_DIR, "capi_predict.cc")
 _PREDICT_SO = os.path.join(_NATIVE_DIR, "libmxtpu_predict.so")
 
 
-def build_predict_lib():
-    """Build the embeddable C predict API (native/capi_predict.cc) —
-    the amalgamation/libmxnet_predict analog. Returns the .so path."""
-    if (
-        os.path.exists(_PREDICT_SO)
-        and os.path.getmtime(_PREDICT_SO)
-        >= os.path.getmtime(_PREDICT_SRC)
-    ):
-        return _PREDICT_SO
+def embed_flags():
+    """python3-config flags for embedding CPython, validated."""
     cfg = subprocess.run(
         ["python3-config", "--includes", "--ldflags", "--embed"],
         capture_output=True, text=True,
     )
-    flags = cfg.stdout.split()
+    if cfg.returncode != 0 or not cfg.stdout.strip():
+        raise MXNetError(
+            "python3-config --embed failed (Python built without "
+            f"embed support?): {cfg.stderr}"
+        )
+    return cfg.stdout.split()
+
+
+def _build_embed_lib(src, so, label):
+    """Compile an embeddable (CPython-hosting) C API library, cached by
+    mtime."""
+    if os.path.exists(so) and \
+            os.path.getmtime(so) >= os.path.getmtime(src):
+        return so
     cmd = (
-        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _PREDICT_SRC]
-        + flags + ["-o", _PREDICT_SO]
+        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", src]
+        + embed_flags() + ["-o", so]
     )
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
         raise MXNetError(
-            f"predict lib build failed: {' '.join(cmd)}\n{proc.stderr}"
+            f"{label} build failed: {' '.join(cmd)}\n{proc.stderr}"
         )
-    return _PREDICT_SO
+    return so
+
+
+def build_predict_lib():
+    """Build the embeddable C predict API (native/capi_predict.cc) —
+    the amalgamation/libmxnet_predict analog. Returns the .so path."""
+    return _build_embed_lib(_PREDICT_SRC, _PREDICT_SO, "predict lib")
+
+
+_CORE_SRC = os.path.join(_NATIVE_DIR, "capi_core.cc")
+_CORE_SO = os.path.join(_NATIVE_DIR, "libmxtpu_c.so")
+
+
+def build_core_lib():
+    """Build the embeddable core C API (native/capi_core.cc — NDArray/
+    imperative/Symbol/Executor tiers of the reference c_api.h). Returns
+    the .so path."""
+    return _build_embed_lib(_CORE_SRC, _CORE_SO, "core C API")
 
 
 _ENGINE_SRC = os.path.join(_NATIVE_DIR, "engine_core.cc")

@@ -1,0 +1,538 @@
+// Embeddable C API — NDArray / imperative-invoke / Symbol / Executor.
+//
+// Capability parity with the reference's core C ABI
+// (include/mxnet/c_api.h: MXNDArray*, MXImperativeInvoke, MXSymbol*,
+// MXExecutor*, with per-thread MXGetLastError via
+// src/c_api/c_api_error.cc). Same embedding architecture as
+// capi_predict.cc: the compute path is XLA-via-jax in Python, so this
+// library hosts a CPython interpreter and marshals flat C calls into
+// mxnet_tpu.capi (the support shim); PyObject* doubles as the C handle
+// for NDArray / Symbol / Executor objects.
+//
+// Conventions:
+//   - every function returns 0 on success, -1 on failure;
+//     MXTpuGetLastError() returns the calling thread's last message.
+//   - "list out" results (names, handles) live in thread-local storage
+//     owned by the library and are valid until the thread's next call.
+//
+// Build (see mxnet_tpu/native.py build_core_lib):
+//   g++ -O2 -std=c++17 -shared -fPIC capi_core.cc \
+//       $(python3-config --includes --ldflags --embed) -o libmxtpu_c.so
+
+#include <Python.h>
+
+#include <cstdarg>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::once_flag g_init_once;
+thread_local std::string tls_err;
+thread_local std::vector<std::string> tls_strs;
+thread_local std::vector<const char*> tls_strps;
+thread_local std::vector<void*> tls_handles;
+thread_local std::vector<int> tls_shape_data;
+
+void EnsurePython() {
+  std::call_once(g_init_once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      PyEval_SaveThread();
+    }
+  });
+}
+
+// Build a Python str from a C string; never fails (non-UTF-8 byte
+// sequences — legal in e.g. filenames — fall back to Latin-1 so the
+// call surfaces a Python-level error instead of a NULL element crash).
+PyObject* Str(const char* s) {
+  PyObject* o = PyUnicode_FromString(s);
+  if (o == nullptr) {
+    PyErr_Clear();
+    o = PyUnicode_DecodeLatin1(s, static_cast<Py_ssize_t>(strlen(s)),
+                               nullptr);
+  }
+  return o;
+}
+
+void SetError(const char* where) {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  tls_err = where;
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      tls_err += ": ";
+      tls_err += PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+// Call mxnet_tpu.capi.<fn>(args...) with a pre-built argument tuple.
+// Returns a NEW reference or nullptr (error recorded).
+PyObject* CallShim(const char* fn, PyObject* args) {
+  PyObject* mod = PyImport_ImportModule("mxnet_tpu.capi");
+  if (mod == nullptr) {
+    SetError("import mxnet_tpu.capi");
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject* f = PyObject_GetAttrString(mod, fn);
+  Py_DECREF(mod);
+  if (f == nullptr) {
+    SetError(fn);
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject* r = args ? PyObject_CallObject(f, args)
+                     : PyObject_CallObject(f, nullptr);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  if (r == nullptr) SetError(fn);
+  return r;
+}
+
+PyObject* IntList(const int* data, int n) {
+  PyObject* lst = PyList_New(n);
+  for (int i = 0; i < n; ++i)
+    PyList_SET_ITEM(lst, i, PyLong_FromLong(data[i]));
+  return lst;
+}
+
+PyObject* FloatList(const float* data, long n) {
+  PyObject* lst = PyList_New(n);
+  for (long i = 0; i < n; ++i)
+    PyList_SET_ITEM(lst, i, PyFloat_FromDouble(data[i]));
+  return lst;
+}
+
+PyObject* StrList(const char** data, int n) {
+  PyObject* lst = PyList_New(n);
+  for (int i = 0; i < n; ++i)
+    PyList_SET_ITEM(lst, i, Str(data[i]));
+  return lst;
+}
+
+PyObject* HandleList(void** data, int n) {
+  PyObject* lst = PyList_New(n);
+  for (int i = 0; i < n; ++i) {
+    PyObject* o = static_cast<PyObject*>(data[i]);
+    Py_INCREF(o);
+    PyList_SET_ITEM(lst, i, o);
+  }
+  return lst;
+}
+
+// {keys[i]: vals[i]} with string values (the shim's op param coercion
+// maps accept strings, matching the reference's all-strings C params)
+PyObject* StrDict(int n, const char** keys, const char** vals) {
+  PyObject* d = PyDict_New();
+  for (int i = 0; i < n; ++i) {
+    PyObject* v = Str(vals[i]);
+    PyDict_SetItemString(d, keys[i], v);
+    Py_DECREF(v);
+  }
+  return d;
+}
+
+// Shape spec packing used across the ABI: entity i's dims live in
+// shape_data[shape_ind[i] .. shape_ind[i+1])
+PyObject* ShapeLists(int num, const int* shape_ind,
+                     const int* shape_data) {
+  PyObject* out = PyList_New(num);
+  for (int i = 0; i < num; ++i) {
+    int lo = shape_ind[i], hi = shape_ind[i + 1];
+    PyObject* s = PyList_New(hi - lo);
+    for (int j = lo; j < hi; ++j)
+      PyList_SET_ITEM(s, j - lo, PyLong_FromLong(shape_data[j]));
+    PyList_SET_ITEM(out, i, s);
+  }
+  return out;
+}
+
+// Store a python list of strings into TLS; returns (count, ptr array).
+int StashStrList(PyObject* lst, int* num, const char*** out) {
+  tls_strs.clear();
+  tls_strps.clear();
+  Py_ssize_t n = PyList_Size(lst);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* s = PyUnicode_AsUTF8(PyList_GET_ITEM(lst, i));
+    tls_strs.emplace_back(s ? s : "");
+  }
+  for (auto& s : tls_strs) tls_strps.push_back(s.c_str());
+  *num = static_cast<int>(n);
+  *out = tls_strps.data();
+  return 0;
+}
+
+// Store a python list of objects as NEW-reference handles in TLS.
+int StashHandleList(PyObject* lst, int* num, void*** out) {
+  tls_handles.clear();
+  Py_ssize_t n = PyList_Size(lst);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GET_ITEM(lst, i);
+    Py_INCREF(o);
+    tls_handles.push_back(o);
+  }
+  *num = static_cast<int>(n);
+  *out = tls_handles.data();
+  return 0;
+}
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() {
+    EnsurePython();
+    st = PyGILState_Ensure();
+  }
+  ~Gil() { PyGILState_Release(st); }
+};
+
+}  // namespace
+
+extern "C" {
+
+const char* MXTpuGetLastError() { return tls_err.c_str(); }
+
+int MXTpuHandleFree(void* h) {
+  Gil gil;
+  Py_XDECREF(static_cast<PyObject*>(h));
+  return 0;
+}
+
+// ------------------------------------------------------------ NDArray
+
+int MXTpuNDArrayCreate(const int* shape, int ndim, const float* data,
+                       void** out) {
+  Gil gil;
+  PyObject* args = PyTuple_New(2);
+  PyTuple_SET_ITEM(args, 0, IntList(shape, ndim));
+  long size = 1;
+  for (int i = 0; i < ndim; ++i) size *= shape[i];
+  PyTuple_SET_ITEM(args, 1, FloatList(data, size));
+  PyObject* r = CallShim("ndarray_from_data", args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+int MXTpuNDArrayZeros(const int* shape, int ndim, void** out) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  PyTuple_SET_ITEM(args, 0, IntList(shape, ndim));
+  PyObject* r = CallShim("ndarray_zeros", args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+// Writes up to cap dims into shape; returns ndim via out param.
+int MXTpuNDArrayGetShape(void* h, int* shape, int cap, int* ndim) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  Py_INCREF(static_cast<PyObject*>(h));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(h));
+  PyObject* r = CallShim("ndarray_shape", args);
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PyList_Size(r);
+  *ndim = static_cast<int>(n);
+  for (Py_ssize_t i = 0; i < n && i < cap; ++i)
+    shape[i] = static_cast<int>(
+        PyLong_AsLong(PyList_GET_ITEM(r, i)));
+  Py_DECREF(r);
+  return 0;
+}
+
+// Copies the (row-major) float data out; returns element count, or -1.
+// buf may be NULL to query the size.
+long MXTpuNDArrayCopyOut(void* h, float* buf, long cap) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  Py_INCREF(static_cast<PyObject*>(h));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(h));
+  PyObject* r = CallShim("ndarray_to_list", args);
+  if (r == nullptr) return -1;
+  Py_ssize_t n = PyList_Size(r);
+  if (buf != nullptr) {
+    for (Py_ssize_t i = 0; i < n && i < cap; ++i)
+      buf[i] = static_cast<float>(
+          PyFloat_AsDouble(PyList_GET_ITEM(r, i)));
+  }
+  Py_DECREF(r);
+  return static_cast<long>(n);
+}
+
+// Overwrites the array's contents from a row-major float buffer whose
+// length must equal the array size (reference MXNDArraySyncCopyFromCPU).
+int MXTpuNDArrayCopyIn(void* h, const float* data, long size) {
+  Gil gil;
+  PyObject* args = PyTuple_New(2);
+  Py_INCREF(static_cast<PyObject*>(h));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(h));
+  PyTuple_SET_ITEM(args, 1, FloatList(data, size));
+  PyObject* r = CallShim("ndarray_copy_from", args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuNDArraySave(const char* fname, int num, void** handles,
+                     const char** keys) {
+  Gil gil;
+  PyObject* args = PyTuple_New(3);
+  PyTuple_SET_ITEM(args, 0, Str(fname));
+  PyTuple_SET_ITEM(args, 1, HandleList(handles, num));
+  PyTuple_SET_ITEM(args, 2,
+                   keys ? StrList(keys, num) : PyList_New(0));
+  PyObject* r = CallShim("ndarray_save", args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// Loaded keys via MXTpuLastStrList, handles via out params (TLS).
+int MXTpuNDArrayLoad(const char* fname, int* num_out, void*** out,
+                     int* num_keys, const char*** keys) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  PyTuple_SET_ITEM(args, 0, Str(fname));
+  PyObject* r = CallShim("ndarray_load", args);
+  if (r == nullptr) return -1;
+  PyObject* klist = PyTuple_GET_ITEM(r, 0);
+  PyObject* vlist = PyTuple_GET_ITEM(r, 1);
+  StashStrList(klist, num_keys, keys);
+  StashHandleList(vlist, num_out, out);
+  Py_DECREF(r);
+  return 0;
+}
+
+// -------------------------------------------------- imperative invoke
+
+// New-output form: results become TLS handles (valid until this
+// thread's next call).
+int MXTpuImperativeInvoke(const char* op, int num_in, void** inputs,
+                          int num_params, const char** keys,
+                          const char** vals, int* num_out,
+                          void*** outputs) {
+  Gil gil;
+  PyObject* args = PyTuple_New(3);
+  PyTuple_SET_ITEM(args, 0, Str(op));
+  PyTuple_SET_ITEM(args, 1, HandleList(inputs, num_in));
+  PyTuple_SET_ITEM(args, 2, StrDict(num_params, keys, vals));
+  PyObject* r = CallShim("invoke", args);
+  if (r == nullptr) return -1;
+  StashHandleList(r, num_out, outputs);
+  Py_DECREF(r);
+  return 0;
+}
+
+// In-place form: writes results into the given existing NDArrays (the
+// reference's out-array convention — how fused optimizer updates
+// mutate executor weights from C).
+int MXTpuImperativeInvokeInto(const char* op, int num_in,
+                              void** inputs, int num_params,
+                              const char** keys, const char** vals,
+                              int num_out, void** outputs) {
+  Gil gil;
+  PyObject* args = PyTuple_New(4);
+  PyTuple_SET_ITEM(args, 0, Str(op));
+  PyTuple_SET_ITEM(args, 1, HandleList(inputs, num_in));
+  PyTuple_SET_ITEM(args, 2, StrDict(num_params, keys, vals));
+  PyTuple_SET_ITEM(args, 3, HandleList(outputs, num_out));
+  PyObject* r = CallShim("invoke_into", args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// ------------------------------------------------------------- Symbol
+
+int MXTpuSymbolCreateVariable(const char* name, void** out) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  PyTuple_SET_ITEM(args, 0, Str(name));
+  PyObject* r = CallShim("symbol_variable", args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+// Atomic-symbol creation + composition in one call: input_keys name
+// the op's symbol inputs (e.g. "data", "weight"), params are the op's
+// string-typed attributes.
+int MXTpuSymbolCreate(const char* op, int num_params,
+                      const char** param_keys, const char** param_vals,
+                      const char* name, int num_in,
+                      const char** input_keys, void** input_syms,
+                      void** out) {
+  Gil gil;
+  PyObject* args = PyTuple_New(5);
+  PyTuple_SET_ITEM(args, 0, Str(op));
+  PyTuple_SET_ITEM(args, 1,
+                   StrDict(num_params, param_keys, param_vals));
+  PyTuple_SET_ITEM(args, 2, Str(name ? name : ""));
+  PyTuple_SET_ITEM(args, 3, StrList(input_keys, num_in));
+  PyTuple_SET_ITEM(args, 4, HandleList(input_syms, num_in));
+  PyObject* r = CallShim("symbol_create", args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+int MXTpuSymbolFromJSON(const char* json, void** out) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  PyTuple_SET_ITEM(args, 0, Str(json));
+  PyObject* r = CallShim("symbol_from_json", args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+// JSON into TLS string; pointer valid until this thread's next call.
+int MXTpuSymbolToJSON(void* sym, const char** out_json) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  Py_INCREF(static_cast<PyObject*>(sym));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(sym));
+  PyObject* r = CallShim("symbol_to_json", args);
+  if (r == nullptr) return -1;
+  tls_strs.clear();
+  tls_strs.emplace_back(PyUnicode_AsUTF8(r));
+  *out_json = tls_strs.back().c_str();
+  Py_DECREF(r);
+  return 0;
+}
+
+// kind: "arg" | "out" | "aux"
+int MXTpuSymbolList(void* sym, const char* kind, int* num,
+                    const char*** out) {
+  Gil gil;
+  PyObject* args = PyTuple_New(2);
+  Py_INCREF(static_cast<PyObject*>(sym));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(sym));
+  PyTuple_SET_ITEM(args, 1, Str(kind));
+  PyObject* r = CallShim("symbol_list", args);
+  if (r == nullptr) return -1;
+  StashStrList(r, num, out);
+  Py_DECREF(r);
+  return 0;
+}
+
+// Infers all argument shapes from the named input shapes. Results are
+// packed into TLS: shape_ind has num+1 entries into shape_data.
+int MXTpuSymbolInferShape(void* sym, int num_in, const char** names,
+                          const int* shape_ind, const int* shape_data,
+                          int* num_arg, const int** arg_ind,
+                          const int** arg_data) {
+  Gil gil;
+  PyObject* args = PyTuple_New(3);
+  Py_INCREF(static_cast<PyObject*>(sym));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(sym));
+  PyTuple_SET_ITEM(args, 1, StrList(names, num_in));
+  PyTuple_SET_ITEM(args, 2,
+                   ShapeLists(num_in, shape_ind, shape_data));
+  PyObject* r = CallShim("symbol_infer_shape", args);
+  if (r == nullptr) return -1;
+  PyObject* arg_shapes = PyTuple_GET_ITEM(r, 0);
+  tls_shape_data.clear();
+  static thread_local std::vector<int> ind;
+  ind.clear();
+  ind.push_back(0);
+  Py_ssize_t n = PyList_Size(arg_shapes);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* s = PyList_GET_ITEM(arg_shapes, i);
+    for (Py_ssize_t j = 0; j < PyList_Size(s); ++j)
+      tls_shape_data.push_back(static_cast<int>(
+          PyLong_AsLong(PyList_GET_ITEM(s, j))));
+    ind.push_back(static_cast<int>(tls_shape_data.size()));
+  }
+  *num_arg = static_cast<int>(n);
+  *arg_ind = ind.data();
+  *arg_data = tls_shape_data.data();
+  Py_DECREF(r);
+  return 0;
+}
+
+// ----------------------------------------------------------- Executor
+
+int MXTpuExecutorSimpleBind(void* sym, const char* ctx_type,
+                            int dev_id, const char* grad_req,
+                            int num_in, const char** names,
+                            const int* shape_ind,
+                            const int* shape_data, void** out) {
+  Gil gil;
+  PyObject* args = PyTuple_New(6);
+  Py_INCREF(static_cast<PyObject*>(sym));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(sym));
+  PyTuple_SET_ITEM(args, 1, Str(ctx_type));
+  PyTuple_SET_ITEM(args, 2, PyLong_FromLong(dev_id));
+  PyTuple_SET_ITEM(args, 3, Str(grad_req));
+  PyTuple_SET_ITEM(args, 4, StrList(names, num_in));
+  PyTuple_SET_ITEM(args, 5,
+                   ShapeLists(num_in, shape_ind, shape_data));
+  PyObject* r = CallShim("executor_bind", args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+int MXTpuExecutorForward(void* ex, int is_train) {
+  Gil gil;
+  PyObject* args = PyTuple_New(2);
+  Py_INCREF(static_cast<PyObject*>(ex));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(ex));
+  PyTuple_SET_ITEM(args, 1, PyLong_FromLong(is_train));
+  PyObject* r = CallShim("executor_forward", args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuExecutorBackward(void* ex) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  Py_INCREF(static_cast<PyObject*>(ex));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(ex));
+  PyObject* r = CallShim("executor_backward", args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuExecutorOutputs(void* ex, int* num, void*** out) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  Py_INCREF(static_cast<PyObject*>(ex));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(ex));
+  PyObject* r = CallShim("executor_outputs", args);
+  if (r == nullptr) return -1;
+  StashHandleList(r, num, out);
+  Py_DECREF(r);
+  return 0;
+}
+
+// kind: "arg" | "grad" | "aux"; returns a NEW handle to the named
+// executor array (shared storage with the executor).
+int MXTpuExecutorArray(void* ex, const char* name, const char* kind,
+                       void** out) {
+  Gil gil;
+  PyObject* args = PyTuple_New(3);
+  Py_INCREF(static_cast<PyObject*>(ex));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(ex));
+  PyTuple_SET_ITEM(args, 1, Str(name));
+  PyTuple_SET_ITEM(args, 2, Str(kind));
+  PyObject* r = CallShim("executor_arg", args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,93 @@
+/* mxnet_tpu C API — the embeddable core ABI.
+ *
+ * Capability parity with the reference include/mxnet/c_api.h surface
+ * (NDArray / imperative invoke / Symbol / Executor tiers) plus the
+ * predict-only ABI in capi_predict.cc (c_predict_api.h analog).
+ *
+ * Conventions:
+ *   - all functions return 0 on success, nonzero on failure;
+ *     MXTpuGetLastError() returns the calling THREAD's last error
+ *     (reference src/c_api/c_api_error.cc TLS semantics).
+ *   - void* handles are opaque; release with MXTpuHandleFree.
+ *   - "list" outputs (names, handles, shapes) point into per-thread
+ *     storage owned by the library, valid until the same thread's next
+ *     API call — copy before calling again.
+ *   - shape packing: entity i's dims occupy
+ *     shape_data[shape_ind[i] .. shape_ind[i+1]).
+ */
+#ifndef MXNET_TPU_C_API_H_
+#define MXNET_TPU_C_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+const char* MXTpuGetLastError(void);
+int MXTpuHandleFree(void* handle);
+
+/* ---- NDArray ---- */
+int MXTpuNDArrayCreate(const int* shape, int ndim, const float* data,
+                       void** out);
+int MXTpuNDArrayZeros(const int* shape, int ndim, void** out);
+int MXTpuNDArrayGetShape(void* handle, int* shape, int cap, int* ndim);
+long MXTpuNDArrayCopyOut(void* handle, float* buf, long cap);
+int MXTpuNDArrayCopyIn(void* handle, const float* data, long size);
+int MXTpuNDArraySave(const char* fname, int num, void** handles,
+                     const char** keys);
+int MXTpuNDArrayLoad(const char* fname, int* num_out, void*** out,
+                     int* num_keys, const char*** keys);
+
+/* ---- imperative op invocation ---- */
+int MXTpuImperativeInvoke(const char* op, int num_in, void** inputs,
+                          int num_params, const char** keys,
+                          const char** vals, int* num_out,
+                          void*** outputs);
+int MXTpuImperativeInvokeInto(const char* op, int num_in, void** inputs,
+                              int num_params, const char** keys,
+                              const char** vals, int num_out,
+                              void** outputs);
+
+/* ---- Symbol ---- */
+int MXTpuSymbolCreateVariable(const char* name, void** out);
+int MXTpuSymbolCreate(const char* op, int num_params,
+                      const char** param_keys, const char** param_vals,
+                      const char* name, int num_in,
+                      const char** input_keys, void** input_syms,
+                      void** out);
+int MXTpuSymbolFromJSON(const char* json, void** out);
+int MXTpuSymbolToJSON(void* sym, const char** out_json);
+int MXTpuSymbolList(void* sym, const char* kind /* arg|out|aux */,
+                    int* num, const char*** out);
+int MXTpuSymbolInferShape(void* sym, int num_in, const char** names,
+                          const int* shape_ind, const int* shape_data,
+                          int* num_arg, const int** arg_ind,
+                          const int** arg_data);
+
+/* ---- Executor ---- */
+int MXTpuExecutorSimpleBind(void* sym, const char* ctx_type,
+                            int dev_id, const char* grad_req,
+                            int num_in, const char** names,
+                            const int* shape_ind,
+                            const int* shape_data, void** out);
+int MXTpuExecutorForward(void* ex, int is_train);
+int MXTpuExecutorBackward(void* ex);
+int MXTpuExecutorOutputs(void* ex, int* num, void*** out);
+int MXTpuExecutorArray(void* ex, const char* name,
+                       const char* kind /* arg|grad|aux */, void** out);
+
+/* ---- predict-only ABI (capi_predict.cc) ---- */
+int MXTpuPredCreate(const char* symbol_json, const void* param_bytes,
+                    int param_size, int num_input,
+                    const char** input_keys, const unsigned* shape_ind,
+                    const unsigned* shape_data, void** out);
+int MXTpuPredSetInput(void* handle, const char* key, const float* data,
+                      int size);
+int MXTpuPredForward(void* handle);
+int MXTpuPredGetOutput(void* handle, int index, float* buf, int cap);
+void MXTpuPredFree(void* handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXNET_TPU_C_API_H_ */

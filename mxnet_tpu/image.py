@@ -327,8 +327,20 @@ class ImageIter(_io.DataIter):
                  path_imgrec=None, path_imglist=None, path_root=None,
                  path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
                  aug_list=None, imglist=None, data_name="data",
-                 label_name="softmax_label", **kwargs):
+                 label_name="softmax_label", preprocess_threads=4,
+                 **kwargs):
         super().__init__(batch_size)
+        # decode+augment worker pool (the analog of the reference's
+        # OMP-parallel ImageRecordIOParser2 threads,
+        # src/io/iter_image_recordio_2.cc:28 — PIL/cv2 release the GIL
+        # during JPEG decompression, so threads give real parallelism)
+        self.preprocess_threads = max(1, int(preprocess_threads))
+        self._pool = None
+        if self.preprocess_threads > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.preprocess_threads)
         assert path_imgrec or path_imglist or (isinstance(imglist, list))
         if path_imgrec:
             logging.info("loading recordio %s...", path_imgrec)
@@ -440,8 +452,29 @@ class ImageIter(_io.DataIter):
         header, img = recordio.unpack(s)
         return header.label, img
 
+    def _decode_augment(self, raw):
+        """Worker: raw bytes -> list of augmented HWC numpy images."""
+        data = [imdecode(raw)]
+        if len(data[0].shape) == 0:
+            return []
+        for aug in self.auglist:
+            data = [ret for src in data for ret in aug(src)]
+        return [d.asnumpy() for d in data]
+
+    def _write_sample(self, batch_data, batch_label, i, img, label):
+        batch_data[i] = img.transpose(2, 0, 1)
+        lab = label.asnumpy() if isinstance(label, nd.NDArray) \
+            else np.asarray(label)
+        if self.label_width == 1:
+            batch_label[i] = lab.reshape(-1)[0]
+        else:
+            batch_label[i] = lab.reshape(-1)[: self.label_width]
+
     def next(self):
-        """(reference image.py:420)"""
+        """Assemble a batch: samples are read sequentially from the
+        record stream, then decode+augment fans out over the worker
+        pool (reference: OMP threads write straight into the batch,
+        iter_image_recordio_2.cc:28-490)."""
         batch_size = self.batch_size
         c, h, w = self.data_shape
         batch_data = np.zeros((batch_size, c, h, w), dtype=np.float32)
@@ -449,32 +482,35 @@ class ImageIter(_io.DataIter):
             (batch_size,) if self.label_width == 1
             else (batch_size, self.label_width), dtype=np.float32)
         i = 0
-        try:
-            while i < batch_size:
-                label, s = self.next_sample()
-                data = [imdecode(s)]
-                if len(data[0].shape) == 0:
+        exhausted = False
+        while i < batch_size and not exhausted:
+            # 1. pull up to the remaining quota of raw samples
+            raw = []
+            try:
+                while len(raw) < batch_size - i:
+                    raw.append(self.next_sample())
+            except StopIteration:
+                exhausted = True
+            if not raw:
+                break
+            # 2. decode+augment (parallel), 3. write in order
+            if self._pool is not None:
+                decoded = list(self._pool.map(
+                    self._decode_augment, [s for _, s in raw]))
+            else:
+                decoded = [self._decode_augment(s) for _, s in raw]
+            for (label, _), imgs in zip(raw, decoded):
+                if not imgs:
                     logging.debug("Invalid image, skipping.")
                     continue
-                for aug in self.auglist:
-                    data = [ret for src in data for ret in aug(src)]
-                for d in data:
+                for img in imgs:
                     assert i < batch_size, \
                         "Batch size must be multiple of augmenter output"
-                    arr = d.asnumpy()
-                    batch_data[i] = arr.transpose(2, 0, 1)
-                    if isinstance(label, nd.NDArray):
-                        lab = label.asnumpy()
-                    else:
-                        lab = np.asarray(label)
-                    if self.label_width == 1:
-                        batch_label[i] = lab.reshape(-1)[0]
-                    else:
-                        batch_label[i] = lab.reshape(-1)[: self.label_width]
+                    self._write_sample(batch_data, batch_label, i, img,
+                                       label)
                     i += 1
-        except StopIteration:
-            if i == 0:
-                raise
+        if i == 0:
+            raise StopIteration
         return _io.DataBatch(
             data=[nd.array(batch_data)], label=[nd.array(batch_label)],
             pad=batch_size - i, index=None,

@@ -272,6 +272,98 @@ class Executor {
   Handle h_;
 };
 
+// Batch iterator over the C DataIter ABI (reference cpp-package
+// MXDataIter): Next()/GetData()/GetLabel()/Reset() over any registered
+// python-side iterator (CSVIter, MNISTIter, ImageRecordIter, ...).
+class DataIter {
+ public:
+  DataIter(const std::string& name, const KWArgs& params) {
+    auto ptrs = KwPtrs(params);
+    void* out = nullptr;
+    Check(MXTpuDataIterCreate(name.c_str(),
+                              static_cast<int>(ptrs.first.size()),
+                              ptrs.first.data(), ptrs.second.data(),
+                              &out),
+          name.c_str());
+    h_ = Handle(out);
+  }
+
+  static std::vector<std::string> List() {
+    int n = 0;
+    const char** names = nullptr;
+    Check(MXTpuListDataIters(&n, &names), "ListDataIters");
+    return std::vector<std::string>(names, names + n);
+  }
+
+  bool Next() {
+    int has = 0;
+    Check(MXTpuDataIterNext(h_.get(), &has), "DataIterNext");
+    return has != 0;
+  }
+  void Reset() {
+    Check(MXTpuDataIterBeforeFirst(h_.get()), "DataIterBeforeFirst");
+  }
+  NDArray GetData() const {
+    void* out = nullptr;
+    Check(MXTpuDataIterGetData(h_.get(), &out), "DataIterGetData");
+    return NDArray(out);
+  }
+  NDArray GetLabel() const {
+    void* out = nullptr;
+    Check(MXTpuDataIterGetLabel(h_.get(), &out), "DataIterGetLabel");
+    return NDArray(out);
+  }
+  int PadNum() const {
+    int pad = 0;
+    Check(MXTpuDataIterGetPadNum(h_.get(), &pad), "DataIterGetPadNum");
+    return pad;
+  }
+
+ private:
+  Handle h_;
+};
+
+// KVStore over the C ABI (reference cpp-package KVStore): int keys,
+// optional C updater applied server-side on push.
+class KVStore {
+ public:
+  explicit KVStore(const std::string& type = "local") {
+    void* out = nullptr;
+    Check(MXTpuKVStoreCreate(type.c_str(), &out), "KVStoreCreate");
+    h_ = Handle(out);
+  }
+
+  void Init(int key, const NDArray& v) {
+    void* vals[1] = {v.get()};
+    Check(MXTpuKVStoreInit(h_.get(), 1, &key, vals), "KVStoreInit");
+  }
+  void Push(int key, const NDArray& v) {
+    void* vals[1] = {v.get()};
+    Check(MXTpuKVStorePush(h_.get(), 1, &key, vals), "KVStorePush");
+  }
+  void Pull(int key, NDArray* out) {
+    void* vals[1] = {out->get()};
+    Check(MXTpuKVStorePull(h_.get(), 1, &key, vals), "KVStorePull");
+  }
+  void SetUpdater(MXTpuKVUpdater cb, void* payload = nullptr) {
+    Check(MXTpuKVStoreSetUpdater(h_.get(), cb, payload),
+          "KVStoreSetUpdater");
+  }
+  int Rank() const {
+    int r = 0;
+    Check(MXTpuKVStoreGetRank(h_.get(), &r), "KVStoreGetRank");
+    return r;
+  }
+  int GroupSize() const {
+    int s = 0;
+    Check(MXTpuKVStoreGetGroupSize(h_.get(), &s), "KVStoreGroupSize");
+    return s;
+  }
+
+ private:
+  Handle h_;
+};
+
 // Minimal optimizer over fused update ops (the cpp-package Optimizer
 // analog): sgd with optional momentum, updating executor arrays
 // in place through InvokeInto.

@@ -17,6 +17,7 @@ from .context import (
     current_context,
     default_context,
     num_devices,
+    memory_stats,
 )
 from . import ops
 from . import ndarray
@@ -39,6 +40,7 @@ from . import engine
 from . import io
 from . import recordio
 from . import image
+from . import image_det
 from . import native
 from . import kvstore as kv
 from . import kvstore

@@ -157,3 +157,189 @@ def executor_arg(ex, name, kind):
     if name not in table:
         raise MXNetError(f"no {kind} array named {name!r}")
     return table[name]
+
+
+def executor_set_monitor(ex, fn_ptr, payload_ptr):
+    """Install a C monitor callback: cb(name_bytes, arr_handle,
+    payload). Monitored forwards then run the executor's eager per-node
+    path (reference MXExecutorSetMonitorCallback +
+    ExecuteMonCallback)."""
+    import ctypes
+
+    cb = ctypes.CFUNCTYPE(
+        None, ctypes.c_char_p, ctypes.py_object, ctypes.c_void_p
+    )(fn_ptr)
+    payload = ctypes.c_void_p(payload_ptr)
+
+    def monitor(name, arr):
+        cb(name.encode(), arr, payload)
+
+    ex.set_monitor_callback(monitor)
+
+
+# ------------------------------------------------------------ data iter
+
+_DATAITERS = {
+    "NDArrayIter": ("io", "NDArrayIter"),
+    "MNISTIter": ("io", "MNISTIter"),
+    "CSVIter": ("io", "CSVIter"),
+    "ImageRecordIter": ("image", "ImageRecordIter"),
+    "ImageDetRecordIter": ("image_det", "ImageDetIter"),
+}
+
+# per-param coercion: the C side passes every value as a string
+# (reference MXDataIterCreateIter kwargs convention)
+
+
+def _coerce_str_param(v):
+    s = str(v)
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    if s.lower() in ("true", "false"):
+        return s.lower() == "true"
+    if s.startswith("(") and s.endswith(")"):
+        return tuple(int(p) for p in s[1:-1].split(",") if p.strip())
+    return s
+
+
+def dataiter_list():
+    return sorted(_DATAITERS)
+
+
+def dataiter_create(name, params):
+    import importlib
+
+    if name not in _DATAITERS:
+        raise MXNetError(f"unknown data iter {name!r}")
+    mod_name, cls_name = _DATAITERS[name]
+    mod = importlib.import_module("mxnet_tpu." + mod_name)
+    cls = getattr(mod, cls_name)
+    kwargs = {k: _coerce_str_param(v) for k, v in params.items()}
+    return _CDataIter(cls(**kwargs))
+
+
+class _CDataIter:
+    """Cursor wrapper giving the C ABI its Next/GetData protocol over
+    our python iterators (reference io.cc DataIter semantics)."""
+
+    def __init__(self, it):
+        self.it = it
+        self.batch = None
+
+    def next(self):
+        try:
+            self.batch = self.it.next()
+            return 1
+        except StopIteration:
+            self.batch = None
+            return 0
+
+    def reset(self):
+        self.it.reset()
+        self.batch = None
+
+
+def dataiter_next(cit):
+    return cit.next()
+
+
+def dataiter_reset(cit):
+    cit.reset()
+
+
+def dataiter_get(cit, what):
+    if cit.batch is None:
+        raise MXNetError("no current batch (call Next first)")
+    arrs = cit.batch.data if what == "data" else cit.batch.label
+    if not arrs:
+        raise MXNetError(f"batch has no {what}")
+    return arrs[0]
+
+
+def dataiter_pad(cit):
+    if cit.batch is None:
+        raise MXNetError("no current batch (call Next first)")
+    return int(cit.batch.pad or 0)
+
+
+# -------------------------------------------------------------- kvstore
+
+def kvstore_create(kv_type):
+    from . import kvstore as kv
+
+    return kv.create(kv_type)
+
+
+def kvstore_init(kv, keys, vals):
+    kv.init(list(keys), list(vals))
+
+
+def kvstore_push(kv, keys, vals):
+    kv.push(list(keys), list(vals))
+
+
+def kvstore_pull(kv, keys, outs):
+    kv.pull(list(keys), out=list(outs))
+
+
+def kvstore_set_updater(kv, fn_ptr, payload_ptr):
+    """C updater: cb(key, recv_grad, local_weight, payload); both
+    arrays are borrowed handles (reference MXKVStoreSetUpdater)."""
+    import ctypes
+
+    cb = ctypes.CFUNCTYPE(
+        None, ctypes.c_int, ctypes.py_object, ctypes.py_object,
+        ctypes.c_void_p,
+    )(fn_ptr)
+    payload = ctypes.c_void_p(payload_ptr)
+
+    def updater(key, recv, local):
+        cb(int(key), recv, local, payload)
+
+    kv._set_updater(updater)
+
+
+def kvstore_type(kv):
+    return kv.type
+
+
+def kvstore_rank(kv):
+    return int(kv.rank)
+
+
+def kvstore_group_size(kv):
+    return int(kv.num_workers)
+
+
+def kvstore_barrier(kv):
+    kv._barrier()
+
+
+def kvstore_num_dead_node(kv, node_id, timeout):
+    return int(kv.get_num_dead_node(node_id, timeout))
+
+
+# ------------------------------------------------------------- autograd
+
+def autograd_set_training(is_training):
+    from . import autograd
+
+    return int(autograd.set_is_training(bool(is_training)))
+
+
+def autograd_mark_variables(variables, gradients):
+    from . import autograd
+
+    autograd.mark_variables(list(variables), list(gradients))
+
+
+def autograd_compute_gradient(outputs):
+    from . import autograd
+
+    autograd.compute_gradient(list(outputs))

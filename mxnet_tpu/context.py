@@ -121,3 +121,22 @@ def default_context() -> Context:
 def num_devices(device_type: str = "tpu") -> int:
     devs = _devices_for_platform(device_type)
     return len(devs)
+
+
+def memory_stats(ctx=None):
+    """Device-memory introspection (counterpart of the reference's
+    pooled storage manager stats, src/storage/pooled_storage_manager.h:
+    28-47 — there the pool is hand-managed; here allocation belongs to
+    the XLA runtime, and this surfaces its per-device counters).
+
+    Returns a dict (bytes_in_use, peak_bytes_in_use, bytes_limit, ...
+    as provided by the PJRT backend) or {} on backends without memory
+    accounting (CPU).
+    """
+    c = ctx if ctx is not None else current_context()
+    dev = c.jax_device() if isinstance(c, Context) else c
+    try:
+        stats = dev.memory_stats()
+    except Exception:
+        return {}
+    return dict(stats or {})

@@ -197,7 +197,14 @@ class Executor:
             (grads,) = vjp_fn(head_grads)
             return outs, grads, aux_upd
 
-        self._jit_train_step = jax.jit(train_step)
+        # Donation (the PlanMemory/inplace analog): head_grads are
+        # consumed by the vjp and never reused — donate them. arg/aux
+        # buffers CANNOT be donated here: on the eager path they are the
+        # user-visible NDArrays of arg_dict/grad_dict (reference
+        # executor semantics — the caller may read them after forward).
+        # Full in-place donation of params+state lives on the fused
+        # train step (parallel/dp_step.py), which owns its buffers.
+        self._jit_train_step = jax.jit(train_step, donate_argnums=(3,))
 
     # --------------------------------------------------------- running
     def _gather_inputs(self):
@@ -296,7 +303,9 @@ class Executor:
                 raise MXNetError("backward called before forward")
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
-            head_grads = [g._data for g in out_grads]
+            # copies: the train-step jit donates its head-grad buffers,
+            # which must not invalidate the caller's NDArrays
+            head_grads = [jnp.copy(g._data) for g in out_grads]
             arg_vals, aux_vals, rng = self._last_inputs
             _, grads, _ = self._jit_train_step(
                 arg_vals, aux_vals, rng, head_grads

@@ -422,6 +422,9 @@ class ImageIter(_io.DataIter):
         else:
             self.auglist = aug_list
         self.cur = 0
+        # decoded-but-unbatched (img, label) pairs: augmenters with
+        # fan-out > 1 can overshoot a batch; the excess carries over
+        self._carry = []
         self.reset()
 
     def reset(self):
@@ -430,6 +433,7 @@ class ImageIter(_io.DataIter):
         if self.imgrec is not None:
             self.imgrec.reset()
         self.cur = 0
+        self._carry = []
 
     def next_sample(self):
         """(reference image.py:398)"""
@@ -483,8 +487,16 @@ class ImageIter(_io.DataIter):
             else (batch_size, self.label_width), dtype=np.float32)
         i = 0
         exhausted = False
+        # drain images an earlier batch over-decoded (augmenter
+        # fan-out > 1) before touching the record stream
+        while self._carry and i < batch_size:
+            img, label = self._carry.pop(0)
+            self._write_sample(batch_data, batch_label, i, img, label)
+            i += 1
         while i < batch_size and not exhausted:
-            # 1. pull up to the remaining quota of raw samples
+            # 1. pull up to the remaining quota of raw samples (with
+            # fan-out k > 1 this overshoots at most once: the excess
+            # goes to _carry and later batches pull less)
             raw = []
             try:
                 while len(raw) < batch_size - i:
@@ -504,11 +516,12 @@ class ImageIter(_io.DataIter):
                     logging.debug("Invalid image, skipping.")
                     continue
                 for img in imgs:
-                    assert i < batch_size, \
-                        "Batch size must be multiple of augmenter output"
-                    self._write_sample(batch_data, batch_label, i, img,
-                                       label)
-                    i += 1
+                    if i < batch_size:
+                        self._write_sample(batch_data, batch_label, i,
+                                           img, label)
+                        i += 1
+                    else:
+                        self._carry.append((img, label))
         if i == 0:
             raise StopIteration
         return _io.DataBatch(

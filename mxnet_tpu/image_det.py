@@ -1,0 +1,382 @@
+"""Detection-aware data pipeline: bbox-preserving augmenters +
+ImageDetIter.
+
+Covers the reference's detection IO tier (src/io/image_det_aug_default.cc
+DefaultImageDetAugmenter, src/io/iter_image_det_recordio.cc
+ImageDetRecordIter): SSD-style IoU-constrained random crop samplers,
+random-pad expansion, mirror with box flip, and a batching iterator
+whose labels are (batch, max_objects, label_width) with -1 padding.
+
+Label convention (the reference's packed format, tools/im2rec +
+image_det_aug_default.cc ConvertLabels): per image a float array
+  [header_width, object_width, (extra header...), obj0..., obj1...]
+where each object is [class_id, xmin, ymin, xmax, ymax, ...] with
+coordinates normalized to [0, 1]. A plain (N, 5+) array is also
+accepted.
+
+All of this is host-side numpy: the decode/augment path feeds the
+device pipeline and never runs under jit (same split as the reference:
+OpenCV threads feeding the GPU).
+"""
+from __future__ import annotations
+
+import logging
+import random
+
+import numpy as np
+
+from . import io as _io
+from . import ndarray as nd
+from . import recordio
+from .base import MXNetError
+from .image import (
+    CastAug,
+    ColorNormalizeAug,
+    imdecode,
+    imresize,
+)
+
+
+def _to_obj_array(label, obj_width=5):
+    """Normalize a raw packed label into a (num_obj, width) float array."""
+    label = np.asarray(label, dtype=np.float32).ravel()
+    if label.size >= 2 and float(label[0]) >= 1 and \
+            float(label[1]) >= 5 and \
+            (label.size - int(label[0])) % int(label[1]) == 0:
+        hw, ow = int(label[0]), int(label[1])
+        body = label[hw:]
+        return body.reshape((-1, ow))
+    if label.size % obj_width == 0 and label.size:
+        return label.reshape((-1, obj_width))
+    raise MXNetError(f"cannot parse detection label of size {label.size}")
+
+
+def _pack_obj_array(objs, header_width=2):
+    """Inverse of _to_obj_array: [hw, ow, objs...] flat float array."""
+    objs = np.asarray(objs, dtype=np.float32)
+    head = np.array([header_width, objs.shape[1]], dtype=np.float32)
+    return np.concatenate([head, objs.ravel()])
+
+
+def _iou(box, boxes):
+    """IoU of one [x1,y1,x2,y2] box against (N,4) boxes."""
+    ix1 = np.maximum(box[0], boxes[:, 0])
+    iy1 = np.maximum(box[1], boxes[:, 1])
+    ix2 = np.minimum(box[2], boxes[:, 2])
+    iy2 = np.minimum(box[3], boxes[:, 3])
+    iw = np.clip(ix2 - ix1, 0, None)
+    ih = np.clip(iy2 - iy1, 0, None)
+    inter = iw * ih
+    a = (box[2] - box[0]) * (box[3] - box[1])
+    b = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    return inter / np.maximum(a + b - inter, 1e-12)
+
+
+class DetAugmenter:
+    """Base detection augmenter: __call__(img_nd, objs) -> (img, objs)
+    with objs an (N, 5+) [cls, x1, y1, x2, y2, ...] normalized array."""
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Mirror image + boxes with probability p (reference
+    image_det_aug_default.cc HorizontalFlip + rand_mirror_prob)."""
+
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, src, label):
+        if random.random() < self.p:
+            src = nd.array(np.asarray(src.asnumpy())[:, ::-1])
+            label = label.copy()
+            x1 = label[:, 1].copy()
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = 1.0 - x1
+        return src, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """IoU-constrained random crop (the SSD sampler,
+    image_det_aug_default.cc GenerateCropBox + crop_emit_mode center):
+    sample a scale/aspect window until its IoU with some ground-truth
+    box lies in [min_overlap, max_overlap]; keep objects whose centers
+    fall inside; re-express surviving boxes in crop coordinates."""
+
+    def __init__(self, min_scale=0.3, max_scale=1.0, min_aspect=0.5,
+                 max_aspect=2.0, min_overlap=0.1, max_overlap=1.0,
+                 max_trials=25, p=0.5):
+        self.min_scale = min_scale
+        self.max_scale = max_scale
+        self.min_aspect = min_aspect
+        self.max_aspect = max_aspect
+        self.min_overlap = min_overlap
+        self.max_overlap = max_overlap
+        self.max_trials = max_trials
+        self.p = p
+
+    def _sample(self, objs):
+        for _ in range(self.max_trials):
+            scale = random.uniform(self.min_scale, self.max_scale)
+            ratio = random.uniform(self.min_aspect, self.max_aspect)
+            w = min(scale * np.sqrt(ratio), 1.0)
+            h = min(scale / np.sqrt(ratio), 1.0)
+            x = random.uniform(0, 1 - w)
+            y = random.uniform(0, 1 - h)
+            crop = np.array([x, y, x + w, y + h], dtype=np.float32)
+            if not len(objs):
+                return crop
+            ious = _iou(crop, objs[:, 1:5])
+            if ((ious >= self.min_overlap) &
+                    (ious <= self.max_overlap)).any():
+                return crop
+        return None
+
+    def __call__(self, src, label):
+        if random.random() >= self.p:
+            return src, label
+        crop = self._sample(label)
+        if crop is None:
+            return src, label
+        x1, y1, x2, y2 = crop
+        cw, ch = x2 - x1, y2 - y1
+        # emit mode "center": keep objects whose center is in the crop
+        cx = (label[:, 1] + label[:, 3]) / 2
+        cy = (label[:, 2] + label[:, 4]) / 2
+        keep = (cx >= x1) & (cx <= x2) & (cy >= y1) & (cy <= y2)
+        if not keep.any():
+            return src, label
+        kept = label[keep].copy()
+        kept[:, 1] = np.clip((kept[:, 1] - x1) / cw, 0, 1)
+        kept[:, 3] = np.clip((kept[:, 3] - x1) / cw, 0, 1)
+        kept[:, 2] = np.clip((kept[:, 2] - y1) / ch, 0, 1)
+        kept[:, 4] = np.clip((kept[:, 4] - y1) / ch, 0, 1)
+        img = src.asnumpy()
+        hh, ww = img.shape[:2]
+        px1, px2 = int(x1 * ww), max(int(x2 * ww), int(x1 * ww) + 1)
+        py1, py2 = int(y1 * hh), max(int(y2 * hh), int(y1 * hh) + 1)
+        return nd.array(img[py1:py2, px1:px2]), kept
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Canvas expansion (zoom-out) with fill value; boxes shrink into
+    the padded frame (image_det_aug_default.cc RandomPad +
+    max_pad_scale)."""
+
+    def __init__(self, max_pad_scale=4.0, fill=127, p=0.5):
+        self.max_pad_scale = max_pad_scale
+        self.fill = fill
+        self.p = p
+
+    def __call__(self, src, label):
+        if random.random() >= self.p or self.max_pad_scale <= 1.0:
+            return src, label
+        img = src.asnumpy()
+        h, w = img.shape[:2]
+        scale = random.uniform(1.0, self.max_pad_scale)
+        nh, nw = int(h * scale), int(w * scale)
+        oy = random.randint(0, nh - h)
+        ox = random.randint(0, nw - w)
+        canvas = np.full((nh, nw) + img.shape[2:], self.fill,
+                         dtype=img.dtype)
+        canvas[oy:oy + h, ox:ox + w] = img
+        out = label.copy()
+        out[:, 1] = (out[:, 1] * w + ox) / nw
+        out[:, 3] = (out[:, 3] * w + ox) / nw
+        out[:, 2] = (out[:, 2] * h + oy) / nh
+        out[:, 4] = (out[:, 4] * h + oy) / nh
+        return nd.array(canvas), out
+
+
+class DetResizeAug(DetAugmenter):
+    """Force resize to (w, h); normalized boxes are shape-invariant."""
+
+    def __init__(self, w, h, interp=2):
+        self.w, self.h, self.interp = w, h, interp
+
+    def __call__(self, src, label):
+        return imresize(src, self.w, self.h, self.interp), label
+
+
+class DetImageAug(DetAugmenter):
+    """Adapt a plain image augmenter (color/cast — anything geometry-
+    free) into the detection chain."""
+
+    def __init__(self, aug):
+        self.aug = aug
+
+    def __call__(self, src, label):
+        out = self.aug(src)
+        return (out[0] if isinstance(out, list) else out), label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0.0, rand_pad=0.0,
+                       rand_mirror=False, mean=None, std=None,
+                       min_object_covered=0.1, max_pad_scale=4.0,
+                       fill_value=127, inter_method=2):
+    """Factory mirroring the reference's DefaultImageDetAugmenter knob
+    set (image_det_aug_default.cc:96-168) at python level."""
+    augs = []
+    if resize > 0:
+        augs.append(DetResizeAug(resize, resize, inter_method))
+    if rand_crop > 0:
+        augs.append(DetRandomCropAug(min_overlap=min_object_covered,
+                                     p=rand_crop))
+    if rand_pad > 0:
+        augs.append(DetRandomPadAug(max_pad_scale=max_pad_scale,
+                                    fill=fill_value, p=rand_pad))
+    if rand_mirror:
+        augs.append(DetHorizontalFlipAug(0.5))
+    augs.append(DetResizeAug(data_shape[2], data_shape[1], inter_method))
+    augs.append(DetImageAug(CastAug()))
+    if mean is not None or std is not None:
+        mean = np.asarray(mean if mean is not None else [0, 0, 0],
+                          dtype=np.float32)
+        std = np.asarray(std if std is not None else [1, 1, 1],
+                         dtype=np.float32)
+        augs.append(DetImageAug(ColorNormalizeAug(mean, std)))
+    return augs
+
+
+class ImageDetIter(_io.DataIter):
+    """Detection batch iterator (reference ImageDetRecordIter,
+    iter_image_det_recordio.cc): packed RecordIO (or an imglist of
+    (label, path)) in, (data (N, C, H, W), label (N, max_obj, width))
+    out, label rows padded with -1."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=None, imglist=None,
+                 shuffle=False, aug_list=None, label_width=5,
+                 max_objects=None, last_batch_handle="pad", **kwargs):
+        super().__init__()
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.path_root = path_root
+        self.auglist = aug_list if aug_list is not None else \
+            CreateDetAugmenter(self.data_shape, **kwargs)
+
+        self.imgrec = None
+        self.seq = None
+        self.imglist = None
+        if path_imgrec:
+            import os
+
+            idx_path = path_imgrec.rsplit(".", 1)[0] + ".idx"
+            if os.path.exists(idx_path):
+                self.imgrec = recordio.MXIndexedRecordIO(
+                    idx_path, path_imgrec, "r")
+                self.seq = list(self.imgrec.keys)
+            else:
+                self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
+                self.seq = None
+        elif imglist is not None or path_imglist:
+            if path_imglist:
+                entries = []
+                with open(path_imglist) as f:
+                    for line in f:
+                        parts = line.strip().split("\t")
+                        entries.append((
+                            np.asarray([float(v) for v in parts[1:-1]],
+                                       dtype=np.float32),
+                            parts[-1]))
+                self.imglist = entries
+            else:
+                self.imglist = [
+                    (np.asarray(lab, dtype=np.float32), path)
+                    for lab, path in imglist
+                ]
+            self.seq = list(range(len(self.imglist)))
+        else:
+            raise MXNetError(
+                "ImageDetIter needs path_imgrec, path_imglist or imglist")
+
+        # scan (or trust) the max object count for the padded batch
+        self.cur = 0
+        self._max_obj = max_objects or self._scan_max_objects()
+        c, h, w = self.data_shape
+        self.provide_data = [_io.DataDesc("data", (batch_size, c, h, w))]
+        self.provide_label = [_io.DataDesc(
+            "label", (batch_size, self._max_obj, self.label_width))]
+        self.cur = 0
+        self.reset()
+
+    def _records(self):
+        """Yield (label_objs, raw_image_bytes) over one epoch."""
+        if self.imglist is not None:
+            for i in self.seq:
+                lab, fname = self.imglist[i]
+                import os
+
+                with open(os.path.join(self.path_root or "", fname),
+                          "rb") as f:
+                    yield _to_obj_array(lab, self.label_width), f.read()
+            return
+        if self.seq is not None:
+            for idx in self.seq[self.cur:]:
+                s = self.imgrec.read_idx(idx)
+                header, img = recordio.unpack(s)
+                yield _to_obj_array(header.label, self.label_width), img
+            return
+        while True:
+            s = self.imgrec.read()
+            if s is None:
+                return
+            header, img = recordio.unpack(s)
+            yield _to_obj_array(header.label, self.label_width), img
+
+    def _scan_max_objects(self):
+        m = 1
+        n = 0
+        for objs, _ in self._records():
+            m = max(m, len(objs))
+            n += 1
+            if n >= 512:  # sample; max_objects= overrides when known
+                break
+        self.reset()
+        return m
+
+    def reset(self):
+        if self.shuffle and self.seq is not None:
+            random.shuffle(self.seq)
+        if self.imgrec is not None:
+            self.imgrec.reset()
+        self.cur = 0
+        self._iter = self._records()
+
+    def next(self):
+        c, h, w = self.data_shape
+        bs = self.batch_size
+        data = np.zeros((bs, c, h, w), dtype=np.float32)
+        label = np.full((bs, self._max_obj, self.label_width), -1.0,
+                        dtype=np.float32)
+        i = 0
+        while i < bs:
+            try:
+                objs, raw = next(self._iter)
+            except StopIteration:
+                break
+            img = imdecode(raw)
+            if img.shape == ():
+                logging.debug("invalid image, skipping")
+                continue
+            for aug in self.auglist:
+                img, objs = aug(img, objs)
+            arr = img.asnumpy()
+            if arr.shape[:2] != (h, w):
+                arr = imresize(nd.array(arr), w, h).asnumpy()
+            data[i] = arr.astype(np.float32).transpose(2, 0, 1)
+            k = min(len(objs), self._max_obj)
+            if k:
+                label[i, :k, :] = objs[:k, :self.label_width]
+            i += 1
+            self.cur += 1
+        if i == 0:
+            raise StopIteration
+        return _io.DataBatch(
+            data=[nd.array(data)], label=[nd.array(label)],
+            pad=bs - i, index=None,
+            provide_data=self.provide_data,
+            provide_label=self.provide_label)

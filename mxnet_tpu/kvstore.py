@@ -130,6 +130,11 @@ class KVStore(object):
     def num_workers(self):
         return 1
 
+    def get_num_dead_node(self, node_id=0, timeout=60):
+        """Liveness surface (reference include/mxnet/kvstore.h:242);
+        a single-process store has no peers to lose."""
+        return 0
+
     # ------------------------------------------------- optimizer states
     def save_optimizer_states(self, fname):
         assert self._updater is not None, "Cannot save states for distributed training"
@@ -152,6 +157,10 @@ def create(name="local"):
     if not isinstance(name, str):
         raise TypeError("name must be a string")
     lname = name.lower()
+    if "async" in lname:
+        from .parallel.kvstore_async import KVStoreDistAsync
+
+        return KVStoreDistAsync(lname)
     if "tpu" in lname or "dist" in lname:
         from .parallel.kvstore_tpu import KVStoreTPU
 

@@ -1,107 +1,115 @@
-"""Learning-rate schedulers (reference python/mxnet/lr_scheduler.py:36-77).
+"""Learning-rate schedules (API parity: reference
+python/mxnet/lr_scheduler.py; semantics pinned by tests, design not —
+these are closed-form, stateless evaluations instead of the reference's
+mutate-base_lr-in-a-while-loop pattern).
 
-Schedulers are called with `num_update` (the optimizer's global update
-count) and return the lr for that update — host-side scalar logic, never
-traced into the jit step; the lr enters the fused update op as a scalar
-argument.
+A schedule maps `num_update` (the optimizer's global update counter) to
+a learning rate. Evaluation is pure host-side scalar math: the fused
+TPU train step takes lr as a scalar jit argument each step, so a
+schedule must be cheap, reentrant, and safe to re-evaluate for any
+`num_update` (checkpoint resume replays an arbitrary counter value —
+a closed form needs no state reconstruction).
 """
 from __future__ import annotations
 
+import bisect
 import logging
 import math
 
 
 class LRScheduler:
+    """Base: subclasses implement `_value(num_update)` as a pure
+    function of the counter and construction params; `base_lr` may be
+    re-assigned at any time (the Optimizer does so at init).
+
+    Milestone schedules (`_log_changes = True`) log each decay;
+    continuous schedules (Poly/Cosine) change every step and stay
+    quiet."""
+
+    _log_changes = False
+
     def __init__(self, base_lr=0.01):
         self.base_lr = base_lr
+        self._logged = None
+
+    def _value(self, num_update):
+        raise NotImplementedError
 
     def __call__(self, num_update):
-        raise NotImplementedError()
+        lr = self._value(num_update)
+        if self._log_changes and lr != self._logged:
+            if self._logged is not None:
+                logging.info("lr schedule: update %d -> lr %.5e",
+                             num_update, lr)
+            self._logged = lr
+        return lr
 
 
 class FactorScheduler(LRScheduler):
-    """lr *= factor every `step` updates (reference lr_scheduler.py:36)."""
+    """Geometric decay: lr = base_lr * factor^(decays), one decay per
+    `step` updates, floored at `stop_factor_lr`."""
+
+    _log_changes = True
 
     def __init__(self, step, factor=1.0, stop_factor_lr=1e-8):
         super().__init__()
         if step < 1:
-            raise ValueError("Schedule step must be greater or equal than 1")
+            raise ValueError("FactorScheduler: step must be >= 1")
         if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
-        self.step = step
+            raise ValueError("FactorScheduler: factor must be <= 1")
+        self.step = int(step)
         self.factor = factor
         self.stop_factor_lr = stop_factor_lr
-        self.count = 0
 
-    def __call__(self, num_update):
-        while num_update > self.count + self.step:
-            self.count += self.step
-            self.base_lr *= self.factor
-            if self.base_lr < self.stop_factor_lr:
-                self.base_lr = self.stop_factor_lr
-                logging.info(
-                    "Update[%d]: now learning rate arrived at %0.5e, "
-                    "will not change in the future", num_update, self.base_lr)
-            else:
-                logging.info("Update[%d]: Change learning rate to %0.5e",
-                             num_update, self.base_lr)
-        return self.base_lr
+    def _value(self, num_update):
+        decays = max(0, (int(num_update) - 1) // self.step)
+        return max(self.base_lr * self.factor ** decays,
+                   self.stop_factor_lr)
 
 
 class MultiFactorScheduler(LRScheduler):
-    """lr *= factor at each step in a milestone list (reference
-    lr_scheduler.py:77)."""
+    """Milestone decay: lr = base_lr * factor^k where k counts the
+    milestones already passed (milestone m is passed once
+    num_update > m)."""
+
+    _log_changes = True
 
     def __init__(self, step, factor=1):
         super().__init__()
-        if not isinstance(step, list) or len(step) < 1:
-            raise ValueError("step must be a list with at least one element")
-        for i, _step in enumerate(step):
-            if i != 0 and step[i] <= step[i - 1]:
-                raise ValueError("Schedule step must be an increasing list")
-            if _step < 1:
-                raise ValueError("Schedule step must be greater or equal than 1")
+        if not isinstance(step, list) or not step:
+            raise ValueError(
+                "MultiFactorScheduler: step must be a non-empty list")
+        if any(s < 1 for s in step):
+            raise ValueError("MultiFactorScheduler: milestones must be >= 1")
+        if any(b <= a for a, b in zip(step, step[1:])):
+            raise ValueError(
+                "MultiFactorScheduler: milestones must strictly increase")
         if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
-        self.step = step
-        self.cur_step_ind = 0
+            raise ValueError("MultiFactorScheduler: factor must be <= 1")
+        self.step = list(step)
         self.factor = factor
-        self.count = 0
 
-    def __call__(self, num_update):
-        while self.cur_step_ind <= len(self.step) - 1:
-            if num_update > self.step[self.cur_step_ind]:
-                self.count = self.step[self.cur_step_ind]
-                self.cur_step_ind += 1
-                self.base_lr *= self.factor
-                logging.info("Update[%d]: Change learning rate to %0.5e",
-                             num_update, self.base_lr)
-            else:
-                return self.base_lr
-        return self.base_lr
+    def _value(self, num_update):
+        passed = bisect.bisect_left(self.step, int(num_update))
+        return self.base_lr * self.factor ** passed
 
 
 class PolyScheduler(LRScheduler):
-    """Polynomial decay to zero over max_update steps (common extension;
-    matches later-MXNet PolyScheduler semantics)."""
+    """Polynomial decay to zero across `max_update` steps."""
 
     def __init__(self, max_update, base_lr=0.01, pwr=2):
         super().__init__(base_lr)
         self.max_update = max_update
         self.power = pwr
-        self.base_lr_orig = self.base_lr
 
-    def __call__(self, num_update):
-        if num_update <= self.max_update:
-            self.base_lr = self.base_lr_orig * (
-                1.0 - float(num_update) / float(self.max_update)
-            ) ** self.power
-        return self.base_lr
+    def _value(self, num_update):
+        frac = min(float(num_update) / float(self.max_update), 1.0)
+        return self.base_lr * (1.0 - frac) ** self.power
 
 
 class CosineScheduler(LRScheduler):
-    """Cosine decay with optional warmup — the standard schedule for TPU
-    pod-scale training runs."""
+    """Linear warmup then cosine decay to `final_lr` — the standard
+    schedule for TPU pod-scale runs."""
 
     def __init__(self, max_update, base_lr=0.01, final_lr=0.0,
                  warmup_steps=0, warmup_begin_lr=0.0):
@@ -110,20 +118,14 @@ class CosineScheduler(LRScheduler):
         self.final_lr = final_lr
         self.warmup_steps = warmup_steps
         self.warmup_begin_lr = warmup_begin_lr
-        self.base_lr_orig = base_lr
 
-    def __call__(self, num_update):
+    def _value(self, num_update):
         if num_update < self.warmup_steps:
-            increase = (
-                (self.base_lr_orig - self.warmup_begin_lr)
-                * float(num_update) / float(max(1, self.warmup_steps))
-            )
-            self.base_lr = self.warmup_begin_lr + increase
-        elif num_update <= self.max_update:
-            frac = (num_update - self.warmup_steps) / max(
-                1, self.max_update - self.warmup_steps
-            )
-            self.base_lr = self.final_lr + (
-                self.base_lr_orig - self.final_lr
-            ) * 0.5 * (1 + math.cos(math.pi * frac))
-        return self.base_lr
+            span = self.base_lr - self.warmup_begin_lr
+            return self.warmup_begin_lr + span * (
+                float(num_update) / float(max(1, self.warmup_steps)))
+        frac = (num_update - self.warmup_steps) / max(
+            1, self.max_update - self.warmup_steps)
+        frac = min(frac, 1.0)
+        cos = 0.5 * (1.0 + math.cos(math.pi * frac))
+        return self.final_lr + (self.base_lr - self.final_lr) * cos

@@ -13,3 +13,4 @@ from .inception import get_inception_bn
 from .vgg import get_vgg
 from .lstm_lm import get_lstm_lm, lstm_lm_sym_gen
 from .ssd import get_ssd_train, get_ssd_detect
+from .transformer import get_transformer

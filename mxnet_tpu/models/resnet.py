@@ -1,61 +1,64 @@
 """ResNet v1/v2 (reference example/image-classification/symbol_resnet.py
 style; units/filters per the original He et al. configs).
 
-TPU notes: NCHW layout is kept for API parity (XLA:TPU transposes to its
-preferred layout internally); BatchNorm carries moving stats as aux
+TPU notes: `layout` selects NCHW (reference default) or NHWC. NHWC is
+the TPU-native orientation — channels ride the 128-wide lane dimension,
+so XLA skips the relayout transposes it inserts for NCHW graphs; use it
+for training on real chips. BatchNorm carries moving stats as aux
 states; the whole network lowers to one fused XLA computation at bind.
 """
 from .. import symbol as sym
 
 
 def _residual_unit(data, num_filter, stride, dim_match, name,
-                   bottle_neck=True, bn_mom=0.9):
+                   bottle_neck=True, bn_mom=0.9, layout="NCHW"):
     """Residual unit with identity/projection shortcut (pre-activation,
     He 2016)."""
+    ax = layout.index("C")
     if bottle_neck:
         bn1 = sym.BatchNorm(data, name=name + "_bn1", fix_gamma=False,
-                            eps=2e-5, momentum=bn_mom)
+                            eps=2e-5, momentum=bn_mom, axis=ax)
         act1 = sym.Activation(bn1, name=name + "_relu1", act_type="relu")
         conv1 = sym.Convolution(
             act1, name=name + "_conv1", num_filter=num_filter // 4,
-            kernel=(1, 1), stride=(1, 1), pad=(0, 0), no_bias=True)
+            kernel=(1, 1), stride=(1, 1), pad=(0, 0), no_bias=True, layout=layout)
         bn2 = sym.BatchNorm(conv1, name=name + "_bn2", fix_gamma=False,
-                            eps=2e-5, momentum=bn_mom)
+                            eps=2e-5, momentum=bn_mom, axis=ax)
         act2 = sym.Activation(bn2, name=name + "_relu2", act_type="relu")
         conv2 = sym.Convolution(
             act2, name=name + "_conv2", num_filter=num_filter // 4,
-            kernel=(3, 3), stride=stride, pad=(1, 1), no_bias=True)
+            kernel=(3, 3), stride=stride, pad=(1, 1), no_bias=True, layout=layout)
         bn3 = sym.BatchNorm(conv2, name=name + "_bn3", fix_gamma=False,
-                            eps=2e-5, momentum=bn_mom)
+                            eps=2e-5, momentum=bn_mom, axis=ax)
         act3 = sym.Activation(bn3, name=name + "_relu3", act_type="relu")
         conv3 = sym.Convolution(
             act3, name=name + "_conv3", num_filter=num_filter,
-            kernel=(1, 1), stride=(1, 1), pad=(0, 0), no_bias=True)
+            kernel=(1, 1), stride=(1, 1), pad=(0, 0), no_bias=True, layout=layout)
         if dim_match:
             shortcut = data
         else:
             shortcut = sym.Convolution(
                 act1, name=name + "_sc", num_filter=num_filter,
-                kernel=(1, 1), stride=stride, no_bias=True)
+                kernel=(1, 1), stride=stride, no_bias=True, layout=layout)
         return conv3 + shortcut
     bn1 = sym.BatchNorm(data, name=name + "_bn1", fix_gamma=False,
-                        eps=2e-5, momentum=bn_mom)
+                        eps=2e-5, momentum=bn_mom, axis=ax)
     act1 = sym.Activation(bn1, name=name + "_relu1", act_type="relu")
     conv1 = sym.Convolution(
         act1, name=name + "_conv1", num_filter=num_filter,
-        kernel=(3, 3), stride=stride, pad=(1, 1), no_bias=True)
+        kernel=(3, 3), stride=stride, pad=(1, 1), no_bias=True, layout=layout)
     bn2 = sym.BatchNorm(conv1, name=name + "_bn2", fix_gamma=False,
-                        eps=2e-5, momentum=bn_mom)
+                        eps=2e-5, momentum=bn_mom, axis=ax)
     act2 = sym.Activation(bn2, name=name + "_relu2", act_type="relu")
     conv2 = sym.Convolution(
         act2, name=name + "_conv2", num_filter=num_filter,
-        kernel=(3, 3), stride=(1, 1), pad=(1, 1), no_bias=True)
+        kernel=(3, 3), stride=(1, 1), pad=(1, 1), no_bias=True, layout=layout)
     if dim_match:
         shortcut = data
     else:
         shortcut = sym.Convolution(
             act1, name=name + "_sc", num_filter=num_filter,
-            kernel=(1, 1), stride=stride, no_bias=True)
+            kernel=(1, 1), stride=stride, no_bias=True, layout=layout)
     return conv2 + shortcut
 
 
@@ -69,46 +72,56 @@ _CONFIGS = {
 
 
 def get_resnet(num_classes=1000, num_layers=50, image_shape=(3, 224, 224),
-               bn_mom=0.9):
-    """Build ResNet-{18,34,50,101,152} (reference symbol_resnet.py resnet())."""
+               bn_mom=0.9, layout="NCHW"):
+    """Build ResNet-{18,34,50,101,152} (reference symbol_resnet.py resnet()).
+
+    `image_shape` is always (C, H, W); `layout` picks the data/weight
+    orientation of the built graph — "NHWC" feeds (N, H, W, C) batches
+    and is the fast path on TPU (see module docstring).
+    """
     if num_layers not in _CONFIGS:
         raise ValueError(f"no ResNet-{num_layers} config")
+    if layout not in ("NCHW", "NHWC"):
+        raise ValueError(f"layout must be NCHW or NHWC, got {layout!r}")
     units, filter_list, bottle_neck = _CONFIGS[num_layers]
+    ax = layout.index("C")
 
     data = sym.Variable("data")
-    data = sym.BatchNorm(data, name="bn_data", fix_gamma=True, eps=2e-5)
+    data = sym.BatchNorm(data, name="bn_data", fix_gamma=True, eps=2e-5,
+                         axis=ax)
     (nchannel, height, _) = image_shape
     if height <= 32:  # cifar-style stem
         body = sym.Convolution(
             data, name="conv0", num_filter=filter_list[0], kernel=(3, 3),
-            stride=(1, 1), pad=(1, 1), no_bias=True)
+            stride=(1, 1), pad=(1, 1), no_bias=True, layout=layout)
     else:  # imagenet stem
         body = sym.Convolution(
             data, name="conv0", num_filter=filter_list[0], kernel=(7, 7),
-            stride=(2, 2), pad=(3, 3), no_bias=True)
+            stride=(2, 2), pad=(3, 3), no_bias=True, layout=layout)
         body = sym.BatchNorm(body, name="bn0", fix_gamma=False, eps=2e-5,
-                             momentum=bn_mom)
+                             momentum=bn_mom, axis=ax)
         body = sym.Activation(body, name="relu0", act_type="relu")
         body = sym.Pooling(body, name="pool0", kernel=(3, 3),
-                           stride=(2, 2), pad=(1, 1), pool_type="max")
+                           stride=(2, 2), pad=(1, 1), pool_type="max",
+                           layout=layout)
 
     for i, num_unit in enumerate(units):
         stride = (1, 1) if i == 0 else (2, 2)
         body = _residual_unit(
             body, filter_list[i + 1], stride, False,
             name=f"stage{i + 1}_unit1", bottle_neck=bottle_neck,
-            bn_mom=bn_mom)
+            bn_mom=bn_mom, layout=layout)
         for j in range(num_unit - 1):
             body = _residual_unit(
                 body, filter_list[i + 1], (1, 1), True,
                 name=f"stage{i + 1}_unit{j + 2}", bottle_neck=bottle_neck,
-                bn_mom=bn_mom)
+                bn_mom=bn_mom, layout=layout)
 
     bn1 = sym.BatchNorm(body, name="bn1", fix_gamma=False, eps=2e-5,
-                        momentum=bn_mom)
+                        momentum=bn_mom, axis=ax)
     relu1 = sym.Activation(bn1, name="relu1", act_type="relu")
     pool1 = sym.Pooling(relu1, name="pool1", global_pool=True,
-                        kernel=(7, 7), pool_type="avg")
+                        kernel=(7, 7), pool_type="avg", layout=layout)
     flat = sym.Flatten(pool1, name="flatten")
     fc1 = sym.FullyConnected(flat, name="fc1", num_hidden=num_classes)
     return sym.SoftmaxOutput(fc1, name="softmax")

@@ -1,0 +1,273 @@
+"""PipelineModule: GPipe-style pipeline parallelism through the Module
+user API.
+
+The reference's only inter-layer model parallelism was manual ctx-group
+placement with cross-device copies (example/model-parallel-lstm/
+lstm.py:48-99, graph_executor.cc:242-318 _CrossDeviceCopy). TPU-native
+redesign: the user supplies ONE stage Symbol (data -> same-shape
+output); S parameter sets for it live stage-major on a 'pipe' mesh
+axis, and microbatches stream through the ppermute ring schedule of
+parallel/pipeline.py inside a single donated jit — forward, backward
+through the whole pipeline, and the optimizer update all in one XLA
+program.
+
+Differences from Module: the stage symbol must be shape-preserving and
+aux-free (no BatchNorm moving stats in v1), and the loss is declared at
+construction (`loss='l2'` against a label shaped like the output, or a
+callable jax loss(out, label) -> scalar).
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .base_module import BaseModule
+from .. import context as ctx
+from .. import ndarray as nd
+from .. import optimizer as opt
+from ..base import MXNetError
+from ..initializer import InitDesc
+
+
+class PipelineModule(BaseModule):
+    def __init__(self, stage_symbol, num_stages, num_microbatches,
+                 data_names=("data",), label_names=("label",),
+                 context=None, loss="l2", logger=logging):
+        super().__init__(logger=logger)
+        if len(data_names) != 1 or len(label_names) != 1:
+            raise MXNetError(
+                "PipelineModule takes exactly one data and one label")
+        self._symbol = stage_symbol
+        self._num_stages = int(num_stages)
+        self._num_micro = int(num_microbatches)
+        self._data_names = list(data_names)
+        self._label_names = list(label_names)
+        self._context = context if context is not None \
+            else ctx.current_context()
+        if isinstance(self._context, (list, tuple)):
+            self._context = self._context[0]
+        self._loss = loss
+        if stage_symbol.list_auxiliary_states():
+            raise MXNetError(
+                "PipelineModule v1 does not support aux states "
+                "(BatchNorm moving stats) in the stage symbol")
+        self._param_names = [
+            n for n in stage_symbol.list_arguments()
+            if n not in self._data_names
+        ]
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+        self.for_training = True
+        self._outputs = None
+
+    # ---------------------------------------------------------- binding
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        import jax
+        from ..parallel.mesh import make_mesh
+
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        name, shape = (data_shapes[0].name, data_shapes[0].shape) \
+            if hasattr(data_shapes[0], "name") else data_shapes[0]
+        if name != self._data_names[0]:
+            raise MXNetError(f"expected data name {self._data_names[0]}")
+        batch = shape[0]
+        if batch % self._num_micro != 0:
+            raise MXNetError(
+                f"batch {batch} not divisible into {self._num_micro} "
+                "microbatches")
+        self._batch_shape = tuple(shape)
+        self._mb_shape = (batch // self._num_micro,) + tuple(shape[1:])
+        self._mesh = make_mesh({"pipe": self._num_stages})
+
+        # one eager executor at microbatch shape supplies the pure
+        # stage function + the per-stage parameter shapes
+        self._stage_exec = self._symbol.simple_bind(
+            ctx=self._context, grad_req="null",
+            **{self._data_names[0]: self._mb_shape})
+        out_shapes = [tuple(o.shape)
+                      for o in self._stage_exec.outputs]
+        if out_shapes[0] != self._mb_shape:
+            raise MXNetError(
+                f"stage symbol must preserve shape: {self._mb_shape} "
+                f"-> {out_shapes[0]}")
+        self._param_shapes = {
+            n: tuple(self._stage_exec.arg_dict[n].shape)
+            for n in self._param_names
+        }
+        self._rng = jax.random.PRNGKey(0)
+        self.binded = True
+        self.for_training = for_training
+        self._jitted = None
+        self._t = 0
+
+    # ------------------------------------------------------- parameters
+    def init_params(self, initializer=None, arg_params=None,
+                    aux_params=None, allow_missing=False,
+                    force_init=False):
+        import jax.numpy as jnp
+
+        if self.params_initialized and not force_init:
+            return
+        if not self.binded:
+            raise MXNetError("bind before init_params")
+        attrs = self._symbol.attr_dict()
+        rs = np.random.RandomState(0)
+        stacked = {}
+        for pname, pshape in self._param_shapes.items():
+            if arg_params and pname in arg_params:
+                v = arg_params[pname].asnumpy()
+                if v.shape == (self._num_stages,) + pshape:
+                    stacked[pname] = jnp.asarray(v)
+                    continue
+                stages = [v] * self._num_stages
+            elif initializer is not None:
+                stages = []
+                for s in range(self._num_stages):
+                    a = nd.zeros(pshape, ctx=self._context)
+                    initializer(InitDesc(pname, attrs.get(pname)), a)
+                    stages.append(a.asnumpy())
+            elif allow_missing:
+                stages = [rs.uniform(-0.07, 0.07, pshape)
+                          .astype("float32")] * self._num_stages
+            else:
+                raise MXNetError(f"no value for parameter {pname}")
+            stacked[pname] = jnp.asarray(np.stack(stages))
+        self.params = self._place(stacked)  # {name: (S,) + shape}
+        self.params_initialized = True
+
+    def _sharding(self, leaf):
+        """Stage-major leaves shard over 'pipe'; scalars replicate."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if getattr(leaf, "ndim", 0) >= 1 and \
+                leaf.shape[0] == self._num_stages:
+            return NamedSharding(self._mesh, P("pipe"))
+        return NamedSharding(self._mesh, P())
+
+    def _place(self, tree):
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda v: jax.device_put(v, self._sharding(v)), tree)
+
+    def get_params(self):
+        host = {k: nd.array(np.asarray(v)) for k, v in self.params.items()}
+        return host, {}
+
+    # -------------------------------------------------------- optimizer
+    def init_optimizer(self, kvstore=None, optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        from ..parallel.dp_step import supports_fused, _to_jnp_tree
+
+        if isinstance(optimizer, str):
+            optimizer = opt.create(optimizer, **dict(optimizer_params))
+        if not supports_fused(optimizer):
+            raise MXNetError(
+                "PipelineModule needs an optimizer with a traced "
+                f"apply_dense ({type(optimizer).__name__} lacks one)")
+        self._optimizer = optimizer
+        self.states = self._place({
+            n: _to_jnp_tree(
+                optimizer.create_state(i, nd.array(np.asarray(v))))
+            for i, (n, v) in enumerate(self.params.items())
+        })
+        self.optimizer_initialized = True
+
+    # ------------------------------------------------------ computation
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+
+        from ..parallel.pipeline import pipeline_apply
+
+        run = self._stage_exec._run_graph
+        mesh = self._mesh
+        m = self._num_micro
+        names = self._param_names
+        loss = self._loss
+        opt_ = self._optimizer
+
+        def loss_fn(params, data, label, rng):
+            def stage_fn(local_params, x, stage_idx):
+                del stage_idx
+                outs, _ = run({**local_params, self._data_names[0]: x},
+                              {}, rng, True)
+                return outs[0]
+
+            mbs = data.reshape((m,) + self._mb_shape)
+            out = pipeline_apply(stage_fn, params, mbs, mesh, "pipe")
+            out = out.reshape(data.shape)
+            if callable(loss):
+                return loss(out, label), out
+            return jnp.mean(jnp.square(out - label)), out
+
+        def train_step(params, states, data, label, lr, t, rng):
+            # rng is a traced argument — a closure capture would be
+            # baked into the first compile and freeze stochastic ops
+            (lval, out), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, data, label, rng)
+            new_p, new_s = {}, {}
+            for n in names:
+                w2, s2 = opt_.apply_dense(
+                    n, params[n], grads[n], states[n],
+                    lr * opt_._lr_mult_for(n), t)
+                new_p[n] = w2
+                new_s[n] = s2
+            return lval, out, new_p, new_s
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        repl = NamedSharding(mesh, P())
+        param_sh = jax.tree_util.tree_map(self._sharding, self.params)
+        state_sh = jax.tree_util.tree_map(self._sharding, self.states)
+        return jax.jit(
+            train_step, donate_argnums=(0, 1),
+            in_shardings=(param_sh, state_sh, repl, repl, None, None,
+                          None),
+            out_shardings=(None, None, param_sh, state_sh),
+        )
+
+    def forward_backward(self, data_batch):
+        import jax
+        import numpy as np_
+
+        assert self.binded and self.params_initialized \
+            and self.optimizer_initialized
+        self._t += 1
+        self._step_rng = jax.random.fold_in(self._rng, self._t)
+        if self._jitted is None:
+            self._jitted = self._build()
+        data = data_batch.data[0]
+        label = data_batch.label[0]
+        data = data._data if isinstance(data, nd.NDArray) \
+            else np_.asarray(data)
+        label = label._data if isinstance(label, nd.NDArray) \
+            else np_.asarray(label)
+        o = self._optimizer
+        o.num_update += 1
+        lr = o.lr_scheduler(o.num_update) if o.lr_scheduler else o.lr
+        self._loss_val, out, self.params, self.states = self._jitted(
+            self.params, self.states, data, label,
+            np.float32(lr), np.int32(self._t), self._step_rng)
+        self._outputs = [nd.NDArray(out)]
+
+    def update(self):
+        pass  # the fused pipeline step already applied the update
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._outputs
+
+    @property
+    def loss_value(self):
+        return float(np.asarray(self._loss_val))
+
+    def forward(self, data_batch, is_train=None):
+        self.forward_backward(data_batch)

@@ -112,6 +112,17 @@ def _conv_dims(kernel):
     return len(kernel)
 
 
+def _norm_layout(layout, nd):
+    """Resolve a reference-style layout string ('NCHW', 'NHWC', 'NCDHW',
+    'NDHWC', 'NCW', 'NWC'); default channel-first like the reference."""
+    if not layout or layout in ("None",):
+        return "NC" + "DHW"[3 - nd:]
+    layout = str(layout).upper()
+    if len(layout) != nd + 2 or "N" not in layout or "C" not in layout:
+        raise MXNetError(f"bad conv layout {layout!r} for {nd}d")
+    return layout
+
+
 def _spatial_tuple(v, nd, default):
     t = coerce_tuple(v) if v not in (None, "", ()) else ()
     if not t:
@@ -141,21 +152,25 @@ def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
                 pad=(), num_filter=0, num_group=1, no_bias=False,
                 workspace=1024, cudnn_tune=None, cudnn_off=False,
                 layout=None):
-    """NCHW convolution (reference src/operator/convolution-inl.h).
+    """Convolution (reference src/operator/convolution-inl.h), any
+    reference layout: NCHW (default) or channels-last NHWC/NDHWC/NWC.
 
     The reference lowers to im2col+GEMM (nn/im2col.h) or cuDNN; here a
-    single lax.conv_general_dilated lowers straight onto the MXU, with
-    XLA choosing the internal layout.
+    single lax.conv_general_dilated lowers straight onto the MXU. On TPU
+    channels-last is the native orientation (C maps onto the 128-wide
+    lane dimension), so NHWC graphs skip XLA's NCHW->NHWC relayout.
+    Weight layout follows the reference convention: data layout with
+    N->O, C->I (NCHW weights are OIHW, NHWC weights are OHWI).
     """
     nd = _conv_dims(kernel)
     stride = _spatial_tuple(stride, nd, 1)
     dilate = _spatial_tuple(dilate, nd, 1)
     pad = _spatial_tuple(pad, nd, 0)
-    spatial = "DHW"[3 - nd :]
+    lay = _norm_layout(layout, nd)
     dn = lax.conv_dimension_numbers(
         data.shape,
         weight.shape,
-        ("NC" + spatial, "OI" + spatial, "NC" + spatial),
+        (lay, lay.replace("N", "O").replace("C", "I"), lay),
     )
     out = lax.conv_general_dilated(
         data,
@@ -167,7 +182,10 @@ def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
         feature_group_count=num_group,
     )
     if not no_bias and bias is not None:
-        out = out + bias.reshape((1, -1) + (1,) * nd)
+        c_ax = lay.index("C")
+        out = out + bias.reshape(
+            tuple(-1 if i == c_ax else 1 for i in range(nd + 2))
+        )
     return out
 
 
@@ -241,10 +259,13 @@ def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
     aliases=("pooling",),
 )
 def pooling(data, kernel=(), pool_type="max", global_pool=False,
-            pooling_convention="valid", stride=(), pad=(), cudnn_off=False):
+            pooling_convention="valid", stride=(), pad=(), cudnn_off=False,
+            layout=None):
     nd = data.ndim - 2
+    lay = _norm_layout(layout, nd)
+    sp_axes = [i for i, ch in enumerate(lay) if ch not in "NC"]
     if global_pool:
-        kernel = data.shape[2:]
+        kernel = tuple(data.shape[a] for a in sp_axes)
         stride = (1,) * nd
         pad = (0,) * nd
     else:
@@ -252,23 +273,27 @@ def pooling(data, kernel=(), pool_type="max", global_pool=False,
         stride = _spatial_tuple(stride, nd, 1)
         pad = _spatial_tuple(pad, nd, 0)
 
-    window = (1, 1) + tuple(kernel)
-    strides = (1, 1) + tuple(stride)
-    base_pad = [(0, 0), (0, 0)] + [(p, p) for p in pad]
+    window = [1] * (nd + 2)
+    strides = [1] * (nd + 2)
+    base_pad = [(0, 0)] * (nd + 2)
+    for i, ax in enumerate(sp_axes):
+        window[ax] = kernel[i]
+        strides[ax] = stride[i]
+        base_pad[ax] = (pad[i], pad[i])
     if pooling_convention == "full" and not global_pool:
         # ceil output convention (pooling-inl.h): pad extra on the right
         # so that ceil((in + 2p - k)/s) + 1 windows fit.
         import math
 
-        new_pad = []
-        for i in range(nd):
-            in_ = data.shape[2 + i]
+        for i, ax in enumerate(sp_axes):
+            in_ = data.shape[ax]
             out_ = int(
                 math.ceil((in_ + 2 * pad[i] - kernel[i]) / stride[i])
             ) + 1
             needed = (out_ - 1) * stride[i] + kernel[i] - in_ - pad[i]
-            new_pad.append((pad[i], max(needed, pad[i])))
-        base_pad = [(0, 0), (0, 0)] + new_pad
+            base_pad[ax] = (pad[i], max(needed, pad[i]))
+    window = tuple(window)
+    strides = tuple(strides)
 
     if pool_type == "max":
         init = -jnp.inf

@@ -1,0 +1,109 @@
+"""Parallelism-aware ops: sequence-parallel attention and expert-parallel
+MoE as first-class registered operators.
+
+The reference exposed model parallelism only through ctx-group placement
+(example/model-parallel-lstm/lstm.py:48-99 + PlaceDevice); here the
+TPU-native equivalents are ordinary Symbol ops. Each op reads the
+ambient device mesh (parallel/mesh.py) at trace time:
+
+  - mesh has the op's axis and size > 1  -> sharded implementation
+    (ring / Ulysses all-to-all attention, expert all-to-all dispatch)
+    via shard_map; XLA lowers the ppermute/all-to-all onto ICI.
+  - otherwise -> mathematically identical single-device fallback, so
+    the same Symbol runs unmodified on one chip, in eager executors,
+    and in shape inference.
+
+The FusedTrainStep installs the Module's mesh as ambient for the trace
+of its step, so `Module(..., mesh_shape={'data': 2, 'seq': 4})` + these
+ops is the complete user-facing SP/EP story.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from ..base import coerce_bool, coerce_float, coerce_int
+
+
+def _ambient_mesh(axis_name):
+    from ..parallel import mesh as mesh_mod
+
+    m = mesh_mod.current_mesh()
+    if m is not None and axis_name in m.axis_names \
+            and m.shape[axis_name] > 1:
+        return m
+    return None
+
+
+def _plain_attention(q, k, v, causal, scale):
+    """Reference attention math for the single-device fallback; (B, T,
+    H, D) layout, numerically the target the ring/Ulysses paths match
+    (tests/test_attention.py)."""
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        t_q, t_k = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((t_q, t_k), bool))
+        logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+@register(
+    "RingAttention",
+    arg_names=["query", "key", "value"],
+    coerce={"causal": coerce_bool, "scale": coerce_float},
+    defaults={"causal": False, "impl": "ring", "axis_name": "seq"},
+    aliases=("ring_attention",),
+)
+def ring_attention_op(query, key, value, causal=False, impl="ring",
+                      axis_name="seq", scale=None):
+    """Sequence-parallel attention over (B, T, H, D) inputs.
+
+    impl='ring': blockwise ring attention (K/V rotate over the mesh
+    axis via ppermute — parallel/ring_attention.py).
+    impl='ulysses': head-scatter/seq-gather all-to-all attention.
+    Without a mesh (or axis size 1) both reduce to plain attention.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(query.shape[-1])
+    m = _ambient_mesh(axis_name)
+    if m is None:
+        return _plain_attention(query, key, value, causal, scale)
+    from ..parallel.ring_attention import ring_attention, ulysses_attention
+
+    fn = ulysses_attention if impl == "ulysses" else ring_attention
+    return fn(query, key, value, mesh=m, axis_name=axis_name,
+              causal=causal, scale=scale)
+
+
+@register(
+    "MoEFFN",
+    arg_names=["data", "gate_weight", "w1_weight", "w2_weight"],
+    coerce={"num_experts": coerce_int, "hidden_size": coerce_int,
+            "capacity_factor": coerce_float},
+    defaults={"capacity_factor": 1.25, "axis_name": "expert"},
+    num_outputs=2,
+    aliases=("moe_ffn",),
+)
+def moe_ffn_op(data, gate_weight, w1_weight, w2_weight, num_experts=0,
+               hidden_size=0, capacity_factor=1.25, axis_name="expert"):
+    """Top-1-routed mixture-of-experts FFN over (..., D) tokens.
+
+    Outputs: (transformed tokens, load-balancing aux loss). With an
+    ambient mesh carrying `axis_name`, expert weights and dispatched
+    token blocks shard over it (parallel/moe.py) — the dispatch einsum
+    becomes the token-routing all-to-all on ICI.
+    """
+    from ..parallel.moe import moe_ffn
+
+    lead = data.shape[:-1]
+    x = data.reshape((-1, data.shape[-1]))
+    out, aux = moe_ffn(
+        x, gate_weight, w1_weight, w2_weight,
+        capacity_factor=capacity_factor, mesh=_ambient_mesh(axis_name),
+        axis_name=axis_name,
+    )
+    return out.reshape(lead + (data.shape[-1],)), aux

@@ -113,8 +113,13 @@ def _conv(params, ins):
     ng = int(params.get("num_group", 1))
     kernel = tuple(params["kernel"])
     no_bias = params.get("no_bias", False)
+    layout = str(params.get("layout") or "")
+    channels_last = layout.upper().endswith("C")
     if data is not None and weight is None:
-        weight = (nf, data[1] // ng) + kernel
+        if channels_last:  # e.g. NHWC -> OHWI weights
+            weight = (nf,) + kernel + (data[-1] // ng,)
+        else:
+            weight = (nf, data[1] // ng) + kernel
     if no_bias:
         return [data, weight], None
     if bias is None:
@@ -155,6 +160,22 @@ def _in(params, ins):
     data = ins[0]
     c = (data[1],)
     return [data, ins[1] or c, ins[2] if len(ins) > 2 and ins[2] else c], None
+
+
+@rule("MoEFFN")
+def _moe_ffn(params, ins):
+    data, gate_w, w1, w2 = (ins + [None] * 4)[:4]
+    e = int(params["num_experts"])
+    f = int(params["hidden_size"])
+    if data is not None:
+        d = data[-1]
+        if gate_w is None:
+            gate_w = (d, e)
+        if w1 is None:
+            w1 = (e, d, f)
+        if w2 is None:
+            w2 = (e, f, d)
+    return [data, gate_w, w1, w2], None
 
 
 @rule("Embedding")

@@ -601,6 +601,15 @@ class Updater:
                 for n, v in obj["states"].items()
                 for i in name2idxs.get(n, ())
             }
+            # seed update counters from the fused step count: Adam-style
+            # bias correction must continue from t, not restart at 1
+            t = int(obj.get("t", 0))
+            if t:
+                opt = self.optimizer
+                opt.num_update = max(opt.num_update, t)
+                for i in self.states:
+                    opt._index_update_count[i] = max(
+                        opt._index_update_count.get(i, 0), t)
             return
         self.states = {k: _to_nd(v) for k, v in obj.items()}
 

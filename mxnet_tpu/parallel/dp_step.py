@@ -31,6 +31,7 @@ representable).
 """
 from __future__ import annotations
 
+import contextlib
 import logging
 import pickle
 
@@ -64,12 +65,14 @@ class FusedTrainStep:
 
     def __init__(self, executor, optimizer, param_names, label_names=(),
                  mesh=None, data_axis="data", compute_dtype=None,
-                 logger=logging):
+                 param_specs=None, data_specs=None, logger=logging):
         self._ex = executor
         self._opt = optimizer
         self._logger = logger
         self._mesh = mesh
         self._data_axis = data_axis
+        self._param_specs = dict(param_specs or {})
+        self._data_specs = dict(data_specs or {})
         self._compute_dtype = (
             jnp.dtype(compute_dtype) if compute_dtype is not None else None
         )
@@ -105,24 +108,88 @@ class FusedTrainStep:
         }
         self._base_rng = executor._rng
         self._t = 0  # steps taken through this fused step
+        self._nproc = jax.process_count()
+
+        if self._nproc > 1:
+            # every process must start from ONE weight lineage (the
+            # reference pushes init through the servers for the same
+            # reason, kvstore_dist.h Push-on-init); rank 0 wins. Host
+            # hop happens once at construction, never per step.
+            from jax.experimental import multihost_utils
+
+            self.params = multihost_utils.broadcast_one_to_all(
+                jax.tree_util.tree_map(np.asarray, self.params))
+            self.auxs = multihost_utils.broadcast_one_to_all(
+                jax.tree_util.tree_map(np.asarray, self.auxs))
+            self.states = multihost_utils.broadcast_one_to_all(
+                jax.tree_util.tree_map(np.asarray, self.states))
 
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             self._repl = NamedSharding(mesh, P())
-            self._batch_sh = NamedSharding(mesh, P(data_axis))
-            put = lambda t: jax.tree_util.tree_map(
-                lambda x: jax.device_put(x, self._repl), t
+            # default batch sharding: dim 0 over the data axis (absent
+            # e.g. on a pure-TP mesh -> replicated batch)
+            self._batch_sh = (
+                NamedSharding(mesh, P(data_axis))
+                if data_axis in mesh.axis_names else self._repl
             )
-            self.params = put(self.params)
-            self.auxs = put(self.auxs)
-            self.states = put(self.states)
+            self._param_sh = {
+                n: NamedSharding(mesh, self._param_specs.get(n, P()))
+                for n in self.params
+            }
+            self._data_sh = {
+                n: (NamedSharding(mesh, self._data_specs[n])
+                    if n in self._data_specs else None)
+                for n in self._data_names
+            }
+            self.params = {
+                n: self._put(v, self._param_sh[n])
+                for n, v in self.params.items()
+            }
+            self.auxs = {
+                n: self._put(v, self._repl)
+                for n, v in self.auxs.items()
+            }
+            # optimizer state leaves shaped like the param shard with
+            # it; anything else (scalar counters) replicates
+            self.states = {
+                n: self._place_state(self.states[n], n)
+                for n in self.states
+            }
         else:
             self._repl = None
             self._batch_sh = None
+            self._param_sh = None
+            self._data_sh = None
 
         self._jitted = self._build()
         self._compiled = None  # AOT executable, built on first run
+
+    def _put(self, value, sharding):
+        """Place a host/device value under `sharding`. Multi-process:
+        the mesh spans processes, so build the global jax.Array from the
+        (identical-everywhere) host value instead of device_put."""
+        if self._nproc == 1:
+            return jax.device_put(value, sharding)
+        host = np.asarray(value)
+        return jax.make_array_from_callback(
+            host.shape, sharding, lambda idx: host[idx])
+
+    def _state_sharding(self, state, name):
+        """Sharding pytree for one param's optimizer state: leaves with
+        the param's shape follow the param's sharding, others replicate."""
+        pshape = self.params[name].shape
+        psh = self._param_sh[name]
+        return jax.tree_util.tree_map(
+            lambda leaf: psh if getattr(leaf, "shape", None) == pshape
+            else self._repl,
+            state,
+        )
+
+    def _place_state(self, state, name):
+        sh = self._state_sharding(state, name)
+        return jax.tree_util.tree_map(self._put, state, sh)
 
     # ------------------------------------------------------------ build
     def _build(self):
@@ -183,14 +250,25 @@ class FusedTrainStep:
 
         kwargs = {"donate_argnums": (0, 1, 2)}
         if self._mesh is not None:
+            state_sh = {
+                n: self._state_sharding(self.states[n], n)
+                for n in self.states
+            }
+            aux_sh = {n: self._repl for n in self.auxs}
+            data_sh = {
+                n: (self._data_sh.get(n) or self._batch_sh)
+                for n in self._data_names
+            }
             kwargs["in_shardings"] = (
-                self._repl, self._repl, self._repl, self._batch_sh,
-                None, None,
+                self._param_sh, state_sh, aux_sh, data_sh, None, None,
             )
             # outputs keep whatever layout XLA picks (batch-sharded in
-            # practice); pinning them could fail on rank-0 outputs
+            # practice); pinning them could fail on rank-0 outputs.
+            # Multi-process: replicate outputs (one small all-gather)
+            # so every process can read them without a collective fetch
             kwargs["out_shardings"] = (
-                None, self._repl, self._repl, self._repl,
+                self._repl if self._nproc > 1 else None,
+                self._param_sh, state_sh, aux_sh,
             )
         return jax.jit(step, **kwargs)
 
@@ -198,10 +276,30 @@ class FusedTrainStep:
     def _place_data(self, data_vals):
         if self._batch_sh is None:
             return data_vals
+        if self._nproc > 1:
+            # THE multi-process data plane: each process contributes its
+            # local batch shard; the global array is assembled without
+            # any host gather, and the gradient all-reduce happens
+            # inside the jit over DCN/ICI (vs the reference's
+            # engine-wrapped ZPush/ZPull, kvstore_dist.h:111-123)
+            return {
+                k: jax.make_array_from_process_local_data(
+                    self._data_sh.get(k) or self._batch_sh,
+                    np.asarray(v))
+                for k, v in data_vals.items()
+            }
         return {
-            k: jax.device_put(v, self._batch_sh)
+            k: jax.device_put(v, self._data_sh.get(k) or self._batch_sh)
             for k, v in data_vals.items()
         }
+
+    def _ambient(self):
+        """Install this step's mesh as ambient for the trace (mesh-aware
+        ops — RingAttention, MoEFFN — read it); no-op without a mesh."""
+        from . import mesh as mesh_mod
+
+        return mesh_mod.use_mesh(self._mesh) if self._mesh is not None \
+            else contextlib.nullcontext()
 
     def step(self, data_vals):
         """Run one fused step on {name: jnp array} batch inputs. Returns
@@ -218,18 +316,20 @@ class FusedTrainStep:
             self._place_data(data_vals),
             np.float32(lr), np.int32(self._t),
         )
-        if self._compiled is None:
+        with self._ambient():
+            if self._compiled is None:
+                try:
+                    self._compiled = self._jitted.lower(*args).compile()
+                except Exception:  # fall back to dispatch-compiled jit
+                    self._compiled = False
+            fn = self._compiled if self._compiled else self._jitted
             try:
-                self._compiled = self._jitted.lower(*args).compile()
-            except Exception:  # fall back to dispatch-compiled jit
-                self._compiled = False
-        fn = self._compiled if self._compiled else self._jitted
-        try:
-            outs, self.params, self.states, self.auxs = fn(*args)
-        except (TypeError, ValueError):
-            # shape/dtype drift (e.g. a differently-sized final batch):
-            # the AOT executable is exact-shape; re-dispatch through jit
-            outs, self.params, self.states, self.auxs = self._jitted(*args)
+                outs, self.params, self.states, self.auxs = fn(*args)
+            except (TypeError, ValueError):
+                # shape/dtype drift (e.g. a differently-sized final
+                # batch): the AOT executable is exact-shape; re-dispatch
+                outs, self.params, self.states, self.auxs = \
+                    self._jitted(*args)
         return outs
 
     def sync(self):
@@ -242,23 +342,27 @@ class FusedTrainStep:
         jax.block_until_ready(self.params)
         if self.params:
             leaf = next(iter(self.params.values()))
-            np.asarray(jax.device_get(jnp.ravel(leaf)[0]))
+            if self._nproc > 1:
+                np.asarray(leaf.addressable_data(0))
+            else:
+                np.asarray(jax.device_get(jnp.ravel(leaf)[0]))
 
     # --------------------------------------------------------- teardown
     def load_params(self, arg_params, aux_params):
         """Replace the owned parameters/auxs from NDArray dicts (the
         Module calls this when params changed outside the fused step —
         set_params, init_params(force_init), an eager update)."""
-        def place(x):
+        def place(x, sh):
             x = jnp.copy(jnp.asarray(x))
-            if self._repl is not None:
-                x = jax.device_put(x, self._repl)
+            if sh is not None:
+                x = jax.device_put(x, sh)
             return x
 
         for n in self._param_names:
-            self.params[n] = place(arg_params[n]._data)
+            sh = self._param_sh[n] if self._param_sh is not None else None
+            self.params[n] = place(arg_params[n]._data, sh)
         for n in self._aux_names:
-            self.auxs[n] = place(aux_params[n]._data)
+            self.auxs[n] = place(aux_params[n]._data, self._repl)
 
     def snapshot(self):
         """(params, auxs) as safe-to-expose copies: the live buffers
@@ -267,6 +371,11 @@ class FusedTrainStep:
         a single device so eager executors can consume them."""
         if self._mesh is None:
             leaf = jnp.copy
+        elif self._nproc > 1:
+            # params/auxs are replicated in multi-process mode (guarded
+            # at construction), so the local shard IS the full value
+            leaf = lambda v: jnp.asarray(np.asarray(
+                v.addressable_data(0)))
         else:
             dev0 = self._mesh.devices.flat[0]
             leaf = lambda v: jax.device_put(v, dev0)
@@ -320,9 +429,7 @@ class FusedTrainStep:
         tmpl = self.states
         new = jax.tree_util.tree_map(jnp.asarray, host)
         if self._repl is not None:
-            new = jax.tree_util.tree_map(
-                lambda x: jax.device_put(x, self._repl), new
-            )
+            new = {n: self._place_state(s, n) for n, s in new.items()}
         if jax.tree_util.tree_structure(new) != \
                 jax.tree_util.tree_structure(tmpl):
             raise MXNetError("optimizer state structure mismatch")

@@ -1,0 +1,249 @@
+"""KVStore('dist_async'): asynchronous parameter-server semantics.
+
+Reference async mode (src/kvstore/kvstore_dist_server.h:136-229,
+kvstore.cc:17-45 type table): each worker's push applies the optimizer
+IMMEDIATELY on the server — no cross-worker barrier, no gradient
+aggregation; pulls return whatever weights the server currently holds.
+Fast workers don't wait for stragglers at the cost of gradient
+staleness.
+
+TPU-native adaptation: there is no separate server binary. Rank 0
+co-hosts the server as a daemon thread, and the transport is the
+jax.distributed *coordination service* KV store (the control plane) —
+NOT the ICI/DCN data plane, which stays dedicated to the in-jit
+collectives of the sync paths. That matches the role split of the
+reference (zmq control sockets vs NCCL data channels) and keeps async
+worker processes free to proceed at their own pace:
+
+  worker push  -> kv_set  bytes at  ps/g/<key>/<rank>/<seq>
+  server loop  -> polls expected seqs, applies updater per arrival
+                  (async: per-push, per-worker, no merge), publishes
+                  ps/w/<key> with a version counter
+  worker pull  -> kv_get  ps/w/<key>   (blocking on first touch)
+
+Liveness: every store heartbeats ps/hb/<rank> (epoch seconds);
+`get_num_dead_node` counts stale ranks — the analog of ps-lite's
+heartbeat surface (reference include/mxnet/kvstore.h:242).
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+
+import numpy as np
+
+from .. import optimizer as opt
+from ..base import MXNetError
+from ..kvstore import _ctype_key_value, _str_key
+from ..ndarray import NDArray, array as nd_array
+from .kvstore_tpu import KVStoreTPU
+
+_HB_INTERVAL = 2.0  # seconds between heartbeats
+_POLL = 0.005       # server poll period
+
+
+def _client():
+    from jax._src import distributed
+
+    return distributed.global_state.client
+
+
+def _try_get_bytes(key):
+    """None when the key is absent (the client raises NOT_FOUND)."""
+    try:
+        return _client().key_value_try_get_bytes(key)
+    except Exception:
+        return None
+
+
+def _try_get(key):
+    try:
+        return _client().key_value_try_get(key)
+    except Exception:
+        return None
+
+
+def _delete(key):
+    try:
+        _client().key_value_delete(key)
+    except Exception:
+        pass
+
+
+def _set_bytes(key, blob):
+    try:
+        _client().key_value_set_bytes(key, blob, allow_overwrite=True)
+    except TypeError:
+        _client().key_value_set_bytes(key, blob)
+
+
+def _dumps(arr):
+    a = np.ascontiguousarray(arr)
+    return pickle.dumps((a.dtype.str, a.shape, a.tobytes()), protocol=4)
+
+
+def _loads(blob):
+    dtype, shape, raw = pickle.loads(blob)
+    return np.frombuffer(raw, dtype=np.dtype(dtype)).reshape(shape).copy()
+
+
+class KVStoreDistAsync(KVStoreTPU):
+    """Async parameter server over the coordination-service KV store."""
+
+    def __init__(self, kv_type="dist_async"):
+        super().__init__(kv_type)
+        import jax
+
+        self._nproc = jax.process_count()
+        self._rank = jax.process_index()
+        self._seq = {}          # key -> my next push sequence number
+        self._server = None
+        self._stop = threading.Event()
+        self._hb_thread = None
+        if self._nproc > 1:
+            self._start_heartbeat()
+
+    # --------------------------------------------------------- lifecycle
+    def _start_heartbeat(self):
+        def beat():
+            while not self._stop.is_set():
+                try:
+                    _client().key_value_set(
+                        f"ps/hb/{self._rank}", str(time.time()),
+                        allow_overwrite=True)
+                except TypeError:
+                    _client().key_value_set(
+                        f"ps/hb/{self._rank}", str(time.time()))
+                except Exception:
+                    pass
+                self._stop.wait(_HB_INTERVAL)
+
+        self._hb_thread = threading.Thread(
+            target=beat, name="kv_heartbeat", daemon=True)
+        self._hb_thread.start()
+
+    def close(self):
+        self._stop.set()
+
+    # ------------------------------------------------------------ server
+    def _ensure_server(self):
+        """Rank 0 co-hosts the server thread (reference: separate
+        server binaries scheduled by the tracker; one co-hosted server
+        is the degenerate single-server topology)."""
+        if self._rank != 0 or self._server is not None:
+            return
+        self._applied = {}  # (key, rank) -> last applied seq
+
+        def serve():
+            while not self._stop.is_set():
+                progressed = False
+                for k in list(self._store):
+                    for r in range(self._nproc):
+                        s = self._applied.get((k, r), 0)
+                        blob = _try_get_bytes(f"ps/g/{k}/{r}/{s}")
+                        if blob is None:
+                            continue
+                        grad = nd_array(_loads(blob))
+                        if self._updater is not None:
+                            self._updater(
+                                _str_key(k), grad, self._store[k])
+                        else:
+                            grad.copyto(self._store[k])
+                        self._publish(k)
+                        _delete(f"ps/g/{k}/{r}/{s}")
+                        self._applied[(k, r)] = s + 1
+                        progressed = True
+                if not progressed:
+                    time.sleep(_POLL)
+
+        self._server = threading.Thread(
+            target=serve, name="kv_async_server", daemon=True)
+        self._server.start()
+
+    def _publish(self, k):
+        _set_bytes(f"ps/w/{k}", _dumps(self._store[k].asnumpy()))
+
+    # ---------------------------------------------------------- data ops
+    def init(self, key, value):
+        keys, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            self._store[k] = vlist[0].copy()
+        if self._nproc == 1:
+            return
+        self._align_processes(f"async_init_{len(self._store)}")
+        if self._rank == 0:
+            self._ensure_server()
+            for k in keys:
+                self._publish(k)
+        else:
+            # adopt the server's initial weights (one lineage)
+            for k in keys:
+                blob = _client().blocking_key_value_get_bytes(
+                    f"ps/w/{k}", 600_000)
+                self._store[k] = nd_array(_loads(blob))
+
+    def push(self, key, value, priority=0):
+        """Send the locally-merged gradient; NO barrier, NO cross-worker
+        merge — the server applies each worker's gradient on arrival
+        (reference async DataHandle, kvstore_dist_server.h:136-160)."""
+        if self._nproc == 1:
+            return super().push(key, value, priority)
+        keys, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            if k not in self._store:
+                raise MXNetError(f"key {k!r} not initialized")
+            merged = vlist[0]
+            if len(vlist) > 1:
+                import jax
+
+                dev = vlist[0].context.jax_device()
+                acc = vlist[0]._data
+                for v in vlist[1:]:
+                    acc = acc + jax.device_put(v._data, dev)
+                merged = NDArray(acc, ctx=vlist[0].context)
+            s = self._seq.get(k, 0)
+            _set_bytes(f"ps/g/{k}/{self._rank}/{s}",
+                       _dumps(merged.asnumpy()))
+            self._seq[k] = s + 1
+
+    def pull(self, key, out=None, priority=0):
+        if self._nproc == 1:
+            return super().pull(key, out=out, priority=priority)
+        keys, outs = _ctype_key_value(key, out)
+        for k, olist in zip(keys, outs):
+            if self._rank == 0:
+                # the co-hosted server's store IS the authoritative
+                # weight; reading the published snapshot here could
+                # revert updates the server thread applied since the
+                # last publish
+                host = self._store[k].asnumpy()
+            else:
+                blob = _try_get_bytes(f"ps/w/{k}")
+                if blob is None:
+                    blob = _client().blocking_key_value_get_bytes(
+                        f"ps/w/{k}", 600_000)
+                host = _loads(blob)
+                self._store[k] = nd_array(host)
+            for o in olist:
+                o[:] = host
+
+    def set_optimizer(self, optimizer):
+        """Only the server (rank 0) runs the optimizer — true reference
+        async topology, unlike the sync path's run-everywhere."""
+        self._set_updater(opt.get_updater(optimizer))
+
+    # ---------------------------------------------------------- liveness
+    def get_num_dead_node(self, node_id=0, timeout=60):
+        """Stale-heartbeat count (reference kvstore.h:242 ps-lite
+        heartbeat surface). A rank is dead when its ps/hb/<rank> entry
+        is older than `timeout` seconds (or missing after startup)."""
+        if self._nproc == 1:
+            return 0
+        now = time.time()
+        dead = 0
+        for r in range(self._nproc):
+            ts = _try_get(f"ps/hb/{r}")
+            if ts is None or now - float(ts) > timeout:
+                dead += 1
+        return dead

@@ -57,7 +57,11 @@ def maybe_init_distributed():
     coord = os.environ.get("MXNET_TPU_COORDINATOR")
     n = os.environ.get("MXNET_TPU_NUM_WORKERS")
     wid = os.environ.get("MXNET_TPU_WORKER_ID")
-    if coord and n and wid:
+    if wid is None and os.environ.get("MXNET_TPU_WORKER_ID_FROM_MPI"):
+        # mpi launcher: rank comes from the MPI runtime
+        wid = os.environ.get("OMPI_COMM_WORLD_RANK") or \
+            os.environ.get("PMI_RANK")
+    if coord and n and wid is not None:
         jax.distributed.initialize(
             coordinator_address=coord,
             num_processes=int(n),
@@ -189,7 +193,20 @@ class KVStoreTPU(KVStore):
         self._set_updater(opt.get_updater(optimizer))
 
     def get_num_dead_node(self, node_id=0, timeout=60):
-        """Reference surfaces ps-lite heartbeat info
-        (kvstore_dist.h:159-167). jax.distributed has no queryable
-        liveness yet; report all healthy."""
-        return 0
+        """Liveness via the coordination service (reference ps-lite
+        heartbeat surface, include/mxnet/kvstore.h:242). Counts worker
+        processes the coordinator no longer sees as live; single
+        process (or no coordinator) reports all healthy."""
+        if jax.process_count() == 1:
+            return 0
+        try:
+            from jax._src import distributed
+
+            client = distributed.global_state.client
+            if client is None:
+                return 0
+            live = client.get_live_nodes(
+                list(range(jax.process_count())))
+            return jax.process_count() - len(live)
+        except Exception:
+            return 0

@@ -52,6 +52,56 @@ def current_mesh():
     return _current_mesh
 
 
+class use_mesh:
+    """Context manager installing `mesh` as the ambient mesh (read by
+    mesh-aware ops like RingAttention/MoEFFN at trace time)."""
+
+    def __init__(self, mesh):
+        self._mesh = mesh
+        self._prev = None
+
+    def __enter__(self):
+        global _current_mesh
+        self._prev = _current_mesh
+        _current_mesh = self._mesh
+        return self._mesh
+
+    def __exit__(self, *exc):
+        global _current_mesh
+        _current_mesh = self._prev
+        return False
+
+
+def parse_partition_spec(spec):
+    """Parse a sharding annotation into a PartitionSpec.
+
+    Accepts a PartitionSpec/tuple directly, or the string syntax used in
+    Symbol `__sharding__` attrs: comma-separated per-dim entries, each
+    an axis name, 'None'/'*' (unsharded), or 'a+b' (multi-axis). E.g.
+    "None,model" = column-parallel 2-D weight; "data+seq" = dim 0
+    sharded over both axes.
+    """
+    if spec is None:
+        return PartitionSpec()
+    if isinstance(spec, PartitionSpec):
+        return spec
+    if isinstance(spec, (tuple, list)):
+        return PartitionSpec(*spec)
+    s = str(spec).strip()
+    if not s or s == "None":
+        return PartitionSpec()
+    dims = []
+    for part in s.split(","):
+        part = part.strip()
+        if part in ("None", "", "*"):
+            dims.append(None)
+        elif "+" in part:
+            dims.append(tuple(p.strip() for p in part.split("+")))
+        else:
+            dims.append(part)
+    return PartitionSpec(*dims)
+
+
 def default_mesh():
     """Current mesh, or a fresh data-parallel mesh over all devices."""
     global _current_mesh

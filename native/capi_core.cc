@@ -27,6 +27,16 @@
 #include <string>
 #include <vector>
 
+extern "C" {
+// callback ABI (mirrors reference MXKVStoreUpdater / monitor callback,
+// include/mxnet/c_api.h:1264, :1084): handles are BORROWED PyObject*
+// NDArrays, valid only for the duration of the call.
+typedef void (*MXTpuKVUpdater)(int key, void* recv, void* local,
+                               void* payload);
+typedef void (*MXTpuMonitorCallback)(const char* name, void* arr,
+                                     void* payload);
+}
+
 namespace {
 
 std::once_flag g_init_once;
@@ -65,8 +75,15 @@ void SetError(const char* where) {
   if (value != nullptr) {
     PyObject* s = PyObject_Str(value);
     if (s != nullptr) {
-      tls_err += ": ";
-      tls_err += PyUnicode_AsUTF8(s);
+      // PyUnicode_AsUTF8 returns nullptr (with an exception pending)
+      // for non-UTF8-encodable text; appending nullptr would be UB
+      const char* u = PyUnicode_AsUTF8(s);
+      if (u != nullptr) {
+        tls_err += ": ";
+        tls_err += u;
+      } else {
+        PyErr_Clear();
+      }
       Py_DECREF(s);
     }
   }
@@ -532,6 +549,270 @@ int MXTpuExecutorArray(void* ex, const char* name, const char* kind,
   PyObject* r = CallShim("executor_arg", args);
   if (r == nullptr) return -1;
   *out = r;
+  return 0;
+}
+
+// Install a per-node monitor callback on the executor (reference
+// MXExecutorSetMonitorCallback, c_api.h:1084): cb(name, array_handle,
+// payload) fires for EVERY node output on monitored forwards. The
+// array handle is BORROWED for the duration of the call.
+int MXTpuExecutorSetMonitorCallback(void* ex,
+                                    MXTpuMonitorCallback cb,
+                                    void* payload) {
+  Gil gil;
+  PyObject* args = PyTuple_New(3);
+  Py_INCREF(static_cast<PyObject*>(ex));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(ex));
+  PyTuple_SET_ITEM(args, 1,
+                   PyLong_FromVoidPtr(reinterpret_cast<void*>(cb)));
+  PyTuple_SET_ITEM(args, 2, PyLong_FromVoidPtr(payload));
+  PyObject* r = CallShim("executor_set_monitor", args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// ----------------------------------------------------------- DataIter
+
+// Registered iterator names (reference MXListDataIters, c_api.h:1096).
+int MXTpuListDataIters(int* num, const char*** names) {
+  Gil gil;
+  PyObject* r = CallShim("dataiter_list", nullptr);
+  if (r == nullptr) return -1;
+  StashStrList(r, num, names);
+  Py_DECREF(r);
+  return 0;
+}
+
+// All params are strings, exactly the reference's kwargs convention
+// (MXDataIterCreateIter, c_api.h:1108).
+int MXTpuDataIterCreate(const char* name, int num_params,
+                        const char** keys, const char** vals,
+                        void** out) {
+  Gil gil;
+  PyObject* args = PyTuple_New(2);
+  PyTuple_SET_ITEM(args, 0, Str(name));
+  PyTuple_SET_ITEM(args, 1, StrDict(num_params, keys, vals));
+  PyObject* r = CallShim("dataiter_create", args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+// *out = 1 while a batch is available, 0 at epoch end.
+int MXTpuDataIterNext(void* it, int* out) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  Py_INCREF(static_cast<PyObject*>(it));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(it));
+  PyObject* r = CallShim("dataiter_next", args);
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuDataIterBeforeFirst(void* it) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  Py_INCREF(static_cast<PyObject*>(it));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(it));
+  PyObject* r = CallShim("dataiter_reset", args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+static int DataIterFetch(void* it, const char* what, void** out) {
+  Gil gil;
+  PyObject* args = PyTuple_New(2);
+  Py_INCREF(static_cast<PyObject*>(it));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(it));
+  PyTuple_SET_ITEM(args, 1, Str(what));
+  PyObject* r = CallShim("dataiter_get", args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+// NEW NDArray handles for the current batch's data / label.
+int MXTpuDataIterGetData(void* it, void** out) {
+  return DataIterFetch(it, "data", out);
+}
+
+int MXTpuDataIterGetLabel(void* it, void** out) {
+  return DataIterFetch(it, "label", out);
+}
+
+int MXTpuDataIterGetPadNum(void* it, int* pad) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  Py_INCREF(static_cast<PyObject*>(it));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(it));
+  PyObject* r = CallShim("dataiter_pad", args);
+  if (r == nullptr) return -1;
+  *pad = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+// ------------------------------------------------------------ KVStore
+
+int MXTpuKVStoreCreate(const char* type, void** out) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  PyTuple_SET_ITEM(args, 0, Str(type));
+  PyObject* r = CallShim("kvstore_create", args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+static int KVStoreKV(const char* fn, void* kv, int num, const int* keys,
+                     void** vals) {
+  Gil gil;
+  PyObject* args = PyTuple_New(3);
+  Py_INCREF(static_cast<PyObject*>(kv));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(kv));
+  PyTuple_SET_ITEM(args, 1, IntList(keys, num));
+  PyTuple_SET_ITEM(args, 2, HandleList(vals, num));
+  PyObject* r = CallShim(fn, args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuKVStoreInit(void* kv, int num, const int* keys, void** vals) {
+  return KVStoreKV("kvstore_init", kv, num, keys, vals);
+}
+
+int MXTpuKVStorePush(void* kv, int num, const int* keys, void** vals) {
+  return KVStoreKV("kvstore_push", kv, num, keys, vals);
+}
+
+// Pull writes INTO the given existing NDArrays.
+int MXTpuKVStorePull(void* kv, int num, const int* keys, void** outs) {
+  return KVStoreKV("kvstore_pull", kv, num, keys, outs);
+}
+
+// cb(key, recv_grad_handle, local_weight_handle, payload); handles are
+// BORROWED for the duration of the call (reference MXKVStoreUpdater,
+// c_api.h:1264-1276).
+int MXTpuKVStoreSetUpdater(void* kv, MXTpuKVUpdater cb, void* payload) {
+  Gil gil;
+  PyObject* args = PyTuple_New(3);
+  Py_INCREF(static_cast<PyObject*>(kv));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(kv));
+  PyTuple_SET_ITEM(args, 1,
+                   PyLong_FromVoidPtr(reinterpret_cast<void*>(cb)));
+  PyTuple_SET_ITEM(args, 2, PyLong_FromVoidPtr(payload));
+  PyObject* r = CallShim("kvstore_set_updater", args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+static int KVStoreIntProp(const char* fn, void* kv, int* out) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  Py_INCREF(static_cast<PyObject*>(kv));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(kv));
+  PyObject* r = CallShim(fn, args);
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuKVStoreGetRank(void* kv, int* rank) {
+  return KVStoreIntProp("kvstore_rank", kv, rank);
+}
+
+int MXTpuKVStoreGetGroupSize(void* kv, int* size) {
+  return KVStoreIntProp("kvstore_group_size", kv, size);
+}
+
+int MXTpuKVStoreGetNumDeadNode(void* kv, int node_id, int timeout,
+                               int* dead) {
+  Gil gil;
+  PyObject* args = PyTuple_New(3);
+  Py_INCREF(static_cast<PyObject*>(kv));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(kv));
+  PyTuple_SET_ITEM(args, 1, PyLong_FromLong(node_id));
+  PyTuple_SET_ITEM(args, 2, PyLong_FromLong(timeout));
+  PyObject* r = CallShim("kvstore_num_dead_node", args);
+  if (r == nullptr) return -1;
+  *dead = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuKVStoreBarrier(void* kv) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  Py_INCREF(static_cast<PyObject*>(kv));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(kv));
+  PyObject* r = CallShim("kvstore_barrier", args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// TLS string; valid until this thread's next call.
+int MXTpuKVStoreGetType(void* kv, const char** out) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  Py_INCREF(static_cast<PyObject*>(kv));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(kv));
+  PyObject* r = CallShim("kvstore_type", args);
+  if (r == nullptr) return -1;
+  tls_strs.clear();
+  const char* s = PyUnicode_AsUTF8(r);
+  tls_strs.emplace_back(s ? s : "");
+  *out = tls_strs.back().c_str();
+  Py_DECREF(r);
+  return 0;
+}
+
+// ----------------------------------------------------------- Autograd
+
+// Returns the previous mode via *prev (reference
+// MXAutogradSetIsTraining, c_api.h:529).
+int MXTpuAutogradSetIsTraining(int is_training, int* prev) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  PyTuple_SET_ITEM(args, 0, PyLong_FromLong(is_training));
+  PyObject* r = CallShim("autograd_set_training", args);
+  if (r == nullptr) return -1;
+  *prev = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+// Attach gradient buffers to variables (reference
+// MXAutogradMarkVariables, c_api.h:536). Gradients accumulate into
+// grad_handles after ComputeGradient.
+int MXTpuAutogradMarkVariables(int num, void** var_handles,
+                               void** grad_handles) {
+  Gil gil;
+  PyObject* args = PyTuple_New(2);
+  PyTuple_SET_ITEM(args, 0, HandleList(var_handles, num));
+  PyTuple_SET_ITEM(args, 1, HandleList(grad_handles, num));
+  PyObject* r = CallShim("autograd_mark_variables", args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// Backprop from the given outputs; gradients land in the buffers given
+// at MarkVariables (reference MXAutogradComputeGradient, c_api.h:546).
+int MXTpuAutogradComputeGradient(int num, void** output_handles) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  PyTuple_SET_ITEM(args, 0, HandleList(output_handles, num));
+  PyObject* r = CallShim("autograd_compute_gradient", args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
   return 0;
 }
 

@@ -25,6 +25,13 @@ extern "C" {
 const char* MXTpuGetLastError(void);
 int MXTpuHandleFree(void* handle);
 
+/* Callback ABI: handles passed to callbacks are BORROWED and valid
+ * only for the duration of the call (do not free them). */
+typedef void (*MXTpuKVUpdater)(int key, void* recv, void* local,
+                               void* payload);
+typedef void (*MXTpuMonitorCallback)(const char* name, void* arr,
+                                     void* payload);
+
 /* ---- NDArray ---- */
 int MXTpuNDArrayCreate(const int* shape, int ndim, const float* data,
                        void** out);
@@ -74,6 +81,40 @@ int MXTpuExecutorBackward(void* ex);
 int MXTpuExecutorOutputs(void* ex, int* num, void*** out);
 int MXTpuExecutorArray(void* ex, const char* name,
                        const char* kind /* arg|grad|aux */, void** out);
+
+int MXTpuExecutorSetMonitorCallback(void* ex,
+                                    MXTpuMonitorCallback cb,
+                                    void* payload);
+
+/* ---- DataIter (reference c_api.h:1096-1185) ---- */
+int MXTpuListDataIters(int* num, const char*** names);
+int MXTpuDataIterCreate(const char* name, int num_params,
+                        const char** keys, const char** vals,
+                        void** out);
+int MXTpuDataIterNext(void* it, int* out /* 1=batch, 0=end */);
+int MXTpuDataIterBeforeFirst(void* it);
+int MXTpuDataIterGetData(void* it, void** out);
+int MXTpuDataIterGetLabel(void* it, void** out);
+int MXTpuDataIterGetPadNum(void* it, int* pad);
+
+/* ---- KVStore (reference c_api.h:1207-1397) ---- */
+int MXTpuKVStoreCreate(const char* type, void** out);
+int MXTpuKVStoreInit(void* kv, int num, const int* keys, void** vals);
+int MXTpuKVStorePush(void* kv, int num, const int* keys, void** vals);
+int MXTpuKVStorePull(void* kv, int num, const int* keys, void** outs);
+int MXTpuKVStoreSetUpdater(void* kv, MXTpuKVUpdater cb, void* payload);
+int MXTpuKVStoreGetType(void* kv, const char** out);
+int MXTpuKVStoreGetRank(void* kv, int* rank);
+int MXTpuKVStoreGetGroupSize(void* kv, int* size);
+int MXTpuKVStoreBarrier(void* kv);
+int MXTpuKVStoreGetNumDeadNode(void* kv, int node_id, int timeout,
+                               int* dead);
+
+/* ---- Autograd (reference c_api.h:529-546) ---- */
+int MXTpuAutogradSetIsTraining(int is_training, int* prev);
+int MXTpuAutogradMarkVariables(int num, void** var_handles,
+                               void** grad_handles);
+int MXTpuAutogradComputeGradient(int num, void** output_handles);
 
 /* ---- predict-only ABI (capi_predict.cc) ---- */
 int MXTpuPredCreate(const char* symbol_json, const void* param_bytes,

@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Multi-process fused-data-plane convergence check (the dist_lenet
+analog, reference tests/nightly/dist_lenet.py): N worker processes
+train one Module through the fused train step — gradients all-reduce
+INSIDE the jit over the global mesh; the KVStore push/pull host path
+must never run.
+
+Run via tools/launch.py -n 2 (see tests/test_dist_kvstore.py pattern).
+"""
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+)
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def main():
+    kv = mx.kv.create("tpu")  # initializes jax.distributed from env
+    import jax
+
+    assert jax.process_count() == int(
+        os.environ["MXNET_TPU_NUM_WORKERS"])
+    rank = kv.rank
+
+    # forbid the host-staged data plane: the fused path must not push
+    def _no_push(*a, **k):
+        raise AssertionError("kvstore.push ran — fused path not used")
+
+    kv.push = _no_push
+
+    # tiny separable problem; each worker sees a disjoint slice
+    rs = np.random.RandomState(42)  # same data both ranks, split below
+    n, dim, classes = 512, 16, 4
+    w_true = rs.randn(dim, classes)
+    x_all = rs.randn(n, dim).astype("float32")
+    y_all = (x_all @ w_true).argmax(axis=1).astype("float32")
+    half = n // kv.num_workers
+    x = x_all[rank * half:(rank + 1) * half]
+    y = y_all[rank * half:(rank + 1) * half]
+
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=classes, name="fc2")
+    net = mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+    batch = 32
+    mod = mx.mod.Module(net, context=[mx.cpu()])
+    mod.bind(data_shapes=[("data", (batch, dim))],
+             label_shapes=[("softmax_label", (batch,))])
+    # rank-dependent init: the fused step's rank-0 broadcast must
+    # reconcile it (kvstore init also broadcasts its copy)
+    mod.init_params(mx.initializer.Uniform(0.1 * (rank + 1)))
+    mod.init_optimizer(
+        kvstore=kv, optimizer="sgd",
+        optimizer_params=(("learning_rate", 0.25), ("momentum", 0.9)))
+
+    assert mod._fused_step is not None, "fused step inactive"
+    assert mod._fused_step._nproc == kv.num_workers
+    assert mod._fused_step._mesh.size == jax.device_count()
+
+    def accuracy():
+        correct = 0
+        for i in range(0, half, batch):
+            b = mx.io.DataBatch(
+                data=[mx.nd.array(x[i:i + batch])],
+                label=[mx.nd.array(y[i:i + batch])])
+            mod.forward(b, is_train=False)
+            pred = mod.get_outputs()[0].asnumpy().argmax(axis=1)
+            correct += (pred == y[i:i + batch]).sum()
+        return correct / half
+
+    for epoch in range(12):
+        order = np.random.RandomState(epoch).permutation(half)
+        for i in range(0, half, batch):
+            idx = order[i:i + batch]
+            b = mx.io.DataBatch(data=[mx.nd.array(x[idx])],
+                                label=[mx.nd.array(y[idx])])
+            mod.forward_backward(b)
+            mod.update()
+    mod.sync()
+
+    acc = accuracy()
+    assert acc > 0.9, f"rank {rank}: accuracy {acc:.3f} too low"
+
+    # replicas must hold identical parameters (one weight lineage)
+    w = mod.get_params()[0]["fc2_weight"].asnumpy()
+    from jax.experimental import multihost_utils
+
+    w0 = multihost_utils.broadcast_one_to_all(w)
+    np.testing.assert_allclose(w, np.asarray(w0), rtol=1e-5, atol=1e-6)
+
+    print(f"dist_fused_module OK rank={rank} acc={acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

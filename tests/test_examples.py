@@ -1,0 +1,59 @@
+"""Examples smoke tier: every examples/* script must run end-to-end on
+the CPU mesh (round-2 verdict weak #7 — examples were untested and
+could rot silently). Each runs as a fresh interpreter with tiny sizes,
+the same way a user would invoke it.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# every example script must appear here (gate below enforces it)
+EXAMPLES = {
+    "image_classification/train_mnist.py": [
+        "--num-epochs", "1", "--batch-size", "32"],
+    "rnn/lstm_bucketing.py": [
+        "--num-epochs", "1", "--batch-size", "8", "--num-hidden", "16",
+        "--num-embed", "8", "--num-layers", "1"],
+    "ssd/train_ssd_toy.py": ["--num-epochs", "1", "--batch-size", "4"],
+    "ssd/train_ssd_recordio.py": [
+        "--num-epochs", "1", "--batch-size", "4"],
+    "long_context/ring_attention_demo.py": [],
+    "distributed/dist_train.py": [],
+}
+
+
+def _run(rel, extra):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "examples", rel)] + extra,
+        env=env, capture_output=True, text=True, timeout=540,
+        cwd=ROOT,
+    )
+    assert proc.returncode == 0, (
+        f"{rel} failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}")
+    return proc
+
+
+def test_every_example_is_listed():
+    found = set()
+    for dirpath, _, files in os.walk(os.path.join(ROOT, "examples")):
+        for f in files:
+            if f.endswith(".py"):
+                rel = os.path.relpath(
+                    os.path.join(dirpath, f),
+                    os.path.join(ROOT, "examples"))
+                found.add(rel.replace(os.sep, "/"))
+    missing = found - set(EXAMPLES)
+    assert not missing, (
+        f"examples without a smoke test entry: {sorted(missing)}")
+
+
+@pytest.mark.parametrize("rel", sorted(EXAMPLES))
+def test_example_runs(rel):
+    _run(rel, EXAMPLES[rel])

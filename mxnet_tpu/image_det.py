@@ -32,8 +32,8 @@ from .base import MXNetError
 from .image import (
     CastAug,
     ColorNormalizeAug,
+    _resize_np,
     imdecode,
-    imresize,
 )
 
 
@@ -73,8 +73,12 @@ def _iou(box, boxes):
 
 
 class DetAugmenter:
-    """Base detection augmenter: __call__(img_nd, objs) -> (img, objs)
-    with objs an (N, 5+) [cls, x1, y1, x2, y2, ...] normalized array."""
+    """Base detection augmenter: __call__(img, objs) -> (img, objs).
+
+    `img` is a plain HWC numpy array (the whole det chain stays on
+    host numpy — no device round-trips in the input hot loop; the
+    batch converts to a device array ONCE at assembly) and objs an
+    (N, 5+) [cls, x1, y1, x2, y2, ...] normalized array."""
 
     def __call__(self, src, label):
         raise NotImplementedError
@@ -89,7 +93,7 @@ class DetHorizontalFlipAug(DetAugmenter):
 
     def __call__(self, src, label):
         if random.random() < self.p:
-            src = nd.array(np.asarray(src.asnumpy())[:, ::-1])
+            src = np.ascontiguousarray(src[:, ::-1])
             label = label.copy()
             x1 = label[:, 1].copy()
             label[:, 1] = 1.0 - label[:, 3]
@@ -152,11 +156,10 @@ class DetRandomCropAug(DetAugmenter):
         kept[:, 3] = np.clip((kept[:, 3] - x1) / cw, 0, 1)
         kept[:, 2] = np.clip((kept[:, 2] - y1) / ch, 0, 1)
         kept[:, 4] = np.clip((kept[:, 4] - y1) / ch, 0, 1)
-        img = src.asnumpy()
-        hh, ww = img.shape[:2]
+        hh, ww = src.shape[:2]
         px1, px2 = int(x1 * ww), max(int(x2 * ww), int(x1 * ww) + 1)
         py1, py2 = int(y1 * hh), max(int(y2 * hh), int(y1 * hh) + 1)
-        return nd.array(img[py1:py2, px1:px2]), kept
+        return src[py1:py2, px1:px2], kept
 
 
 class DetRandomPadAug(DetAugmenter):
@@ -172,7 +175,7 @@ class DetRandomPadAug(DetAugmenter):
     def __call__(self, src, label):
         if random.random() >= self.p or self.max_pad_scale <= 1.0:
             return src, label
-        img = src.asnumpy()
+        img = src
         h, w = img.shape[:2]
         scale = random.uniform(1.0, self.max_pad_scale)
         nh, nw = int(h * scale), int(w * scale)
@@ -186,7 +189,7 @@ class DetRandomPadAug(DetAugmenter):
         out[:, 3] = (out[:, 3] * w + ox) / nw
         out[:, 2] = (out[:, 2] * h + oy) / nh
         out[:, 4] = (out[:, 4] * h + oy) / nh
-        return nd.array(canvas), out
+        return canvas, out
 
 
 class DetResizeAug(DetAugmenter):
@@ -196,19 +199,21 @@ class DetResizeAug(DetAugmenter):
         self.w, self.h, self.interp = w, h, interp
 
     def __call__(self, src, label):
-        return imresize(src, self.w, self.h, self.interp), label
+        return _resize_np(src, self.w, self.h, self.interp), label
 
 
 class DetImageAug(DetAugmenter):
     """Adapt a plain image augmenter (color/cast — anything geometry-
-    free) into the detection chain."""
+    free, written against the NDArray chain) into the numpy det
+    chain."""
 
     def __init__(self, aug):
         self.aug = aug
 
     def __call__(self, src, label):
-        out = self.aug(src)
-        return (out[0] if isinstance(out, list) else out), label
+        out = self.aug(nd.array(src))
+        out = out[0] if isinstance(out, list) else out
+        return out.asnumpy(), label
 
 
 def CreateDetAugmenter(data_shape, resize=0, rand_crop=0.0, rand_pad=0.0,
@@ -272,6 +277,13 @@ class ImageDetIter(_io.DataIter):
             else:
                 self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
                 self.seq = None
+                if shuffle:
+                    logging.warning(
+                        "ImageDetIter: shuffle=True needs an .idx "
+                        "sidecar for random access; %s has none, so "
+                        "records stream in file order every epoch "
+                        "(build one with recordio.MXIndexedRecordIO)",
+                        path_imgrec)
         elif imglist is not None or path_imglist:
             if path_imglist:
                 entries = []
@@ -362,11 +374,11 @@ class ImageDetIter(_io.DataIter):
             if img.shape == ():
                 logging.debug("invalid image, skipping")
                 continue
-            for aug in self.auglist:
-                img, objs = aug(img, objs)
             arr = img.asnumpy()
+            for aug in self.auglist:
+                arr, objs = aug(arr, objs)
             if arr.shape[:2] != (h, w):
-                arr = imresize(nd.array(arr), w, h).asnumpy()
+                arr = _resize_np(arr, w, h)
             data[i] = arr.astype(np.float32).transpose(2, 0, 1)
             k = min(len(objs), self._max_obj)
             if k:

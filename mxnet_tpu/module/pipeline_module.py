@@ -269,5 +269,49 @@ class PipelineModule(BaseModule):
     def loss_value(self):
         return float(np.asarray(self._loss_val))
 
+    def _build_infer(self):
+        import jax
+
+        from ..parallel.pipeline import pipeline_apply
+
+        run = self._stage_exec._run_graph
+        mesh = self._mesh
+        m = self._num_micro
+
+        def infer(params, data, rng):
+            def stage_fn(local_params, x, stage_idx):
+                del stage_idx
+                outs, _ = run(
+                    {**local_params, self._data_names[0]: x},
+                    {}, rng, False)
+                return outs[0]
+
+            mbs = data.reshape((m,) + self._mb_shape)
+            out = pipeline_apply(stage_fn, params, mbs, mesh, "pipe")
+            return out.reshape(data.shape)
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        repl = NamedSharding(mesh, P())
+        param_sh = jax.tree_util.tree_map(self._sharding, self.params)
+        return jax.jit(infer, in_shardings=(param_sh, repl, None))
+
     def forward(self, data_batch, is_train=None):
-        self.forward_backward(data_batch)
+        """Inference through the pipeline: NO backward, NO update, no
+        label needed (train steps go through forward_backward)."""
+        import jax
+
+        if is_train is None:
+            is_train = False
+        if is_train:
+            self.forward_backward(data_batch)
+            return
+        assert self.binded and self.params_initialized
+        if getattr(self, "_jitted_infer", None) is None:
+            self._jitted_infer = self._build_infer()
+        data = data_batch.data[0]
+        data = data._data if isinstance(data, nd.NDArray) \
+            else np.asarray(data)
+        out = self._jitted_infer(
+            self.params, data, jax.random.fold_in(self._rng, 0))
+        self._outputs = [nd.NDArray(out)]

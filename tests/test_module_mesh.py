@@ -218,6 +218,33 @@ def test_pipeline_module_matches_sequential():
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
 
 
+def test_pipeline_module_forward_is_pure_inference():
+    """forward(is_train=False) must not touch parameters or optimizer
+    state, and must work without labels."""
+    d = mx.sym.Variable("data")
+    stage = mx.sym.Activation(
+        mx.sym.FullyConnected(d, num_hidden=6, flatten=False,
+                              no_bias=True, name="fc"),
+        act_type="tanh", name="act")
+    pm = mx.mod.PipelineModule(stage, num_stages=4, num_microbatches=4,
+                               context=mx.cpu())
+    pm.bind(data_shapes=[("data", (8, 6))])
+    pm.init_params(mx.initializer.Xavier())
+    pm.init_optimizer(optimizer="sgd",
+                      optimizer_params=(("learning_rate", 0.5),))
+    rs = np.random.RandomState(0)
+    x = rs.rand(8, 6).astype("float32")
+    w_before = np.asarray(pm.params["fc_weight"]).copy()
+    t_before = pm._t
+    pm.forward(mx.io.DataBatch(data=[mx.nd.array(x)], label=None),
+               is_train=False)
+    out = pm.get_outputs()[0].asnumpy()
+    assert np.isfinite(out).all() and out.shape == (8, 6)
+    np.testing.assert_array_equal(
+        np.asarray(pm.params["fc_weight"]), w_before)
+    assert pm._t == t_before
+
+
 def test_sharding_attr_unknown_axis_ignored():
     """A __sharding__ attr referencing an axis absent from the mesh is
     dropped with a warning, not a crash."""

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Communication bandwidth benchmark (the reference tools/bandwidth/
+measure.py role, TPU-native): measures what actually bounds training —
+host->device transfer, in-jit all-reduce over the mesh (the fused data
+plane's gradient sum), and KVStore push+pull — and prints one JSON line
+per measurement.
+
+  python tools/bandwidth.py --size-mb 64 --iters 10
+  JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+      XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python tools/bandwidth.py    # 8-device CPU mesh
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _emit(metric, gbs, size_mb, extra=None):
+    rec = {"metric": metric, "value": round(gbs, 3), "unit": "GB/s",
+           "size_mb": size_mb}
+    rec.update(extra or {})
+    print(json.dumps(rec))
+    sys.stdout.flush()
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--size-mb", type=int, default=64)
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    n_elem = args.size_mb * (1 << 20) // 4
+    host = np.random.default_rng(0).random(n_elem, np.float32)
+    dev = jax.devices()[0]
+
+    def fence(x):
+        jax.block_until_ready(x)
+        np.asarray(jax.device_get(jnp.ravel(x)[0]))
+
+    # ---- host -> device
+    warm = jax.device_put(host, dev)
+    fence(warm)
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        fence(jax.device_put(host, dev))
+    dt = time.perf_counter() - t0
+    _emit("host_to_device", args.size_mb / 1024 * args.iters / dt,
+          args.size_mb, {"device": str(dev)})
+
+    # ---- device -> host
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        np.asarray(jax.device_get(warm))
+    dt = time.perf_counter() - t0
+    _emit("device_to_host", args.size_mb / 1024 * args.iters / dt,
+          args.size_mb)
+
+    # ---- all-reduce over the device mesh (the fused gradient path)
+    devs = jax.devices()
+    if len(devs) > 1:
+        mesh = Mesh(np.asarray(devs), ("data",))
+        repl = NamedSharding(mesh, P())
+        sh = NamedSharding(mesh, P("data"))
+        x = jax.device_put(host[: n_elem // len(devs) * len(devs)], sh)
+
+        @jax.jit
+        def allreduce(v):
+            # batch-sharded in, replicated out = one all-gather+sum
+            return jax.lax.with_sharding_constraint(
+                jnp.broadcast_to(jnp.sum(v), v.shape), sh)
+
+        fence(allreduce(x))
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            fence(allreduce(x))
+        dt = time.perf_counter() - t0
+        _emit("mesh_allreduce", args.size_mb / 1024 * args.iters / dt,
+              args.size_mb, {"devices": len(devs)})
+
+    # ---- kvstore push+pull round trip
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create("local" if jax.process_count() == 1 else "tpu")
+    v = mx.nd.array(host.reshape(-1, 1024))
+    kv.init("bw", v)
+    out = mx.nd.zeros(v.shape)
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        kv.push("bw", v)
+        kv.pull("bw", out=out)
+    out.asnumpy()
+    dt = time.perf_counter() - t0
+    _emit("kvstore_push_pull", 2 * args.size_mb / 1024 * args.iters / dt,
+          args.size_mb, {"kv_type": kv.type})
+
+
+if __name__ == "__main__":
+    main()

@@ -79,11 +79,16 @@ def _launch_ssh(args):
     """One worker per hostfile line (reference tools/launch.py ssh
     tracker): the coordinator runs on the first host's port; env is
     threaded through the remote shell."""
+    import random as _random
+
     hosts = _read_hostfile(args.hostfile)
     if len(hosts) < args.num_workers:
         raise SystemExit(
             f"hostfile has {len(hosts)} hosts < -n {args.num_workers}")
-    port = _free_port()
+    # the coordinator binds on hosts[0], NOT this machine — probing a
+    # local free port would be meaningless there; pick from the
+    # ephemeral range (override with --port when it collides)
+    port = args.port or _random.randint(20000, 59999)
     coord = f"{hosts[0]}:{port}"
     procs = []
     for wid in range(args.num_workers):
@@ -130,6 +135,9 @@ def main():
                     choices=["local", "ssh", "mpi", "none"])
     ap.add_argument("-H", "--hostfile", default=None,
                     help="hostfile for --launcher ssh")
+    ap.add_argument("--port", type=int, default=None,
+                    help="coordinator port (ssh launcher; default: "
+                         "random ephemeral)")
     ap.add_argument("--env", action="append", default=[],
                     help="extra KEY=VALUE for workers")
     ap.add_argument("command", nargs=argparse.REMAINDER)

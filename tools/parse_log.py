@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Summarize a training log into a table (the reference
+tools/parse_log.py role, reimplemented around this framework's log
+lines: base_module.fit's 'Epoch[N] Train-metric=..', 'Epoch[N]
+Validation-metric=..', 'Epoch[N] Time cost=..', and Speedometer's
+'Speed: X samples/sec').
+
+  python tools/parse_log.py train.log [--format markdown|csv]
+"""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from collections import defaultdict
+
+EPOCH_RES = {
+    "train": re.compile(r"Epoch\[(\d+)\] Train-([\w\-]+)=([-.\deE]+)"),
+    "val": re.compile(r"Epoch\[(\d+)\] Validation-([\w\-]+)=([-.\deE]+)"),
+    "time": re.compile(r"Epoch\[(\d+)\] Time cost=([-.\deE]+)"),
+}
+SPEED_RE = re.compile(
+    r"Epoch\[(\d+)\] Batch \[\d+\]\tSpeed: ([-.\deE]+) samples/sec")
+
+
+def parse(lines):
+    """-> (sorted epoch rows, column names). Each row: {col: value};
+    speed is the mean of the epoch's Speedometer samples."""
+    rows = defaultdict(dict)
+    speeds = defaultdict(list)
+    for line in lines:
+        m = EPOCH_RES["train"].search(line)
+        if m:
+            rows[int(m.group(1))][f"train-{m.group(2)}"] = \
+                float(m.group(3))
+            continue
+        m = EPOCH_RES["val"].search(line)
+        if m:
+            rows[int(m.group(1))][f"val-{m.group(2)}"] = \
+                float(m.group(3))
+            continue
+        m = EPOCH_RES["time"].search(line)
+        if m:
+            rows[int(m.group(1))]["time"] = float(m.group(2))
+            continue
+        m = SPEED_RE.search(line)
+        if m:
+            speeds[int(m.group(1))].append(float(m.group(2)))
+    for e, ss in speeds.items():
+        rows[e]["speed"] = sum(ss) / len(ss)
+    cols = sorted({c for r in rows.values() for c in r})
+    return [dict(r, epoch=e) for e, r in sorted(rows.items())], cols
+
+
+def render(rows, cols, fmt):
+    header = ["epoch"] + cols
+    if fmt == "csv":
+        out = [",".join(header)]
+        for r in rows:
+            out.append(",".join(
+                str(r.get(c, "")) for c in header))
+        return "\n".join(out)
+    widths = [max(len(h), 10) for h in header]
+    line = "| " + " | ".join(
+        h.ljust(w) for h, w in zip(header, widths)) + " |"
+    sep = "|" + "|".join("-" * (w + 2) for w in widths) + "|"
+    body = []
+    for r in rows:
+        cells = []
+        for h, w in zip(header, widths):
+            v = r.get(h, "")
+            cells.append((f"{v:.6g}" if isinstance(v, float)
+                          else str(v)).ljust(w))
+        body.append("| " + " | ".join(cells) + " |")
+    return "\n".join([line, sep] + body)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("logfile")
+    ap.add_argument("--format", default="markdown",
+                    choices=["markdown", "csv"])
+    args = ap.parse_args()
+    with open(args.logfile) as f:
+        rows, cols = parse(f)
+    if not rows:
+        sys.exit("no epoch lines found")
+    print(render(rows, cols, args.format))
+
+
+if __name__ == "__main__":
+    main()

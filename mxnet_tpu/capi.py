@@ -53,6 +53,56 @@ def ndarray_load(fname):
     return [], list(data)
 
 
+def ndarray_slice(a, start, stop):
+    """Axis-0 slice sharing storage (reference MXNDArraySlice,
+    include/mxnet/c_api.h — the returned handle is a view)."""
+    return a[int(start):int(stop)]
+
+
+def ndarray_at(a, idx):
+    return a[int(idx)]
+
+
+def ndarray_reshape(a, shape):
+    return a.reshape(tuple(shape))
+
+
+def ndarray_dtype(a):
+    import numpy as np
+
+    from .ndarray import _DTYPE_TO_ID
+
+    return int(_DTYPE_TO_ID[np.dtype(a.dtype)])
+
+
+def ndarray_context(a):
+    c = a.context
+    return c.device_type, int(c.device_id)
+
+
+def ndarray_wait_to_read(a):
+    a.wait_to_read()
+
+
+def ndarray_waitall():
+    nd.waitall()
+
+
+def ndarray_save_raw(a):
+    """Serialize ONE array to bytes (reference MXNDArraySaveRawBytes)."""
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".params") as tf:
+        nd.save(tf.name, [a])
+        tf.seek(0)
+        return tf.read()
+
+
+def ndarray_load_raw(raw):
+    arrs = nd.load_frombuffer(bytes(raw))
+    return arrs[0] if isinstance(arrs, list) else list(arrs.values())[0]
+
+
 # ---------------------------------------------------------- imperative
 
 def invoke(op_name, inputs, params):
@@ -126,6 +176,104 @@ def symbol_infer_shape(s, names, shapes):
         **{n: tuple(sh) for n, sh in zip(names, shapes)})
     to_l = lambda xs: [list(x) for x in xs]
     return to_l(arg_shapes), to_l(out_shapes), to_l(aux_shapes)
+
+
+def symbol_get_attr(s, key):
+    """-> attr string or None (reference MXSymbolGetAttr)."""
+    v = s.attr(key)
+    return None if v is None else str(v)
+
+
+def symbol_set_attr(s, key, value):
+    s._set_attr(**{key: value})
+
+
+def symbol_list_attr(s):
+    """Flattened [k0, v0, k1, v1, ...] over the full graph
+    (reference MXSymbolListAttr's key/value pair convention)."""
+    out = []
+    for k, v in sorted(s.attr_dict().items()):
+        if isinstance(v, dict):
+            for k2, v2 in sorted(v.items()):
+                out.extend([f"{k}${k2}", str(v2)])
+        else:
+            out.extend([k, str(v)])
+    return out
+
+
+def symbol_get_internals(s):
+    return s.get_internals()
+
+
+def symbol_get_output(s, idx):
+    return s[int(idx)]
+
+
+def symbol_get_children(s):
+    """Symbol grouping the DIRECT inputs of the head node(s)
+    (reference MXSymbolGetChildren)."""
+    from . import symbol as sym
+
+    heads = []
+    for node, _ in s._outputs:
+        heads.extend(node.inputs)
+    return sym.Symbol(heads)
+
+
+def symbol_get_name(s):
+    return s.name
+
+
+def symbol_copy(s):
+    """Independent deep copy (reference MXSymbolCopy): JSON round-trip
+    so later SetAttr on the copy cannot alias the original's nodes."""
+    from . import symbol as sym
+
+    return sym.loads(s.tojson())
+
+
+def symbol_infer_type(s, names, dtype_ids):
+    """dtype ids use the NDArray save-format codes (_DTYPE_TO_ID)."""
+    import numpy as np
+
+    from .ndarray import _DTYPE_TO_ID, _ID_TO_DTYPE
+
+    kwargs = {n: _ID_TO_DTYPE[int(d)] for n, d in zip(names, dtype_ids)}
+    arg_t, out_t, aux_t = s.infer_type(**kwargs)
+    to_ids = lambda ts: [int(_DTYPE_TO_ID[np.dtype(t)]) for t in ts]
+    return to_ids(arg_t), to_ids(out_t), to_ids(aux_t)
+
+
+# -------------------------------------------------------------- op info
+
+def list_all_op_names():
+    """All registered op names (reference MXListAllOpNames)."""
+    from .ops import registry
+
+    return sorted(registry.list_ops())
+
+
+def op_info(name):
+    """-> (description, [input arg names], [param keys]) for a
+    registered op (the reference MXSymbolGetAtomicSymbolInfo's doc
+    surface)."""
+    from .ops import registry
+
+    ops = registry.canonical_ops()
+    aliases = {a: o for o in ops.values() for a in (o.aliases or ())}
+    od = ops.get(name) or aliases.get(name)
+    if od is None:
+        raise MXNetError(f"unknown op {name!r}")
+    doc = (od.fn.__doc__ or od.name).strip()
+    params = sorted(set(od.coerce) | set(od.defaults))
+    args = list(od.arg_names or [])
+    if not args and od.arg_names_fn is not None:
+        # param-dependent inputs (e.g. Custom): best effort at defaults
+        try:
+            args = list(od.arg_names_fn(dict(od.defaults)))
+        except Exception:
+            args = []
+    return doc, args, params
 
 
 # ------------------------------------------------------------ executor
@@ -343,3 +491,100 @@ def autograd_compute_gradient(outputs):
     from . import autograd
 
     autograd.compute_gradient(list(outputs))
+
+
+# ------------------------------------------------------------- recordio
+
+def recordio_writer_create(path):
+    from . import recordio
+
+    return recordio.MXRecordIO(path, "w")
+
+
+def recordio_reader_create(path):
+    from . import recordio
+
+    return recordio.MXRecordIO(path, "r")
+
+
+def recordio_write(w, raw):
+    w.write(bytes(raw))
+
+
+def recordio_read(r):
+    """-> record bytes, or None at end of file (the C side maps None to
+    a NULL buffer — distinct from a legal 0-length record)."""
+    return r.read()
+
+
+def recordio_tell(h):
+    return int(h.tell())
+
+
+def recordio_seek(r, pos):
+    """Byte-offset seek (reference MXRecordIOReaderSeek)."""
+    r.reset()
+    if pos:
+        r.handle.seek(int(pos))
+
+
+def recordio_close(h):
+    h.close()
+
+
+# ------------------------------------------------------------- profiler
+
+def profiler_set_config(mode, filename):
+    from . import profiler
+
+    profiler.profiler_set_config(
+        mode={0: "symbolic", 1: "all"}.get(int(mode), "symbolic")
+        if str(mode).isdigit() else str(mode),
+        filename=filename,
+    )
+
+
+def profiler_set_state(state):
+    from . import profiler
+
+    profiler.profiler_set_state(
+        {0: "stop", 1: "run"}.get(int(state), "stop"))
+
+
+def profiler_dump():
+    from . import profiler
+
+    profiler.dump_profile()
+
+
+# -------------------------------------------------------------- runtime
+
+def random_seed(seed):
+    from . import random as rnd
+
+    rnd.seed(int(seed))
+
+
+def notify_shutdown():
+    """Drain outstanding work before teardown (reference
+    MXNotifyShutdown's engine-notify role)."""
+    nd.waitall()
+
+
+def init_ps_env(keys, vals):
+    """Stage distributed-bootstrap env vars (reference MXInitPSEnv,
+    which forwards DMLC_* vars into ps-lite)."""
+    import os
+
+    for k, v in zip(keys, vals):
+        os.environ[str(k)] = str(v)
+
+
+def kvstore_role():
+    """-> "worker" | "server" | "scheduler" from the launch env (the
+    reference derives node role from DMLC_ROLE; our coordination-service
+    backend has no separate server/scheduler processes, so worker is the
+    default)."""
+    import os
+
+    return os.environ.get("DMLC_ROLE", "worker")

@@ -330,6 +330,141 @@ int MXTpuNDArrayLoad(const char* fname, int* num_out, void*** out,
   return 0;
 }
 
+// helper: call shim fn(handle) and return the NEW handle it produces
+static int HandleUnary(const char* fn, void* h, void** out) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  Py_INCREF(static_cast<PyObject*>(h));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(h));
+  PyObject* r = CallShim(fn, args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+// helper: call shim fn(handle) for side effect only
+static int HandleUnaryVoid(const char* fn, void* h) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  Py_INCREF(static_cast<PyObject*>(h));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(h));
+  PyObject* r = CallShim(fn, args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuNDArraySlice(void* h, int start, int stop, void** out) {
+  Gil gil;
+  PyObject* args = PyTuple_New(3);
+  Py_INCREF(static_cast<PyObject*>(h));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(h));
+  PyTuple_SET_ITEM(args, 1, PyLong_FromLong(start));
+  PyTuple_SET_ITEM(args, 2, PyLong_FromLong(stop));
+  PyObject* r = CallShim("ndarray_slice", args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+int MXTpuNDArrayAt(void* h, int idx, void** out) {
+  Gil gil;
+  PyObject* args = PyTuple_New(2);
+  Py_INCREF(static_cast<PyObject*>(h));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(h));
+  PyTuple_SET_ITEM(args, 1, PyLong_FromLong(idx));
+  PyObject* r = CallShim("ndarray_at", args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+int MXTpuNDArrayReshape(void* h, int ndim, const int* dims, void** out) {
+  Gil gil;
+  PyObject* args = PyTuple_New(2);
+  Py_INCREF(static_cast<PyObject*>(h));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(h));
+  PyTuple_SET_ITEM(args, 1, IntList(dims, ndim));
+  PyObject* r = CallShim("ndarray_reshape", args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+int MXTpuNDArrayGetDType(void* h, int* dtype) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  Py_INCREF(static_cast<PyObject*>(h));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(h));
+  PyObject* r = CallShim("ndarray_dtype", args);
+  if (r == nullptr) return -1;
+  *dtype = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuNDArrayGetContext(void* h, const char** dev_type, int* dev_id) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  Py_INCREF(static_cast<PyObject*>(h));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(h));
+  PyObject* r = CallShim("ndarray_context", args);
+  if (r == nullptr) return -1;
+  const char* s = PyUnicode_AsUTF8(PyTuple_GET_ITEM(r, 0));
+  tls_strs.clear();
+  tls_strs.emplace_back(s ? s : "");
+  *dev_type = tls_strs.back().c_str();
+  *dev_id = static_cast<int>(PyLong_AsLong(PyTuple_GET_ITEM(r, 1)));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuNDArrayWaitToRead(void* h) {
+  return HandleUnaryVoid("ndarray_wait_to_read", h);
+}
+
+int MXTpuNDArrayWaitAll(void) {
+  Gil gil;
+  PyObject* r = CallShim("ndarray_waitall", nullptr);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+static thread_local std::string tls_bytes;
+
+int MXTpuNDArraySaveRawBytes(void* h, const char** buf, long* size) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  Py_INCREF(static_cast<PyObject*>(h));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(h));
+  PyObject* r = CallShim("ndarray_save_raw", args);
+  if (r == nullptr) return -1;
+  char* data = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(r, &data, &n) != 0) {
+    SetError("ndarray_save_raw");
+    Py_DECREF(r);
+    return -1;
+  }
+  tls_bytes.assign(data, static_cast<size_t>(n));
+  *buf = tls_bytes.data();
+  *size = static_cast<long>(n);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuNDArrayLoadFromRawBytes(const void* buf, long size, void** out) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  PyTuple_SET_ITEM(args, 0, PyBytes_FromStringAndSize(
+      static_cast<const char*>(buf), size));
+  PyObject* r = CallShim("ndarray_load_raw", args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
 // -------------------------------------------------- imperative invoke
 
 // New-output form: results become TLS handles (valid until this
@@ -476,6 +611,341 @@ int MXTpuSymbolInferShape(void* sym, int num_in, const char** names,
   *arg_data = tls_shape_data.data();
   Py_DECREF(r);
   return 0;
+}
+
+int MXTpuSymbolGetAttr(void* sym, const char* key, const char** out,
+                       int* success) {
+  Gil gil;
+  PyObject* args = PyTuple_New(2);
+  Py_INCREF(static_cast<PyObject*>(sym));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(sym));
+  PyTuple_SET_ITEM(args, 1, Str(key));
+  PyObject* r = CallShim("symbol_get_attr", args);
+  if (r == nullptr) return -1;
+  if (r == Py_None) {
+    *success = 0;
+    *out = "";
+  } else {
+    *success = 1;
+    const char* s = PyUnicode_AsUTF8(r);
+    tls_strs.clear();
+    tls_strs.emplace_back(s ? s : "");
+    *out = tls_strs.back().c_str();
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuSymbolSetAttr(void* sym, const char* key, const char* value) {
+  Gil gil;
+  PyObject* args = PyTuple_New(3);
+  Py_INCREF(static_cast<PyObject*>(sym));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(sym));
+  PyTuple_SET_ITEM(args, 1, Str(key));
+  PyTuple_SET_ITEM(args, 2, Str(value));
+  PyObject* r = CallShim("symbol_set_attr", args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuSymbolListAttr(void* sym, int* num, const char*** out) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  Py_INCREF(static_cast<PyObject*>(sym));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(sym));
+  PyObject* r = CallShim("symbol_list_attr", args);
+  if (r == nullptr) return -1;
+  int n_flat = 0;
+  StashStrList(r, &n_flat, out);
+  *num = n_flat / 2;  // pair count, reference ListAttr convention
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuSymbolGetInternals(void* sym, void** out) {
+  return HandleUnary("symbol_get_internals", sym, out);
+}
+
+int MXTpuSymbolGetOutput(void* sym, int index, void** out) {
+  Gil gil;
+  PyObject* args = PyTuple_New(2);
+  Py_INCREF(static_cast<PyObject*>(sym));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(sym));
+  PyTuple_SET_ITEM(args, 1, PyLong_FromLong(index));
+  PyObject* r = CallShim("symbol_get_output", args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+int MXTpuSymbolGetChildren(void* sym, void** out) {
+  return HandleUnary("symbol_get_children", sym, out);
+}
+
+int MXTpuSymbolGetName(void* sym, const char** out, int* success) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  Py_INCREF(static_cast<PyObject*>(sym));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(sym));
+  PyObject* r = CallShim("symbol_get_name", args);
+  if (r == nullptr) return -1;
+  if (r == Py_None) {
+    *success = 0;
+    *out = "";
+  } else {
+    *success = 1;
+    const char* s = PyUnicode_AsUTF8(r);
+    tls_strs.clear();
+    tls_strs.emplace_back(s ? s : "");
+    *out = tls_strs.back().c_str();
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuSymbolCopy(void* sym, void** out) {
+  return HandleUnary("symbol_copy", sym, out);
+}
+
+int MXTpuSymbolInferType(void* sym, int num_in, const char** names,
+                         const int* dtypes, int* num_arg,
+                         const int** arg_dtypes) {
+  Gil gil;
+  PyObject* args = PyTuple_New(3);
+  Py_INCREF(static_cast<PyObject*>(sym));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(sym));
+  PyTuple_SET_ITEM(args, 1, StrList(names, num_in));
+  PyTuple_SET_ITEM(args, 2, IntList(dtypes, num_in));
+  PyObject* r = CallShim("symbol_infer_type", args);
+  if (r == nullptr) return -1;
+  PyObject* arg_t = PyTuple_GET_ITEM(r, 0);
+  tls_shape_data.clear();
+  Py_ssize_t n = PyList_Size(arg_t);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    tls_shape_data.push_back(static_cast<int>(
+        PyLong_AsLong(PyList_GET_ITEM(arg_t, i))));
+  *num_arg = static_cast<int>(n);
+  *arg_dtypes = tls_shape_data.data();
+  Py_DECREF(r);
+  return 0;
+}
+
+// -------------------------------------------------------------- op info
+
+int MXTpuListAllOpNames(int* num, const char*** names) {
+  Gil gil;
+  PyObject* r = CallShim("list_all_op_names", nullptr);
+  if (r == nullptr) return -1;
+  StashStrList(r, num, names);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuOpGetInfo(const char* op, const char** description,
+                   int* num_args, const char*** arg_names,
+                   int* num_params, const char*** param_keys) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  PyTuple_SET_ITEM(args, 0, Str(op));
+  PyObject* r = CallShim("op_info", args);
+  if (r == nullptr) return -1;
+  // Pack desc + args + params into ONE TLS string table:
+  // [desc, arg0..argN, param0..paramM]
+  PyObject* desc = PyTuple_GET_ITEM(r, 0);
+  PyObject* arg_l = PyTuple_GET_ITEM(r, 1);
+  PyObject* par_l = PyTuple_GET_ITEM(r, 2);
+  tls_strs.clear();
+  tls_strps.clear();
+  const char* d = PyUnicode_AsUTF8(desc);
+  tls_strs.emplace_back(d ? d : "");
+  Py_ssize_t na = PyList_Size(arg_l), np = PyList_Size(par_l);
+  for (Py_ssize_t i = 0; i < na; ++i) {
+    const char* s = PyUnicode_AsUTF8(PyList_GET_ITEM(arg_l, i));
+    tls_strs.emplace_back(s ? s : "");
+  }
+  for (Py_ssize_t i = 0; i < np; ++i) {
+    const char* s = PyUnicode_AsUTF8(PyList_GET_ITEM(par_l, i));
+    tls_strs.emplace_back(s ? s : "");
+  }
+  for (auto& s : tls_strs) tls_strps.push_back(s.c_str());
+  *description = tls_strps[0];
+  *num_args = static_cast<int>(na);
+  *arg_names = tls_strps.data() + 1;
+  *num_params = static_cast<int>(np);
+  *param_keys = tls_strps.data() + 1 + na;
+  Py_DECREF(r);
+  return 0;
+}
+
+// ------------------------------------------------------------ RecordIO
+
+static int PathCreate(const char* fn, const char* path, void** out) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  PyTuple_SET_ITEM(args, 0, Str(path));
+  PyObject* r = CallShim(fn, args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+int MXTpuRecordIOWriterCreate(const char* path, void** out) {
+  return PathCreate("recordio_writer_create", path, out);
+}
+
+int MXTpuRecordIOReaderCreate(const char* path, void** out) {
+  return PathCreate("recordio_reader_create", path, out);
+}
+
+int MXTpuRecordIOWriterWriteRecord(void* h, const char* buf, long size) {
+  Gil gil;
+  PyObject* args = PyTuple_New(2);
+  Py_INCREF(static_cast<PyObject*>(h));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(h));
+  PyTuple_SET_ITEM(args, 1, PyBytes_FromStringAndSize(buf, size));
+  PyObject* r = CallShim("recordio_write", args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuRecordIOWriterTell(void* h, long* pos) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  Py_INCREF(static_cast<PyObject*>(h));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(h));
+  PyObject* r = CallShim("recordio_tell", args);
+  if (r == nullptr) return -1;
+  *pos = PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuRecordIOReaderReadRecord(void* h, const char** buf, long* size) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  Py_INCREF(static_cast<PyObject*>(h));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(h));
+  PyObject* r = CallShim("recordio_read", args);
+  if (r == nullptr) return -1;
+  if (r == Py_None) {
+    // end of file: NULL buf (a zero SIZE alone is a legal empty record)
+    *buf = nullptr;
+    *size = 0;
+  } else {
+    char* data = nullptr;
+    Py_ssize_t n = 0;
+    if (PyBytes_AsStringAndSize(r, &data, &n) != 0) {
+      SetError("recordio_read");
+      Py_DECREF(r);
+      return -1;
+    }
+    tls_bytes.assign(data, static_cast<size_t>(n));
+    *buf = tls_bytes.data();
+    *size = static_cast<long>(n);
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuRecordIOReaderSeek(void* h, long pos) {
+  Gil gil;
+  PyObject* args = PyTuple_New(2);
+  Py_INCREF(static_cast<PyObject*>(h));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(h));
+  PyTuple_SET_ITEM(args, 1, PyLong_FromLong(pos));
+  PyObject* r = CallShim("recordio_seek", args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuRecordIOWriterFree(void* h) {
+  if (HandleUnaryVoid("recordio_close", h) != 0) return -1;
+  return MXTpuHandleFree(h);
+}
+
+int MXTpuRecordIOReaderFree(void* h) {
+  return MXTpuRecordIOWriterFree(h);
+}
+
+// ------------------------------------------------------------ profiler
+
+int MXTpuSetProfilerConfig(int mode, const char* filename) {
+  Gil gil;
+  PyObject* args = PyTuple_New(2);
+  PyTuple_SET_ITEM(args, 0, PyLong_FromLong(mode));
+  PyTuple_SET_ITEM(args, 1, Str(filename));
+  PyObject* r = CallShim("profiler_set_config", args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuSetProfilerState(int state) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  PyTuple_SET_ITEM(args, 0, PyLong_FromLong(state));
+  PyObject* r = CallShim("profiler_set_state", args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuDumpProfile(void) {
+  Gil gil;
+  PyObject* r = CallShim("profiler_dump", nullptr);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// ------------------------------------------------------------- runtime
+
+int MXTpuRandomSeed(int seed) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  PyTuple_SET_ITEM(args, 0, PyLong_FromLong(seed));
+  PyObject* r = CallShim("random_seed", args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuNotifyShutdown(void) {
+  Gil gil;
+  PyObject* r = CallShim("notify_shutdown", nullptr);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuInitPSEnv(int num, const char** keys, const char** vals) {
+  Gil gil;
+  PyObject* args = PyTuple_New(2);
+  PyTuple_SET_ITEM(args, 0, StrList(keys, num));
+  PyTuple_SET_ITEM(args, 1, StrList(vals, num));
+  PyObject* r = CallShim("init_ps_env", args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+static int RoleIs(const char* role, int* out) {
+  Gil gil;
+  PyObject* r = CallShim("kvstore_role", nullptr);
+  if (r == nullptr) return -1;
+  const char* s = PyUnicode_AsUTF8(r);
+  *out = (s != nullptr && strcmp(s, role) == 0) ? 1 : 0;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuKVStoreIsWorkerNode(int* out) { return RoleIs("worker", out); }
+int MXTpuKVStoreIsServerNode(int* out) { return RoleIs("server", out); }
+int MXTpuKVStoreIsSchedulerNode(int* out) {
+  return RoleIs("scheduler", out);
 }
 
 // ----------------------------------------------------------- Executor

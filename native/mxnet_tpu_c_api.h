@@ -116,6 +116,81 @@ int MXTpuAutogradMarkVariables(int num, void** var_handles,
                                void** grad_handles);
 int MXTpuAutogradComputeGradient(int num, void** output_handles);
 
+/* ---- NDArray views / introspection (reference c_api.h MXNDArraySlice,
+ * MXNDArrayAt, MXNDArrayReshape, MXNDArrayGetDType, MXNDArrayGetContext,
+ * MXNDArrayWaitToRead, MXNDArrayWaitAll, MXNDArraySaveRawBytes,
+ * MXNDArrayLoadFromRawBytes) ---- */
+int MXTpuNDArraySlice(void* handle, int start, int stop, void** out);
+int MXTpuNDArrayAt(void* handle, int idx, void** out);
+int MXTpuNDArrayReshape(void* handle, int ndim, const int* dims,
+                        void** out);
+int MXTpuNDArrayGetDType(void* handle, int* dtype);
+int MXTpuNDArrayGetContext(void* handle, const char** dev_type,
+                           int* dev_id);
+int MXTpuNDArrayWaitToRead(void* handle);
+int MXTpuNDArrayWaitAll(void);
+/* Serialized single-array blob; buffer lives in per-thread storage. */
+int MXTpuNDArraySaveRawBytes(void* handle, const char** buf,
+                             long* size);
+int MXTpuNDArrayLoadFromRawBytes(const void* buf, long size, void** out);
+
+/* ---- Symbol attributes / structure (reference c_api.h MXSymbolGetAttr,
+ * MXSymbolSetAttr, MXSymbolListAttr, MXSymbolGetInternals,
+ * MXSymbolGetOutput, MXSymbolGetChildren, MXSymbolGetName, MXSymbolCopy,
+ * MXSymbolInferType) ---- */
+int MXTpuSymbolGetAttr(void* sym, const char* key, const char** out,
+                       int* success);
+int MXTpuSymbolSetAttr(void* sym, const char* key, const char* value);
+/* out = flattened [k0, v0, k1, v1, ...]; num = pair count. */
+int MXTpuSymbolListAttr(void* sym, int* num, const char*** out);
+int MXTpuSymbolGetInternals(void* sym, void** out);
+int MXTpuSymbolGetOutput(void* sym, int index, void** out);
+int MXTpuSymbolGetChildren(void* sym, void** out);
+int MXTpuSymbolGetName(void* sym, const char** out, int* success);
+int MXTpuSymbolCopy(void* sym, void** out);
+/* dtype codes follow the NDArray save format (0=f32 1=f64 2=f16 ...). */
+int MXTpuSymbolInferType(void* sym, int num_in, const char** names,
+                         const int* dtypes, int* num_arg,
+                         const int** arg_dtypes);
+
+/* ---- Op listing / docs (reference MXListAllOpNames,
+ * MXSymbolGetAtomicSymbolInfo) ---- */
+int MXTpuListAllOpNames(int* num, const char*** names);
+/* description + input names + param keys for one op; all outputs live
+ * in per-thread storage. */
+int MXTpuOpGetInfo(const char* op, const char** description,
+                   int* num_args, const char*** arg_names,
+                   int* num_params, const char*** param_keys);
+
+/* ---- RecordIO (reference c_api.h MXRecordIO*) ---- */
+int MXTpuRecordIOWriterCreate(const char* path, void** out);
+int MXTpuRecordIOWriterWriteRecord(void* handle, const char* buf,
+                                   long size);
+int MXTpuRecordIOWriterTell(void* handle, long* pos);
+int MXTpuRecordIOWriterFree(void* handle);
+int MXTpuRecordIOReaderCreate(const char* path, void** out);
+/* *buf = NULL at end of file (a 0-length record keeps *buf non-NULL);
+ * record bytes live in per-thread storage. */
+int MXTpuRecordIOReaderReadRecord(void* handle, const char** buf,
+                                  long* size);
+int MXTpuRecordIOReaderSeek(void* handle, long pos);
+int MXTpuRecordIOReaderFree(void* handle);
+
+/* ---- Profiler (reference MXSetProfilerConfig/State, MXDumpProfile) */
+int MXTpuSetProfilerConfig(int mode /* 0=symbolic 1=all */,
+                           const char* filename);
+int MXTpuSetProfilerState(int state /* 0=stop 1=run */);
+int MXTpuDumpProfile(void);
+
+/* ---- runtime (reference MXRandomSeed, MXNotifyShutdown, MXInitPSEnv,
+ * MXKVStoreIsWorkerNode/IsServerNode/IsSchedulerNode) ---- */
+int MXTpuRandomSeed(int seed);
+int MXTpuNotifyShutdown(void);
+int MXTpuInitPSEnv(int num, const char** keys, const char** vals);
+int MXTpuKVStoreIsWorkerNode(int* out);
+int MXTpuKVStoreIsServerNode(int* out);
+int MXTpuKVStoreIsSchedulerNode(int* out);
+
 /* ---- predict-only ABI (capi_predict.cc) ---- */
 int MXTpuPredCreate(const char* symbol_json, const void* param_bytes,
                     int param_size, int num_input,

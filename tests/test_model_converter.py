@@ -1,0 +1,104 @@
+"""tools/model_converter.py: torch state_dict -> mxnet_tpu checkpoint
+(the reference tools/caffe_converter's import-a-pretrained-model role).
+End-to-end: a torch CNN's logits must match our executor's after
+conversion, in both NCHW and NHWC weight layouts."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import mxnet_tpu as mx
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _TorchNet(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = torch.nn.Conv2d(3, 8, 3, padding=1)
+        self.bn1 = torch.nn.BatchNorm2d(8)
+        self.fc = torch.nn.Linear(8 * 8 * 8, 10)
+
+    def forward(self, x):
+        x = torch.relu(self.bn1(self.conv1(x)))
+        x = torch.nn.functional.max_pool2d(x, 2)
+        return self.fc(x.flatten(1))
+
+
+def _our_symbol(layout):
+    s = mx.sym.Variable("data")
+    s = mx.sym.Convolution(s, name="conv1", num_filter=8, kernel=(3, 3),
+                           pad=(1, 1), layout=layout)
+    s = mx.sym.BatchNorm(s, name="bn1", fix_gamma=False, eps=1e-5,
+                         use_global_stats=True,
+                         axis=3 if layout == "NHWC" else 1)
+    s = mx.sym.Activation(s, act_type="relu")
+    s = mx.sym.Pooling(s, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                       layout=layout)
+    if layout == "NHWC":
+        # match torch's NCHW flatten order before the dense layer
+        s = mx.sym.transpose(s, axes=(0, 3, 1, 2))
+    s = mx.sym.Flatten(s)
+    return mx.sym.FullyConnected(s, name="fc", num_hidden=10)
+
+
+@pytest.mark.parametrize("layout", ["NCHW", "NHWC"])
+def test_torch_convert_forward_match(tmp_path, layout):
+    tnet = _TorchNet().eval()
+    # exercise non-trivial running stats
+    with torch.no_grad():
+        tnet.bn1.running_mean.uniform_(-0.5, 0.5)
+        tnet.bn1.running_var.uniform_(0.5, 1.5)
+    sd_path = str(tmp_path / "net.pt")
+    torch.save(tnet.state_dict(), sd_path)
+
+    prefix = str(tmp_path / "converted")
+    subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/model_converter.py"),
+         sd_path, prefix, "--layout", layout],
+        check=True, env=dict(os.environ, JAX_PLATFORMS="cpu"))
+
+    params = mx.nd.load(prefix + "-0000.params")
+    arg_params = {k.split(":", 1)[1]: v for k, v in params.items()
+                  if k.startswith("arg:")}
+    aux_params = {k.split(":", 1)[1]: v for k, v in params.items()
+                  if k.startswith("aux:")}
+    assert "bn1_gamma" in arg_params and "bn1_moving_var" in aux_params
+
+    x = np.random.RandomState(0).randn(2, 3, 16, 16).astype(np.float32)
+    with torch.no_grad():
+        want = tnet(torch.from_numpy(x)).numpy()
+
+    net = _our_symbol(layout)
+    feed = x if layout == "NCHW" else x.transpose(0, 2, 3, 1)
+    ex = net.simple_bind(ctx=mx.cpu(), grad_req="null",
+                         data=feed.shape)
+    ex.copy_params_from(arg_params, aux_params)
+    ex.arg_dict["data"][:] = feed
+    got = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_convert_name_rules():
+    from tools.model_converter import convert_state_dict
+
+    state = {
+        "layer1.0.conv1.weight": np.zeros((4, 3, 3, 3), np.float32),
+        "layer1.0.bn1.weight": np.zeros((4,), np.float32),
+        "layer1.0.bn1.bias": np.zeros((4,), np.float32),
+        "layer1.0.bn1.running_mean": np.zeros((4,), np.float32),
+        "layer1.0.bn1.running_var": np.ones((4,), np.float32),
+        "layer1.0.bn1.num_batches_tracked": np.zeros((), np.int64),
+    }
+    args, auxs = convert_state_dict(
+        state, rules=[(r"^layer1_0", "stage1_unit1")], layout="NHWC")
+    assert set(args) == {"stage1_unit1_conv1_weight",
+                         "stage1_unit1_bn1_gamma",
+                         "stage1_unit1_bn1_beta"}
+    assert set(auxs) == {"stage1_unit1_bn1_moving_mean",
+                         "stage1_unit1_bn1_moving_var"}
+    assert args["stage1_unit1_conv1_weight"].shape == (4, 3, 3, 3)

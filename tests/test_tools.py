@@ -64,3 +64,48 @@ def test_io_bench_tool_emits_json():
     assert len(recs) == 2
     assert all(r["metric"] == "image_record_decode" and r["value"] > 0
                for r in recs)
+
+
+# ---------------------------------------------------------- launchers
+
+
+class _LaunchArgs:
+    num_workers = 3
+    env = ["FOO=bar baz"]
+    command = ["python", "train.py", "--lr", "0.1"]
+    port = 12345
+    hostfile = None
+
+
+def test_sge_script_shape():
+    import launch
+
+    script = launch._sge_script(_LaunchArgs(), 12345, "/shared/rdv")
+    assert "#$ -t 1-3" in script
+    assert "WID=$((SGE_TASK_ID-1))" in script
+    assert 'MXNET_TPU_COORDINATOR="$(cat /shared/rdv):12345"' in script
+    assert "export MXNET_TPU_NUM_WORKERS=3" in script
+    assert "export FOO='bar baz'" in script
+    assert script.rstrip().endswith("exec python train.py --lr 0.1")
+
+
+def test_yarn_command_quoting():
+    import shlex
+
+    import launch
+
+    cmd = launch._yarn_command(_LaunchArgs(), 12345, "/shared/rdv")
+    assert cmd[:2] == ["yarn", "jar"]
+    assert "$HADOOP_HOME" not in cmd[2]  # env expanded, not literal
+    assert cmd[cmd.index("-num_containers") + 1] == "3"
+    shell = cmd[cmd.index("-shell_command") + 1]
+    assert shell.startswith("bash -c ")
+    # the script must survive one level of shell evaluation intact:
+    # after the container shell splits `bash -c <quoted>`, the payload
+    # still contains the UNEXPANDED claim loop and rendezvous read
+    payload = shlex.split(shell[len("bash -c "):])[0] if shell[
+        len("bash -c ")] in "'\"" else shell[len("bash -c "):]
+    inner = shlex.split("bash -c " + shlex.quote(payload))
+    assert "mkdir /shared/rdv.claim.$i" in payload
+    assert '$(cat /shared/rdv):12345' in payload
+    assert inner  # quoting round-trips

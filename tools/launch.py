@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import shlex
 import socket
 import subprocess
 import sys
@@ -94,10 +95,9 @@ def _launch_ssh(args):
     for wid in range(args.num_workers):
         pairs = _worker_env_args(coord, args.num_workers, wid, args.env)
         exports = " ".join(
-            f"{k}={subprocess.list2cmdline([v])}"
-            for k, v in pairs.items())
+            f"{k}={shlex.quote(v)}" for k, v in pairs.items())
         remote = f"cd {os.getcwd()} && env {exports} " + \
-            subprocess.list2cmdline(args.command)
+            shlex.join(args.command)
         procs.append(subprocess.Popen(
             ["ssh", "-o", "StrictHostKeyChecking=no", hosts[wid],
              remote]))
@@ -128,16 +128,128 @@ def _launch_mpi(args):
     return subprocess.call(cmd + args.command, env=env)
 
 
+def _rendezvous_preamble(rdv_path, port, num_workers, wid_expr, extra):
+    """Shell fragment implementing shared-filesystem rendezvous: worker 0
+    publishes its host; the rest poll for it. Batch schedulers (SGE,
+    YARN) place tasks on hosts unknown at submit time, so the
+    coordinator address cannot be baked in the way the ssh launcher
+    does — the cluster's shared filesystem is the discovery channel
+    (the role the reference's dmlc tracker played over TCP)."""
+    exports = "".join(
+        f"export {kv.partition('=')[0]}="
+        f"{shlex.quote(kv.partition('=')[2])}\n"
+        for kv in extra)
+    return f"""WID={wid_expr}
+if [ "$WID" -eq 0 ]; then hostname -f > {rdv_path}.tmp && \
+mv {rdv_path}.tmp {rdv_path}; fi
+tries=0
+while [ ! -s {rdv_path} ]; do
+  sleep 1
+  tries=$((tries+1))
+  if [ "$tries" -gt 300 ]; then echo "rendezvous timeout" >&2; exit 1; fi
+done
+export MXNET_TPU_COORDINATOR="$(cat {rdv_path}):{port}"
+export MXNET_TPU_NUM_WORKERS={num_workers}
+export MXNET_TPU_WORKER_ID=$WID
+{exports}"""
+
+
+def _sge_script(args, port, rdv_path):
+    """qsub array-job script: task i is worker i-1 (reference sge
+    tracker role, tools/launch.py:49-52). Requires -cwd on a shared
+    filesystem (the SGE norm)."""
+    body = _rendezvous_preamble(
+        rdv_path, port, args.num_workers, "$((SGE_TASK_ID-1))",
+        args.env)
+    return f"""#!/bin/bash
+#$ -S /bin/bash
+#$ -cwd
+#$ -V
+#$ -t 1-{args.num_workers}
+#$ -N mxtpu-launch
+{body}exec {shlex.join(args.command)}
+"""
+
+
+def _launch_sge(args):
+    import random as _random
+    import tempfile
+
+    port = args.port or _random.randint(20000, 59999)
+    rdv = os.path.abspath(f".mxtpu_rdv_{os.getpid()}")
+    if os.path.exists(rdv):
+        os.remove(rdv)
+    script = _sge_script(args, port, rdv)
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".sh", dir=".", delete=False) as tf:
+        tf.write(script)
+        path = tf.name
+    try:
+        # -sync y blocks until the array job finishes, so launch.py
+        # keeps the reference's wait-for-completion contract
+        return subprocess.call(["qsub", "-sync", "y", path])
+    finally:
+        import glob
+
+        for f in [path] + glob.glob(rdv + "*"):
+            if os.path.exists(f):
+                os.remove(f)
+
+
+def _yarn_command(args, port, rdv_path):
+    """YARN distributed-shell invocation (reference yarn tracker role).
+    Containers rendezvous through the same shared-filesystem protocol;
+    worker ids are claimed atomically with mkdir (container ordinals
+    are not dense across YARN attempts)."""
+    claim = f"""i=0
+while ! mkdir {rdv_path}.claim.$i 2>/dev/null; do
+  i=$((i+1))
+  if [ "$i" -ge {args.num_workers} ]; then echo claim-fail >&2; exit 1; fi
+done
+"""
+    body = claim + _rendezvous_preamble(
+        rdv_path, port, args.num_workers, "$i", args.env)
+    shell = body + "exec " + shlex.join(args.command)
+    jar = os.environ.get("YARN_DSHELL_JAR") or os.path.join(
+        os.environ.get("HADOOP_HOME", "/usr/lib/hadoop"),
+        "share/hadoop/yarn",
+        "hadoop-yarn-applications-distributedshell.jar")
+    # POSIX quoting: the container shell must NOT expand $i/$((..))/
+    # $(cat ..) before the inner bash runs (list2cmdline would
+    # double-quote, losing exactly that)
+    return ["yarn", "jar", jar,
+            "-jar", jar,
+            "-num_containers", str(args.num_workers),
+            "-shell_command", "bash -c " + shlex.quote(shell)]
+
+
+def _launch_yarn(args):
+    import glob
+    import random as _random
+    import shutil
+
+    port = args.port or _random.randint(20000, 59999)
+    rdv = os.path.abspath(f".mxtpu_rdv_{os.getpid()}")
+    if os.path.exists(rdv):
+        os.remove(rdv)
+    try:
+        return subprocess.call(_yarn_command(args, port, rdv))
+    finally:
+        for f in glob.glob(rdv + "*"):
+            (shutil.rmtree if os.path.isdir(f) else os.remove)(f)
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("-n", "--num-workers", type=int, required=True)
     ap.add_argument("--launcher", default="local",
-                    choices=["local", "ssh", "mpi", "none"])
+                    choices=["local", "ssh", "mpi", "sge", "yarn",
+                             "none"])
     ap.add_argument("-H", "--hostfile", default=None,
                     help="hostfile for --launcher ssh")
     ap.add_argument("--port", type=int, default=None,
-                    help="coordinator port (ssh launcher; default: "
-                         "random ephemeral)")
+                    help="coordinator port (ssh/sge/yarn launchers; "
+                         "default: random ephemeral)")
     ap.add_argument("--env", action="append", default=[],
                     help="extra KEY=VALUE for workers")
     ap.add_argument("command", nargs=argparse.REMAINDER)
@@ -153,6 +265,10 @@ def main():
         sys.exit(_launch_ssh(args))
     if args.launcher == "mpi":
         sys.exit(_launch_mpi(args))
+    if args.launcher == "sge":
+        sys.exit(_launch_sge(args))
+    if args.launcher == "yarn":
+        sys.exit(_launch_yarn(args))
     sys.exit(_launch_local(args))
 
 

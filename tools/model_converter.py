@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Convert a torch state_dict into an mxnet_tpu checkpoint.
+
+The reference ships `tools/caffe_converter/` to import pretrained models
+from another framework; the modern equivalent here imports torch
+(torch-cpu is a peer dependency of this image) state_dicts. The
+conversion handles:
+
+  - name mapping: explicit regex rules (``--map 'pat=repl'``, applied in
+    order) plus built-in defaults (``a.b.weight`` -> ``a_b_weight``,
+    BatchNorm's weight/bias/running_mean/running_var ->
+    gamma/beta/moving_mean/moving_var)
+  - parameter splitting: moving stats become aux_params, everything
+    else arg_params (the reference checkpoint's arg:/aux: tags,
+    python/mxnet/model.py save_checkpoint)
+  - conv-weight layout: torch convs are OIHW; ``--layout NHWC`` emits
+    OHWI for channels-last graphs (ops/nn.py Convolution weight
+    convention)
+
+Usage:
+  python tools/model_converter.py model.pt out_prefix \\
+      [--symbol net.json] [--layout NHWC] [--map 'downsample=sc'] ...
+
+Emits ``out_prefix-0000.params`` (+ ``out_prefix-symbol.json`` when
+--symbol is given) loadable with ``mxnet_tpu.model.load_checkpoint``.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_BN_TAILS = {
+    "running_mean": ("aux", "moving_mean"),
+    "running_var": ("aux", "moving_var"),
+    "num_batches_tracked": (None, None),  # dropped: no analog
+}
+
+
+def convert_name(torch_name, bn_param_names):
+    """-> (kind, our_name) where kind is 'arg' | 'aux' | None (drop)."""
+    head, _, tail = torch_name.rpartition(".")
+    if tail in _BN_TAILS:
+        kind, newtail = _BN_TAILS[tail]
+        if kind is None:
+            return None, None
+        return kind, (head.replace(".", "_") + "_" + newtail)
+    if head in bn_param_names and tail in ("weight", "bias"):
+        newtail = "gamma" if tail == "weight" else "beta"
+        return "arg", head.replace(".", "_") + "_" + newtail
+    return "arg", torch_name.replace(".", "_")
+
+
+def convert_state_dict(state, rules=(), layout="NCHW"):
+    """state: {torch_name: numpy array}. Returns (arg_params,
+    aux_params) as numpy dicts with mapped names/layouts."""
+    import numpy as np
+
+    # a module with running stats is a norm layer: its weight/bias are
+    # gamma/beta, not `<name>_weight`
+    bn_modules = {
+        k.rpartition(".")[0]
+        for k in state if k.endswith(("running_mean", "running_var"))
+    }
+    args, auxs = {}, {}
+    for tname, tensor in state.items():
+        arr = np.asarray(tensor)
+        kind, name = convert_name(tname, bn_modules)
+        if kind is None:
+            continue
+        for pat, repl in rules:
+            name = re.sub(pat, repl, name)
+        if layout.upper() == "NHWC" and arr.ndim == 4 \
+                and name.endswith("_weight"):
+            arr = arr.transpose(0, 2, 3, 1)  # OIHW -> OHWI
+        (args if kind == "arg" else auxs)[name] = arr
+    return args, auxs
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("state_dict", help="torch .pt/.pth state_dict file")
+    ap.add_argument("prefix", help="output checkpoint prefix")
+    ap.add_argument("--symbol", default=None,
+                    help="symbol JSON to save beside the params")
+    ap.add_argument("--layout", default="NCHW",
+                    choices=["NCHW", "NHWC"])
+    ap.add_argument("--map", action="append", default=[],
+                    metavar="PAT=REPL",
+                    help="regex rename applied after default mapping")
+    ap.add_argument("--epoch", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    import torch
+
+    import mxnet_tpu as mx
+
+    state = torch.load(args.state_dict, map_location="cpu",
+                       weights_only=True)
+    if hasattr(state, "state_dict"):
+        state = state.state_dict()
+    state = {k: v.numpy() for k, v in state.items()}
+    rules = [tuple(m.split("=", 1)) for m in args.map]
+    arg_np, aux_np = convert_state_dict(state, rules, args.layout)
+
+    arg_params = {k: mx.nd.array(v) for k, v in arg_np.items()}
+    aux_params = {k: mx.nd.array(v) for k, v in aux_np.items()}
+    sym = None
+    if args.symbol:
+        sym = mx.sym.load(args.symbol)
+        known = set(sym.list_arguments()) | set(
+            sym.list_auxiliary_states())
+        missing = sorted(
+            k for k in (set(arg_params) | set(aux_params)) - known)
+        if missing:
+            print(f"warning: {len(missing)} converted params not in "
+                  f"symbol: {missing[:8]}...", file=sys.stderr)
+    mx.model.save_checkpoint(args.prefix, args.epoch, sym,
+                             arg_params, aux_params)
+    print(f"saved {len(arg_params)} arg + {len(aux_params)} aux params "
+          f"-> {args.prefix}-{args.epoch:04d}.params")
+
+
+if __name__ == "__main__":
+    main()

@@ -131,9 +131,16 @@ def main():
     # NHWC is the TPU-native layout (channels on the lane dimension);
     # BENCH_LAYOUT=NCHW measures the reference-parity orientation.
     layout = os.environ.get("BENCH_LAYOUT", "NHWC").upper()
+    # space-to-depth stem: bit-equivalent reformulation of the 7x7/s2
+    # stem (models/resnet.py _s2d_stem) that keeps the MXU busy; only
+    # meaningful for NHWC ImageNet-scale graphs.
+    stem = os.environ.get(
+        "BENCH_STEM",
+        "space_to_depth" if (layout == "NHWC" and image[1] > 32)
+        else "standard")
 
     net = get_resnet(num_classes=classes, num_layers=num_layers,
-                     image_shape=image, layout=layout)
+                     image_shape=image, layout=layout, stem=stem)
     ctx = mx.tpu() if on_accel else mx.cpu()
     c, h, w = image
     dshape = (batch, c, h, w) if layout == "NCHW" else (batch, h, w, c)

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Stacked MLP autoencoder (the reference example/autoencoder role):
+greedy layerwise pretraining of each encoder/decoder pair, then
+end-to-end finetuning, all through Module + LinearRegressionOutput
+with the input as its own regression target.
+
+Usage: python examples/autoencoder/ae_mnist.py [--epochs N]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def ae_symbol(dims, n_layers):
+    """Encoder dims[0]->dims[n_layers], mirrored decoder, MSE loss."""
+    x = sym.Variable("data")
+    net = x
+    for i in range(n_layers):
+        net = sym.FullyConnected(net, name=f"enc{i}",
+                                 num_hidden=dims[i + 1])
+        net = sym.Activation(net, name=f"enc{i}_act", act_type="sigmoid")
+    for i in reversed(range(n_layers)):
+        net = sym.FullyConnected(net, name=f"dec{i}",
+                                 num_hidden=dims[i])
+        if i != 0:
+            net = sym.Activation(net, name=f"dec{i}_act",
+                                 act_type="sigmoid")
+    return sym.LinearRegressionOutput(net, name="rec")
+
+
+def make_data(n=512, d=64, seed=0):
+    """Low-rank data: the AE must discover an 8-d latent structure."""
+    rs = np.random.RandomState(seed)
+    basis = rs.randn(8, d).astype(np.float32)
+    codes = rs.randn(n, 8).astype(np.float32)
+    x = 1.0 / (1.0 + np.exp(-(codes @ basis)))
+    return x.astype(np.float32)
+
+
+def fit_ae(X, dims, n_layers, epochs, lr, ctx):
+    it = mx.io.NDArrayIter(X, X.copy(), batch_size=64, shuffle=True,
+                           label_name="rec_label")
+    mod = mx.mod.Module(ae_symbol(dims, n_layers),
+                        label_names=("rec_label",), context=[ctx])
+    mod.fit(it, num_epoch=epochs, optimizer="adam",
+            optimizer_params={"learning_rate": lr},
+            eval_metric="mse")
+    return mod
+
+
+def reconstruction_mse(mod, X):
+    it = mx.io.NDArrayIter(X, X.copy(), batch_size=64,
+                           label_name="rec_label")
+    out = mod.predict(it).asnumpy()
+    return float(np.mean((out - X[:len(out)]) ** 2))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=30)
+    ap.add_argument("--lr", type=float, default=1e-2)
+    args = ap.parse_args()
+    ctx = mx.default_context()
+    X = make_data()
+    dims = [X.shape[1], 32, 8]
+
+    # greedy layerwise pretrain: shallow AE first, reuse its weights
+    shallow = fit_ae(X, dims, 1, max(1, args.epochs // 2), args.lr, ctx)
+    deep = mx.mod.Module(ae_symbol(dims, 2),
+                         label_names=("rec_label",), context=[ctx])
+    it = mx.io.NDArrayIter(X, X.copy(), batch_size=64, shuffle=True,
+                           label_name="rec_label")
+    deep.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    deep.init_params(mx.initializer.Xavier())
+    shallow_args, _ = shallow.get_params()
+    deep.set_params({k: v for k, v in shallow_args.items()
+                     if k.startswith(("enc0", "dec0"))}, {},
+                    allow_missing=True)
+    deep.fit(it, num_epoch=args.epochs, optimizer="adam",
+             optimizer_params={"learning_rate": args.lr},
+             eval_metric="mse")
+
+    mse = reconstruction_mse(deep, X)
+    var = float(X.var())
+    print(f"reconstruction mse={mse:.5f} (data variance {var:.5f})")
+    assert mse < 0.6 * var, "autoencoder failed to beat the mean predictor"
+    print("autoencoder done")
+
+
+if __name__ == "__main__":
+    main()

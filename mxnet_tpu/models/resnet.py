@@ -71,33 +71,85 @@ _CONFIGS = {
 }
 
 
+def _s2d_stem(data, num_filter, nchannel, height, width):
+    """Space-to-depth reformulation of the 7x7/s2 ImageNet stem (NHWC).
+
+    Bit-equivalent function space to Convolution(kernel=(7,7),
+    stride=(2,2), pad=(3,3)) on the SAME (O,7,7,I) `conv0_weight`
+    parameter: the 2x2-phase decomposition turns the stride-2 conv over
+    3 channels into a stride-1 4x4 conv over 4*C channels. On TPU this
+    matters twice over: C=3 wastes 125/128 of the lane dimension, and
+    the stride-2 backward data-gradient becomes a zero-dilated conv —
+    both disappear in the s2d form (the MLPerf ResNet TPU trick).
+
+    Derivation: out(i,j) = sum W[u,v] x[2i+u-3, 2j+v-3] with
+    x2[m,n,(p,q,c)] = x[2m+p, 2n+q, c] and W8 = W front-padded 1 in
+    H,W (u' = u+1 = 2A+p) gives a 4x4 valid conv over x2 padded
+    (2,1) per spatial dim.
+    """
+    w = sym.Variable("conv0_weight",
+                     shape=(num_filter, 7, 7, nchannel))
+    w8 = sym.Pad(w, mode="constant",
+                 pad_width=(0, 0, 1, 0, 1, 0, 0, 0))
+    w4 = sym.reshape(w8, shape=(num_filter, 4, 2, 4, 2, nchannel))
+    w4 = sym.transpose(w4, axes=(0, 1, 3, 2, 4, 5))
+    w4 = sym.reshape(w4, shape=(num_filter, 4, 4, 4 * nchannel))
+
+    x2 = sym.reshape(
+        data, shape=(-1, height // 2, 2, width // 2, 2, nchannel))
+    x2 = sym.transpose(x2, axes=(0, 1, 3, 2, 4, 5))
+    x2 = sym.reshape(
+        x2, shape=(-1, height // 2, width // 2, 4 * nchannel))
+    x2 = sym.Pad(x2, mode="constant",
+                 pad_width=(0, 0, 2, 1, 2, 1, 0, 0))
+    return sym.Convolution(
+        x2, weight=w4, name="conv0", num_filter=num_filter,
+        kernel=(4, 4), stride=(1, 1), pad=(0, 0), no_bias=True,
+        layout="NHWC")
+
+
 def get_resnet(num_classes=1000, num_layers=50, image_shape=(3, 224, 224),
-               bn_mom=0.9, layout="NCHW"):
+               bn_mom=0.9, layout="NCHW", stem="standard"):
     """Build ResNet-{18,34,50,101,152} (reference symbol_resnet.py resnet()).
 
     `image_shape` is always (C, H, W); `layout` picks the data/weight
     orientation of the built graph — "NHWC" feeds (N, H, W, C) batches
     and is the fast path on TPU (see module docstring).
+    `stem="space_to_depth"` (NHWC ImageNet stems only) builds the
+    mathematically equivalent MXU-friendly stem over the same
+    `conv0_weight` parameter — see _s2d_stem.
     """
     if num_layers not in _CONFIGS:
         raise ValueError(f"no ResNet-{num_layers} config")
     if layout not in ("NCHW", "NHWC"):
         raise ValueError(f"layout must be NCHW or NHWC, got {layout!r}")
+    if stem not in ("standard", "space_to_depth"):
+        raise ValueError(f"unknown stem {stem!r}")
     units, filter_list, bottle_neck = _CONFIGS[num_layers]
     ax = layout.index("C")
 
     data = sym.Variable("data")
     data = sym.BatchNorm(data, name="bn_data", fix_gamma=True, eps=2e-5,
                          axis=ax)
-    (nchannel, height, _) = image_shape
+    (nchannel, height, width) = image_shape
+    if stem == "space_to_depth" and (
+            layout != "NHWC" or height <= 32 or height % 2 or width % 2):
+        raise ValueError(
+            "space_to_depth stem needs layout='NHWC' and an even-sized "
+            "ImageNet-scale image")
     if height <= 32:  # cifar-style stem
         body = sym.Convolution(
             data, name="conv0", num_filter=filter_list[0], kernel=(3, 3),
             stride=(1, 1), pad=(1, 1), no_bias=True, layout=layout)
     else:  # imagenet stem
-        body = sym.Convolution(
-            data, name="conv0", num_filter=filter_list[0], kernel=(7, 7),
-            stride=(2, 2), pad=(3, 3), no_bias=True, layout=layout)
+        if stem == "space_to_depth":
+            body = _s2d_stem(data, filter_list[0], nchannel, height,
+                             width)
+        else:
+            body = sym.Convolution(
+                data, name="conv0", num_filter=filter_list[0],
+                kernel=(7, 7), stride=(2, 2), pad=(3, 3), no_bias=True,
+                layout=layout)
         body = sym.BatchNorm(body, name="bn0", fix_gamma=False, eps=2e-5,
                              momentum=bn_mom, axis=ax)
         body = sym.Activation(body, name="relu0", act_type="relu")

@@ -862,8 +862,11 @@ int MXTpuRecordIOReaderSeek(void* h, long pos) {
 }
 
 int MXTpuRecordIOWriterFree(void* h) {
-  if (HandleUnaryVoid("recordio_close", h) != 0) return -1;
-  return MXTpuHandleFree(h);
+  // always release the handle, even when the close itself fails
+  // (e.g. ENOSPC on the final flush) — the caller still gets -1
+  int rc = HandleUnaryVoid("recordio_close", h);
+  MXTpuHandleFree(h);
+  return rc;
 }
 
 int MXTpuRecordIOReaderFree(void* h) {

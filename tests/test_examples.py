@@ -23,6 +23,8 @@ EXAMPLES = {
         "--num-epochs", "1", "--batch-size", "4"],
     "long_context/ring_attention_demo.py": [],
     "distributed/dist_train.py": [],
+    "gan/dcgan_mnist.py": ["--epochs", "1", "--batch", "32"],
+    "autoencoder/ae_mnist.py": [],
 }
 
 

@@ -88,3 +88,41 @@ def test_classification_zoo_forward():
         )
         out = ex.forward()
         assert out[0].shape[0] == shape[0]
+
+
+def test_resnet_s2d_stem_matches_standard():
+    """space_to_depth stem is the same function of the same
+    conv0_weight as the 7x7/s2 stem (models/resnet.py _s2d_stem)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import get_resnet
+
+    x = np.random.RandomState(0).randn(2, 32, 32, 3).astype(np.float32)
+    outs = []
+    for stem in ("standard", "space_to_depth"):
+        net = get_resnet(num_classes=5, num_layers=18,
+                         image_shape=(3, 64, 64), layout="NHWC",
+                         stem=stem)
+        ex = net.simple_bind(ctx=mx.cpu(), grad_req="null",
+                             data=(2, 64, 64, 3),
+                             softmax_label=(2,))
+        prs = np.random.RandomState(7)
+        for name, arr in sorted(ex.arg_dict.items()):
+            if name not in ("data", "softmax_label"):
+                arr[:] = prs.randn(*arr.shape).astype(np.float32) * 0.05
+        ex.arg_dict["data"][:] = np.tile(
+            x, (1, 2, 2, 1))[:, :64, :64, :]
+        ex.arg_dict["softmax_label"][:] = np.zeros(2, np.float32)
+        outs.append(ex.forward(is_train=False)[0].asnumpy())
+    np.testing.assert_allclose(outs[0], outs[1], rtol=1e-4, atol=1e-5)
+
+
+def test_resnet_s2d_stem_rejects_nchw():
+    import pytest as _pytest
+
+    from mxnet_tpu.models import get_resnet
+
+    with _pytest.raises(ValueError):
+        get_resnet(num_layers=18, image_shape=(3, 64, 64),
+                   layout="NCHW", stem="space_to_depth")

@@ -54,9 +54,15 @@ def convert_name(torch_name, bn_param_names):
     return "arg", torch_name.replace(".", "_")
 
 
-def convert_state_dict(state, rules=(), layout="NCHW"):
+def convert_state_dict(state, rules=(), layout="NCHW", deconv=()):
     """state: {torch_name: numpy array}. Returns (arg_params,
-    aux_params) as numpy dicts with mapped names/layouts."""
+    aux_params) as numpy dicts with mapped names/layouts.
+
+    `deconv`: regex patterns (matched against the ORIGINAL torch name)
+    naming transposed-conv modules — their weights are torch-IOHW, not
+    OIHW, so the NHWC relayout does not apply; they are passed through
+    unchanged with a warning for manual handling.
+    """
     import numpy as np
 
     # a module with running stats is a norm layer: its weight/bias are
@@ -68,14 +74,24 @@ def convert_state_dict(state, rules=(), layout="NCHW"):
     args, auxs = {}, {}
     for tname, tensor in state.items():
         arr = np.asarray(tensor)
+        head, _, tail = tname.rpartition(".")
+        # layout decision from the ORIGINAL torch name/shape — rename
+        # rules must not be able to toggle the relayout
+        is_conv_w = (tail == "weight" and arr.ndim == 4
+                     and head not in bn_modules)
+        is_deconv = any(re.search(p, tname) for p in deconv)
         kind, name = convert_name(tname, bn_modules)
         if kind is None:
             continue
         for pat, repl in rules:
             name = re.sub(pat, repl, name)
-        if layout.upper() == "NHWC" and arr.ndim == 4 \
-                and name.endswith("_weight"):
-            arr = arr.transpose(0, 2, 3, 1)  # OIHW -> OHWI
+        if layout.upper() == "NHWC" and is_conv_w:
+            if is_deconv:
+                print(f"warning: {tname}: transposed-conv weight "
+                      f"(IOHW) left unconverted for NHWC — handle "
+                      f"manually", file=sys.stderr)
+            else:
+                arr = arr.transpose(0, 2, 3, 1)  # OIHW -> OHWI
         (args if kind == "arg" else auxs)[name] = arr
     return args, auxs
 
@@ -91,6 +107,11 @@ def main(argv=None):
     ap.add_argument("--map", action="append", default=[],
                     metavar="PAT=REPL",
                     help="regex rename applied after default mapping")
+    ap.add_argument("--deconv", action="append", default=[],
+                    metavar="PAT",
+                    help="regex (on torch names) marking "
+                         "ConvTranspose2d modules (IOHW weights): "
+                         "excluded from the NHWC relayout")
     ap.add_argument("--epoch", type=int, default=0)
     args = ap.parse_args(argv)
 
@@ -104,7 +125,8 @@ def main(argv=None):
         state = state.state_dict()
     state = {k: v.numpy() for k, v in state.items()}
     rules = [tuple(m.split("=", 1)) for m in args.map]
-    arg_np, aux_np = convert_state_dict(state, rules, args.layout)
+    arg_np, aux_np = convert_state_dict(state, rules, args.layout,
+                                        deconv=args.deconv)
 
     arg_params = {k: mx.nd.array(v) for k, v in arg_np.items()}
     aux_params = {k: mx.nd.array(v) for k, v in aux_np.items()}

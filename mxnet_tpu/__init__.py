@@ -18,6 +18,7 @@ from .context import (
     default_context,
     num_devices,
     memory_stats,
+    set_memory_fraction,
 )
 from . import ops
 from . import ndarray

@@ -325,6 +325,29 @@ def executor_set_monitor(ex, fn_ptr, payload_ptr):
     ex.set_monitor_callback(monitor)
 
 
+def executor_reshape(ex, names, shapes):
+    """-> NEW executor bound at the new shapes, sharing params
+    (reference MXExecutorReshape)."""
+    return ex.reshape(
+        **{n: tuple(sh) for n, sh in zip(names, shapes)})
+
+
+def executor_copy_params_from(ex, names, handles, allow_extra):
+    args = {n: h for n, h in zip(names, handles)}
+    known = set(ex.arg_dict) | set(ex.aux_dict)
+    arg_params = {k: v for k, v in args.items() if k in ex.arg_dict}
+    aux_params = {k: v for k, v in args.items() if k in ex.aux_dict}
+    extra = set(args) - known
+    if extra and not allow_extra:
+        raise MXNetError(f"unknown params {sorted(extra)[:5]}")
+    ex.copy_params_from(arg_params, aux_params or None)
+
+
+def executor_print(ex):
+    """Executor debug string (reference MXExecutorPrint)."""
+    return ex.debug_str()
+
+
 # ------------------------------------------------------------ data iter
 
 _DATAITERS = {
@@ -471,6 +494,26 @@ def kvstore_barrier(kv):
 
 def kvstore_num_dead_node(kv, node_id, timeout):
     return int(kv.get_num_dead_node(node_id, timeout))
+
+
+def kvstore_set_optimizer(kv, opt_name, params):
+    """Server-side optimizer (the reference ships a pickled optimizer
+    via MXKVStoreSendCommmandToServers + server Controller; the C
+    surface here takes name + string params, the same info)."""
+    from . import optimizer as opt
+
+    kwargs = {k: _coerce_str_param(v) for k, v in params.items()}
+    kv.set_optimizer(opt.create(opt_name, **kwargs))
+
+
+def kvstore_run_server(kv):
+    """Reference MXKVStoreRunServer turns the process into a parameter
+    server. Our dist_async backend hosts its server inside rank 0
+    automatically (parallel/kvstore_async.py _ensure_server); this call
+    just forces that to have happened (no-op on other types/ranks)."""
+    ensure = getattr(kv, "_ensure_server", None)
+    if ensure is not None:
+        ensure()
 
 
 # ------------------------------------------------------------- autograd

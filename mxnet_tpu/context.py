@@ -123,6 +123,31 @@ def num_devices(device_type: str = "tpu") -> int:
     return len(devs)
 
 
+def set_memory_fraction(fraction, preallocate=None):
+    """HBM pool sizing knob (counterpart of the reference's
+    MXNET_GPU_MEM_POOL_RESERVE, src/storage/pooled_storage_manager.h:
+    28-47). The XLA runtime owns the device allocator, so this maps to
+    its client options — it must run BEFORE the first jax backend
+    initialization in the process; afterwards it raises.
+
+    Also reachable via env: MXNET_TPU_MEM_FRACTION (read at import).
+    """
+    import os
+
+    import jax
+
+    if jax._src.xla_bridge._backends:  # backend already materialized
+        from .base import MXNetError
+
+        raise MXNetError(
+            "set_memory_fraction must be called before the first "
+            "device use (the XLA client reads it at initialization)")
+    os.environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] = str(float(fraction))
+    if preallocate is not None:
+        os.environ["XLA_PYTHON_CLIENT_PREALLOCATE"] = (
+            "true" if preallocate else "false")
+
+
 def memory_stats(ctx=None):
     """Device-memory introspection (counterpart of the reference's
     pooled storage manager stats, src/storage/pooled_storage_manager.h:
@@ -140,3 +165,17 @@ def memory_stats(ctx=None):
     except Exception:
         return {}
     return dict(stats or {})
+
+
+# MXNET_TPU_MEM_FRACTION: declarative form of set_memory_fraction,
+# honored when the backend is not yet initialized (import-time here is
+# before any device use in normal programs).
+def _apply_mem_fraction_env():
+    import os
+
+    frac = os.environ.get("MXNET_TPU_MEM_FRACTION")
+    if frac and not jax._src.xla_bridge._backends:
+        os.environ.setdefault("XLA_PYTHON_CLIENT_MEM_FRACTION", frac)
+
+
+_apply_mem_fraction_env()

@@ -85,6 +85,11 @@ register_env(
     "jax.distributed coordinator address (set by tools/launch.py)",
 )
 register_env(
+    "MXNET_TPU_MEM_FRACTION", str, "",
+    "HBM pool fraction for the XLA client (pooled-storage-manager "
+    "knob analog; applied at import if the backend is uninitialized)",
+)
+register_env(
     "MXNET_TPU_NUM_WORKERS", int, 1,
     "worker process count (set by tools/launch.py)",
 )

@@ -1045,6 +1045,56 @@ int MXTpuExecutorSetMonitorCallback(void* ex,
   return 0;
 }
 
+// New executor bound at new shapes, params shared with the original
+// (reference MXExecutorReshape).
+int MXTpuExecutorReshape(void* ex, int num_in, const char** names,
+                         const int* shape_ind, const int* shape_data,
+                         void** out) {
+  Gil gil;
+  PyObject* args = PyTuple_New(3);
+  Py_INCREF(static_cast<PyObject*>(ex));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(ex));
+  PyTuple_SET_ITEM(args, 1, StrList(names, num_in));
+  PyTuple_SET_ITEM(args, 2,
+                   ShapeLists(num_in, shape_ind, shape_data));
+  PyObject* r = CallShim("executor_reshape", args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+int MXTpuExecutorCopyParamsFrom(void* ex, int num, const char** names,
+                                void** handles, int allow_extra) {
+  Gil gil;
+  PyObject* args = PyTuple_New(4);
+  Py_INCREF(static_cast<PyObject*>(ex));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(ex));
+  PyTuple_SET_ITEM(args, 1, StrList(names, num));
+  PyTuple_SET_ITEM(args, 2, HandleList(handles, num));
+  PyTuple_SET_ITEM(args, 3, PyLong_FromLong(allow_extra));
+  PyObject* r = CallShim("executor_copy_params_from", args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// Debug description of the bound graph (reference MXExecutorPrint's
+// out_str form); TLS string.
+int MXTpuExecutorPrint(void* ex, const char** out) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  Py_INCREF(static_cast<PyObject*>(ex));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(ex));
+  PyObject* r = CallShim("executor_print", args);
+  if (r == nullptr) return -1;
+  const char* s = PyUnicode_AsUTF8(r);
+  tls_strs.clear();
+  tls_strs.emplace_back(s ? s : "");
+  *out = tls_strs.back().c_str();
+  Py_DECREF(r);
+  return 0;
+}
+
 // ----------------------------------------------------------- DataIter
 
 // Registered iterator names (reference MXListDataIters, c_api.h:1096).
@@ -1218,6 +1268,30 @@ int MXTpuKVStoreGetNumDeadNode(void* kv, int node_id, int timeout,
   *dead = static_cast<int>(PyLong_AsLong(r));
   Py_DECREF(r);
   return 0;
+}
+
+// Server-side optimizer by name + string params (the reference ships
+// a pickled optimizer via MXKVStoreSendCommmandToServers; same info).
+int MXTpuKVStoreSetOptimizer(void* kv, const char* opt_name,
+                             int num_params, const char** keys,
+                             const char** vals) {
+  Gil gil;
+  PyObject* args = PyTuple_New(3);
+  Py_INCREF(static_cast<PyObject*>(kv));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(kv));
+  PyTuple_SET_ITEM(args, 1, Str(opt_name));
+  PyTuple_SET_ITEM(args, 2, StrDict(num_params, keys, vals));
+  PyObject* r = CallShim("kvstore_set_optimizer", args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// Ensure this process's server role is live (reference
+// MXKVStoreRunServer; our dist_async hosts the server inside rank 0's
+// process, so this returns immediately elsewhere).
+int MXTpuKVStoreRunServer(void* kv) {
+  return HandleUnaryVoid("kvstore_run_server", kv);
 }
 
 int MXTpuKVStoreBarrier(void* kv) {

@@ -109,6 +109,19 @@ int MXTpuKVStoreGetGroupSize(void* kv, int* size);
 int MXTpuKVStoreBarrier(void* kv);
 int MXTpuKVStoreGetNumDeadNode(void* kv, int node_id, int timeout,
                                int* dead);
+int MXTpuKVStoreSetOptimizer(void* kv, const char* opt_name,
+                             int num_params, const char** keys,
+                             const char** vals);
+int MXTpuKVStoreRunServer(void* kv);
+
+/* ---- Executor extras (reference MXExecutorReshape, copy-params,
+ * MXExecutorPrint) ---- */
+int MXTpuExecutorReshape(void* ex, int num_in, const char** names,
+                         const int* shape_ind, const int* shape_data,
+                         void** out);
+int MXTpuExecutorCopyParamsFrom(void* ex, int num, const char** names,
+                                void** handles, int allow_extra);
+int MXTpuExecutorPrint(void* ex, const char** out);
 
 /* ---- Autograd (reference c_api.h:529-546) ---- */
 int MXTpuAutogradSetIsTraining(int is_training, int* prev);

@@ -1,0 +1,93 @@
+"""tools/accnn.py — low-rank factorization (reference tools/accnn/
+role): full-rank factorization must reproduce the network exactly;
+reduced rank must shrink params and still load/run."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _build_net(layout):
+    s = mx.sym.Variable("data")
+    s = mx.sym.Convolution(s, name="conv1", num_filter=8,
+                           kernel=(3, 3), pad=(1, 1), stride=(2, 2),
+                           layout=layout)
+    s = mx.sym.Activation(s, act_type="relu")
+    s = mx.sym.Flatten(s)
+    s = mx.sym.FullyConnected(s, name="fc1", num_hidden=10)
+    return s
+
+
+def _checkpoint(tmp_path, layout):
+    net = _build_net(layout)
+    shape = (2, 3, 12, 12) if layout == "NCHW" else (2, 12, 12, 3)
+    ex = net.simple_bind(ctx=mx.cpu(), grad_req="null", data=shape)
+    rs = np.random.RandomState(0)
+    for name, arr in sorted(ex.arg_dict.items()):
+        if name != "data":
+            arr[:] = rs.randn(*arr.shape).astype(np.float32) * 0.3
+    arg_params = {k: v for k, v in ex.arg_dict.items() if k != "data"}
+    prefix = str(tmp_path / f"net_{layout.lower()}")
+    mx.model.save_checkpoint(prefix, 0, net, arg_params, {})
+    x = rs.randn(*shape).astype(np.float32)
+    ex.arg_dict["data"][:] = x
+    want = ex.forward(is_train=False)[0].asnumpy()
+    return prefix, shape, x, want
+
+
+def _forward(prefix, shape, x):
+    net, args, auxs = mx.model.load_checkpoint(prefix, 0)
+    ex = net.simple_bind(ctx=mx.cpu(), grad_req="null", data=shape)
+    ex.copy_params_from(args, auxs)
+    ex.arg_dict["data"][:] = x
+    return ex.forward(is_train=False)[0].asnumpy()
+
+
+def _run_accnn(prefix, out, extra):
+    subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/accnn.py"),
+         prefix, "0", out] + extra,
+        check=True, env=dict(os.environ, JAX_PLATFORMS="cpu"))
+
+
+@pytest.mark.parametrize("layout", ["NCHW", "NHWC"])
+def test_full_rank_exact(tmp_path, layout):
+    prefix, shape, x, want = _checkpoint(tmp_path, layout)
+    out = str(tmp_path / "fact")
+    # conv1 full rank = min(I*kh, O*kw) = min(9, 16) = 9; fc full = 10
+    _run_accnn(prefix, out, ["--rank", "conv1=9", "--rank", "fc1=64"])
+    graph = json.load(open(out + "-symbol.json"))
+    names = [n["name"] for n in graph["nodes"]]
+    assert "conv1_v" in names  # conv was factorized
+    got = _forward(out, shape, x)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_reduced_rank_shrinks(tmp_path):
+    prefix, shape, x, want = _checkpoint(tmp_path, "NCHW")
+    out = str(tmp_path / "half")
+    _run_accnn(prefix, out, ["--ratio", "0.5"])
+    old = mx.nd.load(prefix + "-0000.params")
+    new = mx.nd.load(out + "-0000.params")
+    n_old = sum(int(np.prod(v.shape)) for v in old.values())
+    n_new = sum(int(np.prod(v.shape)) for v in new.values())
+    assert n_new < n_old
+    got = _forward(out, shape, x)  # loads and runs
+    assert got.shape == want.shape
+
+    # iterative compression: the output graph must stay well-formed
+    # (no duplicate node names) so accnn can run on its own output
+    out2 = str(tmp_path / "quarter")
+    _run_accnn(out, out2, ["--ratio", "0.5"])
+    graph = json.load(open(out2 + "-symbol.json"))
+    names = [n["name"] for n in graph["nodes"]]
+    assert len(names) == len(set(names)), "duplicate node names"
+    got2 = _forward(out2, shape, x)
+    assert got2.shape == want.shape

@@ -7,6 +7,7 @@ MXDumpProfile, MXRandomSeed, MXInitPSEnv, MXKVStoreIs*Node)."""
 import ctypes
 import json
 import os
+import sys
 
 import numpy as np
 import pytest
@@ -306,3 +307,95 @@ def test_runtime_surface(lib):
     os.environ.pop("DMLC_ROLE", None)
 
     assert lib.MXTpuNotifyShutdown() == 0, _err(lib)
+
+
+def test_executor_reshape_copy_print(lib):
+    _, fc = _mlp_symbol(lib)
+    names = (ctypes.c_char_p * 1)(b"data")
+    ind = (ctypes.c_int * 2)(0, 2)
+    dims = (ctypes.c_int * 2)(4, 16)
+    ex = ctypes.c_void_p()
+    assert lib.MXTpuExecutorSimpleBind(
+        fc, b"cpu", 0, b"null", 1, names, ind, dims,
+        ctypes.byref(ex)) == 0, _err(lib)
+
+    # reshape to a new batch size; params shared
+    dims2 = (ctypes.c_int * 2)(8, 16)
+    ex2 = ctypes.c_void_p()
+    assert lib.MXTpuExecutorReshape(
+        ex, 1, names, ind, dims2, ctypes.byref(ex2)) == 0, _err(lib)
+    assert lib.MXTpuExecutorForward(ex2, 0) == 0, _err(lib)
+    num = ctypes.c_int()
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    assert lib.MXTpuExecutorOutputs(ex2, ctypes.byref(num),
+                                    ctypes.byref(outs)) == 0
+    shape = (ctypes.c_int * 4)()
+    nd_ = ctypes.c_int()
+    h0 = ctypes.c_void_p(outs[0])
+    assert lib.MXTpuNDArrayGetShape(h0, shape, 4,
+                                    ctypes.byref(nd_)) == 0
+    assert list(shape[:nd_.value]) == [8, 8]
+
+    # copy_params_from: overwrite fc1_weight with ones
+    w = _make_nd(lib, np.ones(8 * 16, np.float32), (8, 16))
+    pnames = (ctypes.c_char_p * 1)(b"fc1_weight")
+    handles = (ctypes.c_void_p * 1)(w)
+    assert lib.MXTpuExecutorCopyParamsFrom(
+        ex2, 1, pnames, handles, 0) == 0, _err(lib)
+    bad = (ctypes.c_char_p * 1)(b"nope_weight")
+    assert lib.MXTpuExecutorCopyParamsFrom(
+        ex2, 1, bad, handles, 0) != 0  # rejected without allow_extra
+    assert lib.MXTpuExecutorCopyParamsFrom(
+        ex2, 1, bad, handles, 1) == 0, _err(lib)
+
+    dbg = ctypes.c_char_p()
+    assert lib.MXTpuExecutorPrint(ex2, ctypes.byref(dbg)) == 0
+    assert b"fc1" in dbg.value
+
+
+def test_kvstore_set_optimizer_run_server(lib):
+    kv = ctypes.c_void_p()
+    assert lib.MXTpuKVStoreCreate(b"local", ctypes.byref(kv)) == 0
+    keys = (ctypes.c_char_p * 1)(b"learning_rate")
+    vals = (ctypes.c_char_p * 1)(b"0.5")
+    assert lib.MXTpuKVStoreSetOptimizer(
+        kv, b"sgd", 1, keys, vals) == 0, _err(lib)
+    assert lib.MXTpuKVStoreRunServer(kv) == 0, _err(lib)
+
+    # push/pull now applies the sgd update: w <- w - 0.5 * g
+    ikeys = (ctypes.c_int * 1)(3)
+    w = _make_nd(lib, [1.0, 2.0], (2,))
+    assert lib.MXTpuKVStoreInit(kv, 1, ikeys,
+                                (ctypes.c_void_p * 1)(w)) == 0
+    g = _make_nd(lib, [1.0, 1.0], (2,))
+    assert lib.MXTpuKVStorePush(kv, 1, ikeys,
+                                (ctypes.c_void_p * 1)(g)) == 0
+    out = _make_nd(lib, [0.0, 0.0], (2,))
+    assert lib.MXTpuKVStorePull(kv, 1, ikeys,
+                                (ctypes.c_void_p * 1)(out)) == 0
+    np.testing.assert_allclose(_read_nd(lib, out, 2), [0.5, 1.5])
+
+
+def test_set_memory_fraction_env(tmp_path):
+    import subprocess
+
+    code = (
+        "import mxnet_tpu as mx, os\n"
+        "mx.set_memory_fraction(0.4, preallocate=False)\n"
+        "assert os.environ['XLA_PYTHON_CLIENT_MEM_FRACTION'] == '0.4'\n"
+        "assert os.environ['XLA_PYTHON_CLIENT_PREALLOCATE'] == 'false'\n"
+        "import numpy as np\n"
+        "mx.nd.array(np.ones(2)).asnumpy()\n"  # backend init
+        "try:\n"
+        "    mx.set_memory_fraction(0.5)\n"
+        "    raise SystemExit('expected failure after init')\n"
+        "except mx.base.MXNetError:\n"
+        "    pass\n"
+        "print('ok')\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True,
+        text=True)
+    assert proc.returncode == 0 and "ok" in proc.stdout, proc.stderr

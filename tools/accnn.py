@@ -1,0 +1,269 @@
+#!/usr/bin/env python
+"""Low-rank model compression (the reference tools/accnn/ role):
+factorize Convolution and FullyConnected layers of a trained
+checkpoint into rank-R pairs by SVD, rewriting the symbol JSON and the
+params.
+
+- k_h x k_w Convolution -> vertical (R, k_h x 1) conv + horizontal
+  (1 x k_w) conv (the Jaderberg spatial-SVD scheme): the kernel tensor
+  W[o,i,u,v] is reshaped to M[(i,u),(o,v)], SVD'd, and the sqrt-scaled
+  factors become the two kernels. Full rank reproduces the original
+  layer exactly; smaller R trades accuracy for FLOPs/params.
+- FullyConnected -> R-dim bottleneck pair.
+
+The replacement keeps the original node NAME on the second layer, so
+downstream symbols and output names are unchanged; checkpoints emitted
+here load with model.load_checkpoint / Module like any other.
+
+Usage:
+  python tools/accnn.py in_prefix epoch out_prefix \\
+      --rank conv1=8 --rank fc1=32   # explicit ranks
+  python tools/accnn.py in_prefix epoch out_prefix --ratio 0.5
+      # rank = ratio * full rank for every eligible layer
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _svd_pair(M, rank):
+    U, S, Vt = np.linalg.svd(M, full_matrices=False)
+    rank = max(1, min(rank, len(S)))
+    sq = np.sqrt(S[:rank])
+    return (U[:, :rank] * sq[None, :]), (sq[:, None] * Vt[:rank])
+
+
+def factor_conv(w, rank, layout="NCHW"):
+    """-> (Wv, Wh): vertical (R,.,kh,1-ish) and horizontal kernels in
+    the SAME layout convention as the input weight."""
+    if layout == "NCHW":
+        O, I, kh, kw = w.shape
+        M = w.transpose(1, 2, 0, 3).reshape(I * kh, O * kw)
+        A, B = _svd_pair(M, rank)
+        R = A.shape[1]
+        wv = A.reshape(I, kh, R).transpose(2, 0, 1)[..., None]
+        wh = B.reshape(R, O, kw).transpose(1, 0, 2)[:, :, None, :]
+    else:  # NHWC / OHWI
+        O, kh, kw, I = w.shape
+        M = w.transpose(3, 1, 0, 2).reshape(I * kh, O * kw)
+        A, B = _svd_pair(M, rank)
+        R = A.shape[1]
+        wv = A.reshape(I, kh, R).transpose(2, 1, 0)[:, :, None, :]
+        wh = B.reshape(R, O, kw).transpose(1, 2, 0)[:, None, :, :]
+    return np.ascontiguousarray(wv), np.ascontiguousarray(wh)
+
+
+def factor_fc(w, rank):
+    A, B = _svd_pair(w, rank)  # w (N,K) = A(N,R) @ B(R,K)
+    return B, A
+
+
+def _attr(node, key, default=None):
+    return node.get("attrs", {}).get(key, default)
+
+
+def _tup(s, default):
+    if s is None:
+        return default
+    v = ast.literal_eval(s)
+    return tuple(v) if isinstance(v, (tuple, list)) else (v, v)
+
+
+def compress(graph, params, ranks=None, ratio=None):
+    """graph: parsed symbol JSON; params: {'arg:name'|'aux:name': np}.
+    Returns (new_graph, new_params, report)."""
+    nodes = graph["nodes"]
+    taken = {n["name"] for n in nodes}
+    new_nodes = []
+
+    def fresh(base):
+        name = base
+        k = 2
+        while name in taken:
+            name = f"{base}{k}"
+            k += 1
+        taken.add(name)
+        return name
+    idx_map = {}  # old node idx -> new node idx
+    report = []
+
+    def emit(node):
+        new_nodes.append(node)
+        return len(new_nodes) - 1
+
+    def pick_rank(name, full):
+        """-> rank or None. Explicit --rank NAME=R always factorizes
+        (clamped to full rank — useful for exactness checks); --ratio
+        skips layers it cannot shrink."""
+        if ranks and name in ranks:
+            return min(ranks[name], full)
+        if ratio:
+            r = max(1, int(round(full * ratio)))
+            return r if r < full else None
+        return None
+
+    for old_idx, node in enumerate(nodes):
+        node = json.loads(json.dumps(node))  # deep copy
+        node["inputs"] = [
+            [idx_map[i], o, v] for i, o, v in node.get("inputs", [])
+        ]
+        op = node.get("op")
+        name = node["name"]
+        wkey = f"arg:{name}_weight"
+
+        if op == "Convolution" and wkey in params and \
+                _attr(node, "num_group", "1") in ("1", 1) and \
+                not _attr(node, "dilate") and \
+                len(_tup(_attr(node, "kernel"), ())) == 2:
+            layout = _attr(node, "layout") or "NCHW"
+            if layout not in ("NCHW", "NHWC"):
+                idx_map[old_idx] = emit(node)
+                continue
+            w = params[wkey]
+            kh, kw = _tup(_attr(node, "kernel"), (1, 1))
+            full = min(w.shape[1] * kh if layout == "NCHW"
+                       else w.shape[3] * kh,
+                       w.shape[0] * kw)
+            rank = pick_rank(name, full)
+            # spatial SVD needs BOTH kernel dims > 1 (this also keeps
+            # already-factorized (k,1)/(1,k) pairs stable under
+            # iterative compression)
+            if rank is None or kh == 1 or kw == 1:
+                idx_map[old_idx] = emit(node)
+                continue
+            sh, sw = _tup(_attr(node, "stride"), (1, 1))
+            ph, pw = _tup(_attr(node, "pad"), (0, 0))
+            wv, wh = factor_conv(w, rank, layout)
+            R = wv.shape[0]
+            v_name = fresh(f"{name}_v")
+            vw_idx = emit({"op": "null", "name": f"{v_name}_weight",
+                           "inputs": []})
+            v_idx = emit({
+                "op": "Convolution", "name": v_name,
+                "inputs": [node["inputs"][0], [vw_idx, 0, 0]],
+                "attrs": {"num_filter": str(R),
+                          "kernel": str((kh, 1)),
+                          "stride": str((sh, 1)),
+                          "pad": str((ph, 0)),
+                          "no_bias": "True", "layout": layout},
+            })
+            h_attrs = {"num_filter": _attr(node, "num_filter"),
+                       "kernel": str((1, kw)),
+                       "stride": str((1, sw)),
+                       "pad": str((0, pw)),
+                       "no_bias": _attr(node, "no_bias", "False"),
+                       "layout": layout}
+            # the ORIGINAL weight variable node carries the new
+            # horizontal kernel (same name, new value) — no duplicate
+            # node, and iterative compression stays well-formed
+            h_inputs = [[v_idx, 0, 0], node["inputs"][1]]
+            if len(node["inputs"]) > 2:  # bias rides along
+                h_inputs.append(node["inputs"][2])
+            idx_map[old_idx] = emit({
+                "op": "Convolution", "name": name,
+                "inputs": h_inputs, "attrs": h_attrs})
+            params[f"arg:{v_name}_weight"] = wv.astype(w.dtype)
+            params[wkey] = wh.astype(w.dtype)
+            report.append((name, "conv", w.size, wv.size + wh.size, R))
+            continue
+
+        if op == "FullyConnected" and wkey in params:
+            w = params[wkey]
+            full = min(w.shape)
+            rank = pick_rank(name, full)
+            if rank is None:
+                idx_map[old_idx] = emit(node)
+                continue
+            wv, wu = factor_fc(w, rank)
+            R = wv.shape[0]
+            v_name = fresh(f"{name}_v")
+            vw_idx = emit({"op": "null", "name": f"{v_name}_weight",
+                           "inputs": []})
+            v_idx = emit({
+                "op": "FullyConnected", "name": v_name,
+                "inputs": [node["inputs"][0], [vw_idx, 0, 0]],
+                "attrs": {"num_hidden": str(R), "no_bias": "True",
+                          "flatten": _attr(node, "flatten", "True")},
+            })
+            u_inputs = [[v_idx, 0, 0], node["inputs"][1]]
+            if len(node["inputs"]) > 2:
+                u_inputs.append(node["inputs"][2])
+            idx_map[old_idx] = emit({
+                "op": "FullyConnected", "name": name,
+                "inputs": u_inputs,
+                "attrs": {"num_hidden": _attr(node, "num_hidden"),
+                          "no_bias": _attr(node, "no_bias", "False"),
+                          "flatten": "False"}})
+            params[f"arg:{v_name}_weight"] = wv.astype(w.dtype)
+            params[wkey] = wu.astype(w.dtype)
+            report.append((name, "fc", w.size, wv.size + wu.size, R))
+            continue
+
+        idx_map[old_idx] = emit(node)
+
+    graph = dict(graph)
+    graph["nodes"] = new_nodes
+    graph["arg_nodes"] = [
+        i for i, n in enumerate(new_nodes) if n["op"] == "null"
+    ]
+    graph["heads"] = [
+        [idx_map[i], o, v] for i, o, v in graph["heads"]
+    ]
+    return graph, params, report
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix")
+    ap.add_argument("epoch", type=int)
+    ap.add_argument("out_prefix")
+    ap.add_argument("--rank", action="append", default=[],
+                    metavar="NAME=R")
+    ap.add_argument("--ratio", type=float, default=None)
+    args = ap.parse_args(argv)
+
+    import mxnet_tpu as mx
+
+    with open(f"{args.prefix}-symbol.json") as f:
+        graph = json.load(f)
+    raw = mx.nd.load("%s-%04d.params" % (args.prefix, args.epoch))
+    params = {k: v.asnumpy() for k, v in raw.items()}
+    ranks = {}
+    for spec in args.rank:
+        k, _, v = spec.partition("=")
+        ranks[k] = int(v)
+    if not ranks and args.ratio is None:
+        ap.error("give --rank NAME=R and/or --ratio F")
+
+    graph, params, report = compress(graph, params, ranks, args.ratio)
+    done = {r[0] for r in report}
+    for name in sorted(set(ranks) - done):
+        print(f"warning: --rank {name} matched no eligible layer "
+              f"(typo? grouped/dilated conv? missing weight?)",
+              file=sys.stderr)
+
+    with open(f"{args.out_prefix}-symbol.json", "w") as f:
+        json.dump(graph, f, indent=2)
+    mx.nd.save("%s-%04d.params" % (args.out_prefix, args.epoch),
+               {k: mx.nd.array(v) for k, v in params.items()})
+    before = sum(r[2] for r in report)
+    after = sum(r[3] for r in report)
+    for name, kind, b, a, R in report:
+        print(f"{name} ({kind}): {b} -> {a} params (rank {R})")
+    if before:
+        print(f"total factorized params: {before} -> {after} "
+              f"({after / before:.2%})")
+    else:
+        print("nothing factorized (check --rank names / --ratio)")
+
+
+if __name__ == "__main__":
+    main()

@@ -171,11 +171,16 @@ def symbol_list(s, kind):
     raise MXNetError(f"unknown list kind {kind!r}")
 
 
-def symbol_infer_shape(s, names, shapes):
-    arg_shapes, out_shapes, aux_shapes = s.infer_shape(
+def _infer_shape_lists(s, names, shapes, partial):
+    fn = s.infer_shape_partial if partial else s.infer_shape
+    arg_shapes, out_shapes, aux_shapes = fn(
         **{n: tuple(sh) for n, sh in zip(names, shapes)})
-    to_l = lambda xs: [list(x) for x in xs]
+    to_l = lambda xs: [list(x) if x else [] for x in xs]
     return to_l(arg_shapes), to_l(out_shapes), to_l(aux_shapes)
+
+
+def symbol_infer_shape(s, names, shapes):
+    return _infer_shape_lists(s, names, shapes, partial=False)
 
 
 def symbol_get_attr(s, key):
@@ -242,6 +247,20 @@ def symbol_infer_type(s, names, dtype_ids):
     arg_t, out_t, aux_t = s.infer_type(**kwargs)
     to_ids = lambda ts: [int(_DTYPE_TO_ID[np.dtype(t)]) for t in ts]
     return to_ids(arg_t), to_ids(out_t), to_ids(aux_t)
+
+
+def symbol_create_group(syms):
+    """Group symbols into one multi-output symbol (reference
+    MXSymbolCreateGroup)."""
+    from . import symbol as sym
+
+    return sym.Group(list(syms))
+
+
+def symbol_infer_shape_partial(s, names, shapes):
+    """Partial shape inference: unknown shapes come back empty
+    (reference MXSymbolInferShapePartial)."""
+    return _infer_shape_lists(s, names, shapes, partial=True)
 
 
 # -------------------------------------------------------------- op info
@@ -534,6 +553,96 @@ def autograd_compute_gradient(outputs):
     from . import autograd
 
     autograd.compute_gradient(list(outputs))
+
+
+# ------------------------------------------------------------ custom op
+
+def custom_op_register(op_type, num_inputs, num_outputs, fwd_ptr,
+                       bwd_ptr, payload_ptr):
+    """Register a C-implemented custom op (reference MXCustomOpRegister,
+    src/operator/custom/custom.cc). The C callbacks receive BORROWED
+    NDArray handles and mutate the outputs through the C ABI
+    (MXTpuNDArrayCopyIn etc.):
+
+        cb(num_in, in_handles, num_out, out_handles, payload)
+
+    Output shapes default to in[0]'s shape (the CustomOpProp default);
+    a null backward leaves zero input gradients.
+    """
+    import ctypes
+
+    from . import operator as op
+
+    CB = ctypes.CFUNCTYPE(
+        None, ctypes.c_int, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.c_int, ctypes.POINTER(ctypes.c_void_p), ctypes.c_void_p)
+    fwd = CB(fwd_ptr)
+    bwd = CB(bwd_ptr) if bwd_ptr else None
+    payload = ctypes.c_void_p(payload_ptr)
+
+    def call(cb, ins, outs):
+        def pack(arrs):
+            return (ctypes.c_void_p * max(len(arrs), 1))(
+                *[id(a) for a in arrs])
+
+        cb(len(ins), pack(ins), len(outs), pack(outs), payload)
+
+    class _COp(op.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            call(fwd, in_data, out_data)
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad,
+                     aux):
+            if bwd is None:
+                return  # in_grad buffers arrive pre-zeroed
+            call(bwd, list(out_grad) + list(in_data) + list(out_data),
+                 in_grad)
+
+    class _CProp(op.CustomOpProp):
+        def __init__(self, **_kwargs):
+            super().__init__(need_top_grad=True)
+
+        def list_arguments(self):
+            if num_inputs == 1:
+                return ["data"]
+            return [f"data{i}" for i in range(num_inputs)]
+
+        def list_outputs(self):
+            if num_outputs == 1:
+                return ["output"]
+            return [f"output{i}" for i in range(num_outputs)]
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            return _COp()
+
+    op.register(op_type)(_CProp)
+
+
+# ------------------------------------------------------------------ rtc
+
+def rtc_create(name, source, fn_name):
+    """Compile a Pallas kernel from python SOURCE text (the reference
+    MXRtcCreate took CUDA source for NVRTC; the TPU analog takes
+    pallas — see mxnet_tpu/rtc.py). The embedder supplies the code, so
+    this has exactly the reference's trust model: RTC runs caller-
+    provided device code in-process."""
+    from . import rtc
+
+    ns = {}
+    exec(compile(source, f"<rtc:{name}>", "exec"), ns)  # noqa: S102
+    if fn_name not in ns:
+        raise MXNetError(f"rtc source defines no function {fn_name!r}")
+    return rtc.PallasKernel(name, ns[fn_name])
+
+
+def rtc_push(kernel, ins, outs):
+    """Launch: output shapes/dtypes come from the given NDArrays, and
+    results are written into them (reference MXRtcPush semantics)."""
+    res = kernel.push(
+        list(ins), out_shapes=[tuple(o.shape) for o in outs],
+        out_dtypes=[o.dtype for o in outs])
+    for dst, src in zip(outs, res):
+        dst._set_data(src._data)
 
 
 # ------------------------------------------------------------- recordio

@@ -30,6 +30,10 @@ _PROP_REGISTRY: dict[str, type] = {}
 
 
 def register_prop(reg_name, prop_cls):
+    if _PROP_REGISTRY.get(reg_name) is not prop_cls:
+        # re-registration must not serve stale prop instances (or, for
+        # C-registered ops, stale function pointers) out of the cache
+        _make_prop.cache_clear()
     _PROP_REGISTRY[reg_name] = prop_cls
 
 

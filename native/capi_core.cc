@@ -578,12 +578,12 @@ int MXTpuSymbolList(void* sym, const char* kind, int* num,
   return 0;
 }
 
-// Infers all argument shapes from the named input shapes. Results are
-// packed into TLS: shape_ind has num+1 entries into shape_data.
-int MXTpuSymbolInferShape(void* sym, int num_in, const char** names,
-                          const int* shape_ind, const int* shape_data,
-                          int* num_arg, const int** arg_ind,
-                          const int** arg_data) {
+// shared core of InferShape / InferShapePartial: call the shim and
+// pack the arg-shape lists into TLS (shape_ind has num+1 entries).
+static int InferShapeVia(const char* shim_fn, void* sym, int num_in,
+                         const char** names, const int* shape_ind,
+                         const int* shape_data, int* num_arg,
+                         const int** arg_ind, const int** arg_data) {
   Gil gil;
   PyObject* args = PyTuple_New(3);
   Py_INCREF(static_cast<PyObject*>(sym));
@@ -591,7 +591,7 @@ int MXTpuSymbolInferShape(void* sym, int num_in, const char** names,
   PyTuple_SET_ITEM(args, 1, StrList(names, num_in));
   PyTuple_SET_ITEM(args, 2,
                    ShapeLists(num_in, shape_ind, shape_data));
-  PyObject* r = CallShim("symbol_infer_shape", args);
+  PyObject* r = CallShim(shim_fn, args);
   if (r == nullptr) return -1;
   PyObject* arg_shapes = PyTuple_GET_ITEM(r, 0);
   tls_shape_data.clear();
@@ -611,6 +611,16 @@ int MXTpuSymbolInferShape(void* sym, int num_in, const char** names,
   *arg_data = tls_shape_data.data();
   Py_DECREF(r);
   return 0;
+}
+
+// Infers all argument shapes from the named input shapes.
+int MXTpuSymbolInferShape(void* sym, int num_in, const char** names,
+                          const int* shape_ind, const int* shape_data,
+                          int* num_arg, const int** arg_ind,
+                          const int** arg_data) {
+  return InferShapeVia("symbol_infer_shape", sym, num_in, names,
+                       shape_ind, shape_data, num_arg, arg_ind,
+                       arg_data);
 }
 
 int MXTpuSymbolGetAttr(void* sym, const char* key, const char** out,
@@ -730,6 +740,91 @@ int MXTpuSymbolInferType(void* sym, int num_in, const char** names,
   Py_DECREF(r);
   return 0;
 }
+
+int MXTpuSymbolCreateGroup(int num, void** syms, void** out) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  PyTuple_SET_ITEM(args, 0, HandleList(syms, num));
+  PyObject* r = CallShim("symbol_create_group", args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+// Same packing as MXTpuSymbolInferShape; unknown shapes come back as
+// zero-length entries (reference MXSymbolInferShapePartial).
+int MXTpuSymbolInferShapePartial(void* sym, int num_in,
+                                 const char** names,
+                                 const int* shape_ind,
+                                 const int* shape_data, int* num_arg,
+                                 const int** arg_ind,
+                                 const int** arg_data) {
+  return InferShapeVia("symbol_infer_shape_partial", sym, num_in,
+                       names, shape_ind, shape_data, num_arg, arg_ind,
+                       arg_data);
+}
+
+// ---------------------------------------------------------- custom op
+
+typedef void (*MXTpuCustomOpCB)(int num_in, void** ins, int num_out,
+                                void** outs, void* payload);
+
+// Register a C-implemented op under `op_type`, then build it like any
+// Custom op (imperative "Custom" invoke / Symbol with op_type param) —
+// reference MXCustomOpRegister. Callback handles are BORROWED.
+int MXTpuCustomOpRegister(const char* op_type, int num_inputs,
+                          int num_outputs, MXTpuCustomOpCB forward,
+                          MXTpuCustomOpCB backward, void* payload) {
+  Gil gil;
+  PyObject* args = PyTuple_New(6);
+  PyTuple_SET_ITEM(args, 0, Str(op_type));
+  PyTuple_SET_ITEM(args, 1, PyLong_FromLong(num_inputs));
+  PyTuple_SET_ITEM(args, 2, PyLong_FromLong(num_outputs));
+  PyTuple_SET_ITEM(args, 3,
+                   PyLong_FromVoidPtr(reinterpret_cast<void*>(forward)));
+  PyTuple_SET_ITEM(args, 4,
+                   PyLong_FromVoidPtr(reinterpret_cast<void*>(backward)));
+  PyTuple_SET_ITEM(args, 5, PyLong_FromVoidPtr(payload));
+  PyObject* r = CallShim("custom_op_register", args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// ---------------------------------------------------------------- rtc
+
+// Pallas-source RTC (the reference MXRtcCreate took CUDA text for
+// NVRTC; here the source text defines a Pallas kernel function).
+int MXTpuRtcCreate(const char* name, const char* py_source,
+                   const char* kernel_fn_name, void** out) {
+  Gil gil;
+  PyObject* args = PyTuple_New(3);
+  PyTuple_SET_ITEM(args, 0, Str(name));
+  PyTuple_SET_ITEM(args, 1, Str(py_source));
+  PyTuple_SET_ITEM(args, 2, Str(kernel_fn_name));
+  PyObject* r = CallShim("rtc_create", args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
+// Launch on NDArrays; results land in the pre-allocated outs (their
+// shapes/dtypes define the kernel's output spec).
+int MXTpuRtcPush(void* h, int num_in, void** ins, int num_out,
+                 void** outs) {
+  Gil gil;
+  PyObject* args = PyTuple_New(3);
+  Py_INCREF(static_cast<PyObject*>(h));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(h));
+  PyTuple_SET_ITEM(args, 1, HandleList(ins, num_in));
+  PyTuple_SET_ITEM(args, 2, HandleList(outs, num_out));
+  PyObject* r = CallShim("rtc_push", args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuRtcFree(void* h) { return MXTpuHandleFree(h); }
 
 // -------------------------------------------------------------- op info
 

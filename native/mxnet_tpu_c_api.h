@@ -166,6 +166,31 @@ int MXTpuSymbolInferType(void* sym, int num_in, const char** names,
                          const int* dtypes, int* num_arg,
                          const int** arg_dtypes);
 
+int MXTpuSymbolCreateGroup(int num, void** syms, void** out);
+int MXTpuSymbolInferShapePartial(void* sym, int num_in,
+                                 const char** names,
+                                 const int* shape_ind,
+                                 const int* shape_data, int* num_arg,
+                                 const int** arg_ind,
+                                 const int** arg_data);
+
+/* ---- Custom ops from C (reference MXCustomOpRegister) ----
+ * Callback handles are BORROWED NDArrays; mutate outputs through the
+ * NDArray ABI. backward may be NULL (zero input gradients). */
+typedef void (*MXTpuCustomOpCB)(int num_in, void** ins, int num_out,
+                                void** outs, void* payload);
+int MXTpuCustomOpRegister(const char* op_type, int num_inputs,
+                          int num_outputs, MXTpuCustomOpCB forward,
+                          MXTpuCustomOpCB backward, void* payload);
+
+/* ---- RTC (reference MXRtcCreate/Push/Free; source text defines a
+ * Pallas kernel function instead of CUDA) ---- */
+int MXTpuRtcCreate(const char* name, const char* py_source,
+                   const char* kernel_fn_name, void** out);
+int MXTpuRtcPush(void* handle, int num_in, void** ins, int num_out,
+                 void** outs);
+int MXTpuRtcFree(void* handle);
+
 /* ---- Op listing / docs (reference MXListAllOpNames,
  * MXSymbolGetAtomicSymbolInfo) ---- */
 int MXTpuListAllOpNames(int* num, const char*** names);

@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""ImageNet-scale ResNet training — the flagship path as user code
+(the reference example/image-classification/train_imagenet.py role).
+
+Feeds an ImageRecordIter over a packed RecordIO file when --data-train
+is given (pack with tools/im2rec.py); otherwise generates a synthetic
+dataset so the script runs anywhere. Defaults follow docs/perf.md:
+NHWC, space-to-depth stem, bf16 compute, fused step via
+KVStore('tpu'); multi-process launches (tools/launch.py) extend the
+same step across hosts.
+
+  python examples/image_classification/train_imagenet.py \\
+      --data-train imagenet.rec --batch-size 256 --num-epochs 90
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+
+def parse_args():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-train", default=None,
+                    help="RecordIO file (tools/im2rec.py); synthetic "
+                         "data when omitted")
+    ap.add_argument("--num-layers", type=int, default=50)
+    ap.add_argument("--num-classes", type=int, default=1000)
+    ap.add_argument("--image-shape", default="3,224,224")
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--num-epochs", type=int, default=1)
+    ap.add_argument("--num-batches", type=int, default=None,
+                    help="synthetic batches per epoch (default 8)")
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--lr-factor", type=float, default=0.1)
+    ap.add_argument("--lr-step-epochs", default="30,60,80")
+    ap.add_argument("--mom", type=float, default=0.9)
+    ap.add_argument("--wd", type=float, default=1e-4)
+    ap.add_argument("--kv-store", default="tpu")
+    ap.add_argument("--layout", default="NHWC",
+                    choices=["NHWC", "NCHW"])
+    ap.add_argument("--stem", default=None,
+                    choices=["standard", "space_to_depth"])
+    ap.add_argument("--dtype", default="bfloat16",
+                    choices=["float32", "bfloat16"])
+    ap.add_argument("--data-nthreads", type=int, default=4)
+    ap.add_argument("--num-examples", type=int, default=1281167,
+                    help="dataset size, sets the lr-decay epoch size "
+                         "for --data-train runs")
+    ap.add_argument("--model-prefix", default=None)
+    ap.add_argument("--disp-batches", type=int, default=20)
+    return ap.parse_args()
+
+
+class _ToNHWC:
+    """DataIter adapter: NCHW RecordIO batches -> channels-last."""
+
+    def __init__(self, it):
+        import mxnet_tpu as mx
+
+        self._mx = mx
+        self.it = it
+        d = it.provide_data[0]
+        n, c, h, w = d[1]
+        self.provide_data = [mx.io.DataDesc(d[0], (n, h, w, c))]
+        self.provide_label = it.provide_label
+        self.batch_size = it.batch_size
+
+    def reset(self):
+        self.it.reset()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    def next(self):
+        mx = self._mx
+        batch = self.it.next()
+        data = [mx.nd.transpose(d, axes=(0, 2, 3, 1))
+                for d in batch.data]
+        return mx.io.DataBatch(data=data, label=batch.label,
+                               pad=batch.pad, index=batch.index)
+
+
+def get_iter(args, channels, height, width):
+    import mxnet_tpu as mx
+
+    n, h, w, c = args.batch_size, height, width, channels
+    if args.data_train:
+        idx = os.path.splitext(args.data_train)[0] + ".idx"
+        it = mx.image.ImageRecordIter(
+            path_imgrec=args.data_train,
+            path_imgidx=idx if os.path.exists(idx) else None,
+            batch_size=n, data_shape=(c, h, w), shuffle=True,
+            preprocess_threads=args.data_nthreads,
+            rand_mirror=True)
+        if args.layout == "NHWC":
+            it = _ToNHWC(it)
+        return it
+    rs = np.random.RandomState(0)
+    batches = args.num_batches or 8
+    shape = (n * batches, c, h, w) if args.layout == "NCHW" \
+        else (n * batches, h, w, c)
+    X = rs.uniform(-1, 1, shape).astype(np.float32)
+    y = rs.randint(0, args.num_classes,
+                   (n * batches,)).astype(np.float32)
+    return mx.io.NDArrayIter(X, y, batch_size=n, shuffle=False,
+                             label_name="softmax_label")
+
+
+def main():
+    args = parse_args()
+
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import get_resnet
+
+    c, h, w = (int(v) for v in args.image_shape.split(","))
+    on_accel = mx.default_context().device_type == "tpu" and \
+        mx.num_devices("tpu") > 0
+    stem = args.stem or (
+        "space_to_depth" if args.layout == "NHWC" and h > 32
+        else "standard")
+
+    net = get_resnet(num_classes=args.num_classes,
+                     num_layers=args.num_layers, image_shape=(c, h, w),
+                     layout=args.layout, stem=stem)
+
+    steps = [int(e) for e in args.lr_step_epochs.split(",") if e]
+    train = get_iter(args, c, h, w)
+    if args.data_train:
+        epoch_size = max(args.num_examples // args.batch_size, 1)
+    else:
+        epoch_size = args.num_batches or 8
+    lr_sched = mx.lr_scheduler.MultiFactorScheduler(
+        step=[s * epoch_size for s in steps],
+        factor=args.lr_factor) if steps else None
+
+    mod = mx.mod.Module(net, context=[mx.default_context()])
+    if args.dtype == "bfloat16" and on_accel:
+        mod.cast_compute(jnp.bfloat16)
+
+    cbs = [mx.callback.Speedometer(args.batch_size,
+                                   args.disp_batches)]
+    epoch_cbs = []
+    if args.model_prefix:
+        epoch_cbs.append(mx.callback.do_checkpoint(args.model_prefix))
+    mod.fit(train, num_epoch=args.num_epochs,
+            eval_metric=["acc", "ce"],
+            kvstore=args.kv_store, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr,
+                              "momentum": args.mom, "wd": args.wd,
+                              **({"lr_scheduler": lr_sched}
+                                 if lr_sched else {})},
+            initializer=mx.initializer.Xavier(rnd_type="gaussian",
+                                              factor_type="in",
+                                              magnitude=2.0),
+            batch_end_callback=cbs, epoch_end_callback=epoch_cbs)
+    print("train_imagenet done")
+
+
+if __name__ == "__main__":
+    main()

@@ -15,6 +15,11 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EXAMPLES = {
     "image_classification/train_mnist.py": [
         "--num-epochs", "1", "--batch-size", "32"],
+    "image_classification/train_imagenet.py": [
+        "--num-layers", "18", "--num-classes", "8",
+        "--image-shape", "3,64,64", "--batch-size", "8",
+        "--num-batches", "2", "--num-epochs", "1",
+        "--dtype", "float32"],
     "rnn/lstm_bucketing.py": [
         "--num-epochs", "1", "--batch-size", "8", "--num-hidden", "16",
         "--num-embed", "8", "--num-layers", "1"],

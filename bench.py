@@ -190,6 +190,7 @@ def main():
         if peak_flops else 0.0
 
     vs = img_s / BASELINE_IMG_S if num_layers == 50 else 0.0
+    mem = mx.memory_stats(ctx)
     _emit({
         "metric": f"resnet{num_layers}_train_throughput_{platform}"
                   f"_b{batch}_{dtype}_{layout.lower()}",
@@ -204,8 +205,10 @@ def main():
             analytic["forward"] / 2.0 / batch / 1e9, 3),
         "peak_flops": peak_flops,
         "layout": layout,
+        "stem": stem,
         "platform": platform,
         "device_kind": getattr(dev, "device_kind", ""),
+        "peak_hbm_bytes": int(mem.get("peak_bytes_in_use", 0)),
     })
 
 

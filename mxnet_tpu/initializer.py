@@ -77,6 +77,15 @@ class Initializer:
             self._init_beta(name, arr)
         elif name.endswith("weight"):
             self._init_weight(name, arr)
+        elif name.endswith("_parameters"):
+            # fused-RNN packed blob (ops/rnn_op.py names it
+            # <name>_parameters, like the reference cudnn RNN op's
+            # single parameter space) — weight-style init
+            self._init_weight(name, arr)
+        elif name.endswith(("_state", "_state_cell")):
+            # RNN initial hidden/cell state inputs (ops/rnn_op.py
+            # auto-created variables) start at zero
+            self._init_zero(name, arr)
         elif name.endswith("moving_mean") or name.endswith("running_mean"):
             self._init_zero(name, arr)
         elif name.endswith("moving_var") or name.endswith("running_var"):

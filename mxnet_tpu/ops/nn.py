@@ -770,6 +770,88 @@ def make_loss(data, grad_scale=1.0, normalization="null", valid_thresh=0.0):
     return _make_loss(data, grad_scale, normalization)
 
 
+def _ctc_arg_names(params):
+    names = ["data", "label"]
+    if coerce_bool(params.get("use_data_lengths", False)):
+        names.append("data_lengths")
+    if coerce_bool(params.get("use_label_lengths", False)):
+        names.append("label_lengths")
+    return names
+
+
+@register(
+    "CTCLoss",
+    arg_names_fn=_ctc_arg_names,
+    coerce={"use_data_lengths": coerce_bool,
+            "use_label_lengths": coerce_bool,
+            "blank_label": lambda v: str(v)},
+    defaults={"use_data_lengths": False, "use_label_lengths": False,
+              "blank_label": "first"},
+    no_grad_inputs=("label", "data_lengths", "label_lengths"),
+    aliases=("ctc_loss", "WarpCTC"),
+)
+def ctc_loss(*inputs, use_data_lengths=False, use_label_lengths=False,
+             blank_label="first"):
+    """Connectionist Temporal Classification loss (reference
+    plugin/warpctc + contrib ctc_loss). data is (T, N, C) activations
+    (softmax applied internally, the warpctc convention); label is
+    (N, L). With blank_label='first' (default) the blank is id 0,
+    classes are 1..C-1, and label padding is 0; with 'last' the blank
+    is C-1 and label padding is any NEGATIVE id (the reference's -1
+    convention). `use_data_lengths`/`use_label_lengths` add the
+    corresponding (N,) length inputs, masking padded frames/labels.
+    Returns per-example costs (N,); gradients flow to data via jax
+    autodiff of the log-alpha recursion (optax's CTC).
+    """
+    import optax
+
+    if blank_label not in ("first", "last"):
+        raise MXNetError(
+            f"CTCLoss: blank_label must be 'first' or 'last', got "
+            f"{blank_label!r}")
+    # positional inputs follow _ctc_arg_names' order (the lengths are
+    # present exactly when the corresponding use_* flag is set)
+    want = 2 + int(use_data_lengths) + int(use_label_lengths)
+    if len(inputs) != want:
+        raise MXNetError(
+            f"CTCLoss: expected {want} inputs "
+            f"({', '.join(_ctc_arg_names({'use_data_lengths': use_data_lengths, 'use_label_lengths': use_label_lengths}))}), "
+            f"got {len(inputs)}")
+    data, label = inputs[0], inputs[1]
+    idx = 2
+    data_lengths = label_lengths = None
+    if use_data_lengths:
+        data_lengths = inputs[idx]
+        idx += 1
+    if use_label_lengths:
+        label_lengths = inputs[idx]
+
+    T, N, C = data.shape
+    logits = jnp.transpose(data, (1, 0, 2))  # (N, T, C)
+    if use_data_lengths:
+        t_idx = jnp.arange(T, dtype=jnp.float32)[None, :]
+        logit_pads = (t_idx >= data_lengths.astype(
+            jnp.float32).reshape(-1, 1)).astype(logits.dtype)
+    else:
+        logit_pads = jnp.zeros((N, T), dtype=logits.dtype)
+    lab = label.astype(jnp.int32)
+    if blank_label == "first":
+        blank_id = 0
+        pads = (lab <= 0)
+    else:
+        blank_id = C - 1
+        pads = (lab < 0)
+    if use_label_lengths:
+        l_idx = jnp.arange(lab.shape[1], dtype=jnp.int32)[None, :]
+        pads = pads | (l_idx >= label_lengths.astype(
+            jnp.int32).reshape(-1, 1))
+    # padded slots must hold a safe id for the gather inside optax
+    lab = jnp.where(pads, blank_id, lab)
+    return optax.ctc_loss(logits, logit_pads, lab,
+                          pads.astype(logits.dtype),
+                          blank_id=blank_id)
+
+
 @register(
     "softmax_cross_entropy",
     arg_names=["data", "label"],

@@ -23,6 +23,12 @@ sys.path.insert(
         os.path.abspath(__file__))))
 )
 
+import jax  # noqa: E402
+
+# setdefault loses when the env pre-pins JAX_PLATFORMS=axon (the
+# sitecustomize case conftest.py:18-25 documents); force the config too
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 
 import mxnet_tpu as mx  # noqa: E402

@@ -1,0 +1,143 @@
+"""CTCLoss op (reference plugin/warpctc + contrib ctc_loss): values
+against a brute-force alignment enumeration, gradient flow, and the
+Symbol/Executor path."""
+import itertools
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _brute_ctc_nll(acts, labels):
+    """-log P(labels | softmax(acts)) by enumerating ALL alignment
+    paths (blank=0). acts: (T, C); labels: list of ids (no blanks)."""
+    T, C = acts.shape
+    e = np.exp(acts - acts.max(axis=1, keepdims=True))
+    probs = e / e.sum(axis=1, keepdims=True)
+
+    def collapse(path):
+        out = []
+        prev = None
+        for p in path:
+            if p != 0 and p != prev:
+                out.append(p)
+            prev = p
+        return out
+
+    total = 0.0
+    for path in itertools.product(range(C), repeat=T):
+        if collapse(path) == list(labels):
+            p = 1.0
+            for t, k in enumerate(path):
+                p *= probs[t, k]
+            total += p
+    return -np.log(total)
+
+
+def test_ctc_matches_bruteforce():
+    rs = np.random.RandomState(0)
+    T, N, C = 4, 3, 4
+    acts = rs.randn(T, N, C).astype(np.float32)
+    labels = np.array([[1, 2], [3, 0], [2, 2]], np.float32)  # 0 pads
+
+    data = mx.nd.array(acts)
+    lab = mx.nd.array(labels)
+    costs = mx.nd.ctc_loss(data, lab).asnumpy()
+
+    for i in range(N):
+        want = _brute_ctc_nll(
+            acts[:, i, :], [int(v) for v in labels[i] if v != 0])
+        np.testing.assert_allclose(costs[i], want, rtol=1e-4,
+                                   err_msg=f"example {i}")
+
+
+def test_ctc_gradient_flows_symbolically():
+    T, N, C = 5, 2, 3
+    rs = np.random.RandomState(1)
+    sym = mx.sym.CTCLoss(data=mx.sym.Variable("data"),
+                         label=mx.sym.Variable("label"), name="ctc")
+    sym = mx.sym.MakeLoss(sym)
+    ex = sym.simple_bind(ctx=mx.cpu(), grad_req="write",
+                         data=(T, N, C), label=(N, 2))
+    x = rs.randn(T, N, C).astype(np.float32)
+    ex.arg_dict["data"][:] = x
+    ex.arg_dict["label"][:] = np.array([[1, 2], [2, 0]], np.float32)
+    cost0 = ex.forward(is_train=True)[0].asnumpy().sum()
+    ex.backward()
+    g = ex.grad_dict["data"].asnumpy()
+    assert np.abs(g).max() > 0
+
+    # finite-difference check on a few coordinates
+    eps = 1e-2
+    for idx in [(0, 0, 0), (2, 1, 2), (4, 0, 1)]:
+        xp = x.copy()
+        xp[idx] += eps
+        ex.arg_dict["data"][:] = xp
+        cp = ex.forward(is_train=True)[0].asnumpy().sum()
+        xm = x.copy()
+        xm[idx] -= eps
+        ex.arg_dict["data"][:] = xm
+        cm = ex.forward(is_train=True)[0].asnumpy().sum()
+        num = (cp - cm) / (2 * eps)
+        np.testing.assert_allclose(g[idx], num, rtol=5e-2, atol=5e-3)
+
+
+def test_ctc_blank_last_convention():
+    rs = np.random.RandomState(2)
+    T, N, C = 4, 1, 4
+    acts = rs.randn(T, N, C).astype(np.float32)
+    # blank moved to the last channel: same task as blank-first with
+    # channels rotated
+    lab_first = np.array([[1, 2]], np.float32)
+    c_first = mx.nd.ctc_loss(mx.nd.array(acts),
+                             mx.nd.array(lab_first)).asnumpy()
+    rolled = np.roll(acts, -1, axis=2)  # channel k -> k-1, blank -> C-1
+    lab_last = np.array([[0, 1]], np.float32)
+    # padding id for 'last' is C-1; this label has none
+    c_last = mx.nd.CTCLoss(mx.nd.array(rolled),
+                           mx.nd.array(lab_last),
+                           blank_label="last").asnumpy()
+    np.testing.assert_allclose(c_first, c_last, rtol=1e-5)
+
+
+def test_ctc_data_lengths_mask_padded_frames():
+    rs = np.random.RandomState(3)
+    T, N, C = 6, 2, 4
+    acts = rs.randn(T, N, C).astype(np.float32)
+    labels = np.array([[1, 2], [3, 0]], np.float32)
+    lengths = np.array([4, 6], np.float32)
+
+    masked = mx.nd.CTCLoss(
+        mx.nd.array(acts), mx.nd.array(labels),
+        mx.nd.array(lengths), use_data_lengths=True).asnumpy()
+    # example 0 truncated to 4 frames must match a plain 4-frame CTC
+    short = mx.nd.ctc_loss(
+        mx.nd.array(acts[:4, :1, :]),
+        mx.nd.array(labels[:1])).asnumpy()
+    np.testing.assert_allclose(masked[0], short[0], rtol=1e-5)
+    full = mx.nd.ctc_loss(
+        mx.nd.array(acts[:, 1:, :]), mx.nd.array(labels[1:])).asnumpy()
+    np.testing.assert_allclose(masked[1], full[0], rtol=1e-5)
+
+
+def test_ctc_label_lengths_and_negative_padding():
+    rs = np.random.RandomState(4)
+    T, N, C = 5, 1, 4
+    acts = rs.randn(T, N, C).astype(np.float32)
+    via_len = mx.nd.CTCLoss(
+        mx.nd.array(acts), mx.nd.array(np.array([[1, 2, 3]], np.float32)),
+        mx.nd.array(np.array([2.0], np.float32)),
+        use_label_lengths=True).asnumpy()
+    via_pad = mx.nd.ctc_loss(
+        mx.nd.array(acts), mx.nd.array(np.array([[1, 2, 0]], np.float32))
+    ).asnumpy()
+    np.testing.assert_allclose(via_len, via_pad, rtol=1e-5)
+
+    # 'last' convention: -1 padding (the reference form)
+    rolled = np.roll(acts, -1, axis=2)
+    c_last = mx.nd.CTCLoss(
+        mx.nd.array(rolled),
+        mx.nd.array(np.array([[0, 1, -1]], np.float32)),
+        blank_label="last").asnumpy()
+    np.testing.assert_allclose(c_last, via_pad, rtol=1e-5)

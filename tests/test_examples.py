@@ -29,6 +29,7 @@ EXAMPLES = {
     "long_context/ring_attention_demo.py": [],
     "distributed/dist_train.py": [],
     "gan/dcgan_mnist.py": ["--epochs", "1", "--batch", "32"],
+    "speech/lstm_ctc.py": ["--epochs", "10"],
     "autoencoder/ae_mnist.py": [],
 }
 

@@ -345,6 +345,9 @@ def _build_cases():
     lab3 = np.array([0, 1, 2], np.float32)
     c["SoftmaxOutput"] = [Case([x34(57), lab3], {})]
     c["softmax_cross_entropy"] = [Case([x34(58), lab3], {})]
+    c["CTCLoss"] = [Case(
+        [_r(4, 2, 5, seed=158), np.array([[1, 2], [3, 0]], np.float32)],
+        {})]
     c["LinearRegressionOutput"] = [Case([x34(59), x34(60)], {})]
     c["MAERegressionOutput"] = [Case([x34(61), x34(62)], {})]
     c["LogisticRegressionOutput"] = [Case([x34(63), x34(64)], {})]

@@ -82,8 +82,9 @@ def main():
     # give it a flat Uniform (or attach a FusedRNN initializer via
     # Variable(init=...) for per-gate treatment)
     mod.init_params(mx.initializer.Mixed(
-        [".*_parameters", ".*"],
-        [mx.initializer.Uniform(0.1), mx.initializer.Xavier()]))
+        [".*_parameters", ".*_state(_cell)?$", ".*"],
+        [mx.initializer.Uniform(0.1), mx.initializer.Zero(),
+         mx.initializer.Xavier()]))
     mod.init_optimizer(optimizer="adam",
                        optimizer_params={"learning_rate": args.lr})
 

@@ -803,7 +803,12 @@ def ctc_loss(*inputs, use_data_lengths=False, use_label_lengths=False,
     Returns per-example costs (N,); gradients flow to data via jax
     autodiff of the log-alpha recursion (optax's CTC).
     """
-    import optax
+    try:
+        import optax
+    except ImportError as exc:  # pragma: no cover - env without optax
+        raise MXNetError(
+            "CTCLoss needs the optax package for its CTC core "
+            "(pip install optax)") from exc
 
     if blank_label not in ("first", "last"):
         raise MXNetError(

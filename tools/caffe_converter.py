@@ -1,0 +1,296 @@
+#!/usr/bin/env python
+"""Convert a Caffe prototxt network definition into an mxnet_tpu
+Symbol (reference tools/caffe_converter/ role: import models authored
+in Caffe).
+
+Parses protobuf TEXT format with a self-contained recursive parser (no
+caffe/protobuf dependency) and maps the common layer types:
+
+  Convolution, InnerProduct, Pooling (MAX/AVE), ReLU, Sigmoid, TanH,
+  LRN, Dropout, Softmax, SoftmaxWithLoss, Accuracy (skipped),
+  BatchNorm (+ following Scale folded in), Concat, Eltwise (SUM/PROD/
+  MAX), Flatten, Input/Data layers.
+
+Weight conversion from binary .caffemodel is out of scope here (that
+needs the caffe protobuf schema); pair this with
+tools/model_converter.py when the weights come via torch, or load
+Caffe-exported numpy blobs manually — the layer/param NAME mapping
+this tool emits matches what those expect (<layer>_weight/_bias,
+BatchNorm gamma/beta/moving_mean/moving_var).
+
+Usage:
+  python tools/caffe_converter.py deploy.prototxt out-symbol.json
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# ------------------------------------------------- prototxt text parser
+
+_TOKEN = re.compile(
+    r"""\s*(?:(?P<comment>\#[^\n]*)|(?P<brace>[{}])|(?P<colon>:)|"""
+    r"""(?P<string>"(?:[^"\\]|\\.)*")|(?P<word>[^\s{}:"#]+))""",
+    re.S)
+
+
+def _tokens(text):
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if m is None:
+            break
+        pos = m.end()
+        if m.lastgroup == "comment":
+            continue
+        yield m.lastgroup, (m.group(m.lastgroup))
+
+
+def parse_prototxt(text):
+    """-> nested message dict; repeated fields become lists."""
+    tokens = list(_tokens(text))
+    i = 0
+
+    def coerce(word):
+        if word.startswith('"'):
+            return word[1:-1]
+        low = word.lower()
+        if low in ("true", "false"):
+            return low == "true"
+        try:
+            return int(word)
+        except ValueError:
+            pass
+        try:
+            return float(word)
+        except ValueError:
+            return word
+
+    def parse_msg(depth):
+        nonlocal i
+        out = {}
+
+        def put(key, value):
+            if key in out:
+                if not isinstance(out[key], list):
+                    out[key] = [out[key]]
+                out[key].append(value)
+            else:
+                out[key] = value
+
+        while i < len(tokens):
+            kind, val = tokens[i]
+            if kind == "brace" and val == "}":
+                i += 1
+                return out
+            if kind != "word":
+                raise ValueError(f"unexpected token {val!r}")
+            key = val
+            i += 1
+            kind, val = tokens[i]
+            if kind == "colon":
+                i += 1
+                kind, val = tokens[i]
+                if kind == "brace" and val == "{":
+                    i += 1
+                    put(key, parse_msg(depth + 1))
+                else:
+                    i += 1
+                    put(key, coerce(val) if kind != "string"
+                        else val[1:-1])
+            elif kind == "brace" and val == "{":
+                i += 1
+                put(key, parse_msg(depth + 1))
+            else:
+                raise ValueError(f"expected ':' or '{{' after {key!r}")
+        if depth != 0:
+            raise ValueError("unbalanced braces")
+        return out
+
+    return parse_msg(0)
+
+
+# ----------------------------------------------------- layer conversion
+
+def _as_list(v):
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
+
+
+def _kern(p, key, key_h, key_w, default=0):
+    if key in p:
+        v = _as_list(p[key])[0]
+        return (int(v), int(v))
+    return (int(p.get(key_h, default)), int(p.get(key_w, default)))
+
+
+def convert(net_msg):
+    """-> (Symbol, report list). Layers map 1:1 where possible; a
+    Scale layer directly after BatchNorm folds into it (caffe's BN is
+    stats-only; the affine lives in Scale)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+
+    layers = _as_list(net_msg.get("layer") or net_msg.get("layers"))
+    blobs = {}
+    report = []
+
+    def top_of(layer):
+        return _as_list(layer.get("top"))[0]
+
+    def bottoms(layer):
+        return [blobs[b] for b in _as_list(layer.get("bottom"))]
+
+    # network input (deploy-style: input/input_dim or an Input layer)
+    if "input" in net_msg:
+        blobs[_as_list(net_msg["input"])[0]] = sym.Variable("data")
+
+    pending_bn = {}  # top name -> (bn inputs) awaiting a Scale fold
+
+    for layer in layers:
+        ltype = str(layer.get("type", "")).upper()
+        name = str(layer.get("name", f"layer{len(report)}"))
+        if ltype in ("INPUT", "DATA"):
+            blobs[top_of(layer)] = sym.Variable("data")
+            report.append((name, ltype, "data"))
+            continue
+        if ltype == "ACCURACY":
+            report.append((name, ltype, "skipped"))
+            continue
+
+        if ltype == "CONVOLUTION":
+            p = layer.get("convolution_param", {})
+            b = bottoms(layer)[0]
+            kernel = _kern(p, "kernel_size", "kernel_h", "kernel_w")
+            stride = _kern(p, "stride", "stride_h", "stride_w", 1)
+            pad = _kern(p, "pad", "pad_h", "pad_w", 0)
+            out = sym.Convolution(
+                b, name=name, num_filter=int(p["num_output"]),
+                kernel=kernel, stride=stride, pad=pad,
+                num_group=int(p.get("group", 1)),
+                no_bias=not p.get("bias_term", True))
+        elif ltype == "INNER_PRODUCT" or ltype == "INNERPRODUCT":
+            p = layer.get("inner_product_param", {})
+            out = sym.FullyConnected(
+                bottoms(layer)[0], name=name,
+                num_hidden=int(p["num_output"]),
+                no_bias=not p.get("bias_term", True))
+        elif ltype == "POOLING":
+            p = layer.get("pooling_param", {})
+            pool = str(p.get("pool", "MAX")).upper()
+            if p.get("global_pooling", False):
+                out = sym.Pooling(
+                    bottoms(layer)[0], name=name, global_pool=True,
+                    pool_type="avg" if pool == "AVE" else "max")
+            else:
+                out = sym.Pooling(
+                    bottoms(layer)[0], name=name,
+                    kernel=_kern(p, "kernel_size", "kernel_h",
+                                 "kernel_w"),
+                    stride=_kern(p, "stride", "stride_h", "stride_w",
+                                 1),
+                    pad=_kern(p, "pad", "pad_h", "pad_w", 0),
+                    pool_type="avg" if pool == "AVE" else "max",
+                    # caffe pools use ceil output sizing
+                    pooling_convention="full")
+        elif ltype == "RELU":
+            out = sym.Activation(bottoms(layer)[0], name=name,
+                                 act_type="relu")
+        elif ltype == "SIGMOID":
+            out = sym.Activation(bottoms(layer)[0], name=name,
+                                 act_type="sigmoid")
+        elif ltype == "TANH":
+            out = sym.Activation(bottoms(layer)[0], name=name,
+                                 act_type="tanh")
+        elif ltype == "LRN":
+            p = layer.get("lrn_param", {})
+            out = sym.LRN(bottoms(layer)[0], name=name,
+                          nsize=int(p.get("local_size", 5)),
+                          alpha=float(p.get("alpha", 1e-4)),
+                          beta=float(p.get("beta", 0.75)))
+        elif ltype == "DROPOUT":
+            p = layer.get("dropout_param", {})
+            out = sym.Dropout(bottoms(layer)[0], name=name,
+                              p=float(p.get("dropout_ratio", 0.5)))
+        elif ltype == "BATCHNORM":
+            p = layer.get("batch_norm_param", {})
+            out = sym.BatchNorm(
+                bottoms(layer)[0], name=name,
+                eps=float(p.get("eps", 1e-5)), fix_gamma=True,
+                use_global_stats=bool(p.get("use_global_stats",
+                                            False)))
+            pending_bn[top_of(layer)] = (bottoms(layer)[0], name, p)
+        elif ltype == "SCALE":
+            src = _as_list(layer.get("bottom"))[0]
+            if src in pending_bn:
+                # refold: BN with learnable gamma/beta replaces the
+                # stats-only BN + Scale pair
+                bn_in, bn_name, p = pending_bn.pop(src)
+                out = sym.BatchNorm(
+                    bn_in, name=bn_name,
+                    eps=float(p.get("eps", 1e-5)), fix_gamma=False,
+                    use_global_stats=bool(p.get("use_global_stats",
+                                                False)))
+                report.append((name, ltype, f"folded into {bn_name}"))
+                blobs[top_of(layer)] = out
+                continue
+            raise ValueError(
+                f"standalone Scale layer {name!r} (not after "
+                f"BatchNorm) is not supported")
+        elif ltype == "CONCAT":
+            p = layer.get("concat_param", {})
+            out = sym.Concat(*bottoms(layer), name=name,
+                             dim=int(p.get("axis", 1)))
+        elif ltype == "ELTWISE":
+            p = layer.get("eltwise_param", {})
+            op = str(p.get("operation", "SUM")).upper()
+            ins = bottoms(layer)
+            out = ins[0]
+            for other in ins[1:]:
+                if op == "SUM":
+                    out = out + other
+                elif op == "PROD":
+                    out = out * other
+                elif op == "MAX":
+                    out = sym.maximum(out, other)
+                else:
+                    raise ValueError(f"eltwise op {op!r}")
+        elif ltype == "FLATTEN":
+            out = sym.Flatten(bottoms(layer)[0], name=name)
+        elif ltype in ("SOFTMAX", "SOFTMAXWITHLOSS", "SOFTMAX_LOSS"):
+            out = sym.SoftmaxOutput(bottoms(layer)[0], name=name)
+        else:
+            raise ValueError(
+                f"unsupported caffe layer type {ltype!r} ({name})")
+        blobs[top_of(layer)] = out
+        report.append((name, ltype, "ok"))
+
+    if not layers:
+        raise ValueError("prototxt defines no layers")
+    last = blobs[top_of(layers[-1])] if top_of(layers[-1]) in blobs \
+        else list(blobs.values())[-1]
+    return last, report
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prototxt")
+    ap.add_argument("out_json")
+    args = ap.parse_args(argv)
+    with open(args.prototxt) as f:
+        msg = parse_prototxt(f.read())
+    symbol, report = convert(msg)
+    symbol.save(args.out_json)
+    for name, ltype, status in report:
+        print(f"{name} ({ltype}): {status}")
+    print(f"saved {args.out_json}")
+
+
+if __name__ == "__main__":
+    main()

@@ -109,3 +109,11 @@ def test_yarn_command_quoting():
     assert "mkdir /shared/rdv.claim.$i" in payload
     assert '$(cat /shared/rdv):12345' in payload
     assert inner  # quoting round-trips
+
+
+def test_bench_transformer_emits_json():
+    rec = _run_tool("bench_transformer.py", [
+        "--batch", "2", "--seq", "64", "--d-model", "32",
+        "--d-ff", "64", "--num-layers", "1", "--iters", "2"])[-1]
+    assert rec["unit"] == "tokens/s" and rec["value"] > 0
+    assert rec["step_flops_analytic"] > 0

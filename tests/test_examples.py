@@ -30,6 +30,7 @@ EXAMPLES = {
     "distributed/dist_train.py": [],
     "gan/dcgan_mnist.py": ["--epochs", "1", "--batch", "32"],
     "speech/lstm_ctc.py": ["--epochs", "10"],
+    "multi_task/multitask_mnist.py": ["--epochs", "6"],
     "autoencoder/ae_mnist.py": [],
 }
 

@@ -117,3 +117,31 @@ def test_bench_transformer_emits_json():
         "--d-ff", "64", "--num-layers", "1", "--iters", "2"])[-1]
     assert rec["unit"] == "tokens/s" and rec["value"] > 0
     assert rec["step_flops_analytic"] > 0
+
+
+def test_kill_mxnet_dry_run():
+    import subprocess as sp
+    import time
+
+    marker = "kmx_sentinel_sleep"
+    victim = sp.Popen([sys.executable, "-c",
+                       f"import time  # {marker}\ntime.sleep(60)"])
+    try:
+        time.sleep(0.5)
+        proc = sp.run(
+            [sys.executable, os.path.join(ROOT, "tools/kill_mxnet.py"),
+             "-p", marker, "--dry-run"],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        assert f"would kill {victim.pid}" in proc.stdout
+        assert victim.poll() is None  # dry run left it alive
+
+        proc = sp.run(
+            [sys.executable, os.path.join(ROOT, "tools/kill_mxnet.py"),
+             "-p", marker],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        victim.wait(timeout=10)  # killed for real
+    finally:
+        if victim.poll() is None:
+            victim.kill()

@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Kill stray training processes (reference tools/kill-mxnet.py role:
+after a crashed distributed run, clear every worker on every host).
+
+With no -p, matches processes whose ENVIRONMENT carries the launch.py
+worker marker (MXNET_TPU_WORKER_ID — read from /proc/<pid>/environ,
+since env vars never appear on command lines). With -p, matches the
+pattern against command lines instead. --hostfile repeats the sweep
+over ssh (cmdline patterns only there; pass -p).
+
+  python tools/kill_mxnet.py                       # local worker sweep
+  python tools/kill_mxnet.py -p train_imagenet.py  # by script name
+  python tools/kill_mxnet.py -p train.py -H hosts  # whole cluster
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import signal
+import subprocess
+import sys
+
+_ENV_MARKER = "MXNET_TPU_WORKER_ID"
+
+
+def _ppid(pid):
+    with open(f"/proc/{pid}/stat") as f:
+        stat = f.read()
+    # comm may contain spaces/parens: field 4 counted AFTER the last ')'
+    return int(stat.rsplit(")", 1)[1].split()[1])
+
+
+def _ancestors():
+    skip = set()
+    pid = os.getpid()
+    for _ in range(32):
+        try:
+            pid = _ppid(pid)
+        except Exception:
+            break
+        if pid <= 0:
+            break
+        skip.add(pid)
+    return skip
+
+
+def _env_has_marker(pid):
+    try:
+        with open(f"/proc/{pid}/environ", "rb") as f:
+            return _ENV_MARKER.encode() in f.read()
+    except Exception:
+        return False
+
+
+def find_pids(pattern=None):
+    """-> [(pid, cmdline)]. pattern=None matches the worker env
+    marker; otherwise the command line."""
+    out = subprocess.run(
+        ["ps", "-eo", "pid=,args="], capture_output=True, text=True,
+    ).stdout
+    me = os.getpid()
+    skip = _ancestors()
+    hits = []
+    for line in out.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        pid_s, _, cmd = line.partition(" ")
+        try:
+            pid = int(pid_s)
+        except ValueError:
+            continue
+        if pid == me or pid in skip or "kill_mxnet" in cmd:
+            continue
+        if pattern is None:
+            if _env_has_marker(pid):
+                hits.append((pid, cmd.strip()))
+        elif pattern in cmd:
+            hits.append((pid, cmd.strip()))
+    return hits
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-p", "--pattern", default=None,
+                    help="match this substring of the command line "
+                         "(default: match the launch.py worker env "
+                         "marker via /proc/<pid>/environ)")
+    ap.add_argument("-H", "--hostfile", default=None)
+    ap.add_argument("-9", "--force", action="store_true",
+                    help="SIGKILL instead of SIGTERM")
+    ap.add_argument("-n", "--dry-run", action="store_true")
+    args = ap.parse_args(argv)
+
+    sig = signal.SIGKILL if args.force else signal.SIGTERM
+    hits = find_pids(args.pattern)
+    for pid, cmd in hits:
+        print(f"{'would kill' if args.dry_run else 'killing'} "
+              f"{pid}: {cmd[:100]}")
+        if not args.dry_run:
+            try:
+                os.kill(pid, sig)
+            except ProcessLookupError:
+                pass
+    if not hits:
+        what = args.pattern or f"env marker {_ENV_MARKER}"
+        print(f"no processes match {what!r}")
+
+    if args.hostfile:
+        if not args.pattern:
+            ap.error("--hostfile needs -p (remote sweeps match "
+                     "command lines; the env marker is not visible "
+                     "over pkill)")
+        with open(args.hostfile) as f:
+            hosts = [ln.split()[0] for ln in f
+                     if ln.strip() and not ln.startswith("#")]
+        # bracket the first char so pkill -f cannot match the remote
+        # shell carrying the pattern in its own command line
+        safe = "[" + args.pattern[0] + "]" + args.pattern[1:]
+        remote = ("pkill " + ("-9 " if args.force else "") + "-f "
+                  + shlex.quote(safe) + " || true")
+        for host in hosts:
+            print(f"{host}: {remote}")
+            if not args.dry_run:
+                subprocess.run(
+                    ["ssh", "-o", "StrictHostKeyChecking=no", host,
+                     remote])
+
+
+if __name__ == "__main__":
+    main()

@@ -157,6 +157,16 @@ def symbol_from_json(js):
     return sym.loads(js)
 
 
+def symbol_from_file(fname):
+    from . import symbol as sym
+
+    return sym.load(fname)
+
+
+def symbol_save_to_file(s, fname):
+    s.save(fname)
+
+
 def symbol_to_json(s):
     return s.tojson()
 
@@ -458,6 +468,37 @@ def dataiter_pad(cit):
     return int(cit.batch.pad or 0)
 
 
+def dataiter_index(cit):
+    """-> per-example indices of the current batch, or [] when the
+    iterator doesn't track them (reference MXDataIterGetIndex)."""
+    if cit.batch is None:
+        raise MXNetError("no current batch (call Next first)")
+    idx = cit.batch.index
+    return [] if idx is None else [int(i) for i in idx]
+
+
+def dataiter_info(name):
+    """-> (description, [param names]) for a registered iterator
+    (reference MXDataIterGetIterInfo)."""
+    import importlib
+
+    if name not in _DATAITERS:
+        raise MXNetError(f"unknown data iter {name!r}")
+    mod_name, cls_name = _DATAITERS[name]
+    cls = getattr(importlib.import_module("mxnet_tpu." + mod_name),
+                  cls_name)
+    import inspect
+
+    doc = (cls.__doc__ or cls_name).strip()
+    sig = inspect.signature(cls.__init__)
+    params = [
+        n for n, p in sig.parameters.items()
+        if n != "self" and p.kind not in (p.VAR_KEYWORD,
+                                          p.VAR_POSITIONAL)
+    ]
+    return doc, params
+
+
 # -------------------------------------------------------------- kvstore
 
 def kvstore_create(kv_type):
@@ -523,6 +564,14 @@ def kvstore_set_optimizer(kv, opt_name, params):
 
     kwargs = {k: _coerce_str_param(v) for k, v in params.items()}
     kv.set_optimizer(opt.create(opt_name, **kwargs))
+
+
+def kvstore_set_barrier_before_exit(kv, flag):
+    """Accepted no-op stub (reference MXKVStoreSetBarrierBeforeExit):
+    the coordination-service backend always tears down collectively,
+    so there is no optional exit barrier to toggle; the flag is
+    recorded only for introspection."""
+    kv._barrier_before_exit = bool(flag)
 
 
 def kvstore_run_server(kv):

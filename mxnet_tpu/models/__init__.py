@@ -9,6 +9,7 @@ from .mlp import get_mlp
 from .lenet import get_lenet
 from .resnet import get_resnet
 from .alexnet import get_alexnet
+from .googlenet import get_googlenet
 from .inception import get_inception_bn
 from .vgg import get_vgg
 from .lstm_lm import get_lstm_lm, lstm_lm_sym_gen

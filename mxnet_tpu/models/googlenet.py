@@ -1,0 +1,55 @@
+"""GoogLeNet / Inception-v1 (reference
+example/image-classification/symbol_googlenet.py): the plain (no
+BatchNorm) inception network — 3x3-reduce / 5x5-reduce / pool-proj
+branches concatenated per block."""
+from .. import symbol as sym
+
+
+def _conv(data, num_filter, kernel, stride=(1, 1), pad=(0, 0),
+          name=None):
+    c = sym.Convolution(data, name=name, num_filter=num_filter,
+                        kernel=kernel, stride=stride, pad=pad)
+    return sym.Activation(c, name=f"{name}_relu", act_type="relu")
+
+
+def _inception(data, n1x1, n3x3r, n3x3, n5x5r, n5x5, proj, name):
+    b1 = _conv(data, n1x1, (1, 1), name=f"{name}_1x1")
+    b2 = _conv(data, n3x3r, (1, 1), name=f"{name}_3x3_reduce")
+    b2 = _conv(b2, n3x3, (3, 3), pad=(1, 1), name=f"{name}_3x3")
+    b3 = _conv(data, n5x5r, (1, 1), name=f"{name}_5x5_reduce")
+    b3 = _conv(b3, n5x5, (5, 5), pad=(2, 2), name=f"{name}_5x5")
+    b4 = sym.Pooling(data, kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+                     pool_type="max", name=f"{name}_pool")
+    b4 = _conv(b4, proj, (1, 1), name=f"{name}_proj")
+    return sym.Concat(b1, b2, b3, b4, dim=1, name=f"{name}_concat")
+
+
+def get_googlenet(num_classes=1000):
+    data = sym.Variable("data")
+    body = _conv(data, 64, (7, 7), stride=(2, 2), pad=(3, 3),
+                 name="conv1")
+    body = sym.Pooling(body, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                       pool_type="max", name="pool1")
+    body = _conv(body, 64, (1, 1), name="conv2_reduce")
+    body = _conv(body, 192, (3, 3), pad=(1, 1), name="conv2")
+    body = sym.Pooling(body, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                       pool_type="max", name="pool2")
+    body = _inception(body, 64, 96, 128, 16, 32, 32, "in3a")
+    body = _inception(body, 128, 128, 192, 32, 96, 64, "in3b")
+    body = sym.Pooling(body, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                       pool_type="max", name="pool3")
+    body = _inception(body, 192, 96, 208, 16, 48, 64, "in4a")
+    body = _inception(body, 160, 112, 224, 24, 64, 64, "in4b")
+    body = _inception(body, 128, 128, 256, 24, 64, 64, "in4c")
+    body = _inception(body, 112, 144, 288, 32, 64, 64, "in4d")
+    body = _inception(body, 256, 160, 320, 32, 128, 128, "in4e")
+    body = sym.Pooling(body, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                       pool_type="max", name="pool4")
+    body = _inception(body, 256, 160, 320, 32, 128, 128, "in5a")
+    body = _inception(body, 384, 192, 384, 48, 128, 128, "in5b")
+    body = sym.Pooling(body, global_pool=True, kernel=(7, 7),
+                       pool_type="avg", name="global_pool")
+    body = sym.Dropout(body, p=0.4, name="drop")
+    flat = sym.Flatten(body, name="flatten")
+    fc = sym.FullyConnected(flat, num_hidden=num_classes, name="fc1")
+    return sym.SoftmaxOutput(fc, name="softmax")

@@ -330,6 +330,17 @@ int MXTpuNDArrayLoad(const char* fname, int* num_out, void*** out,
   return 0;
 }
 
+// helper: call shim fn(path string) and return the NEW handle
+static int PathCreate(const char* fn, const char* path, void** out) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  PyTuple_SET_ITEM(args, 0, Str(path));
+  PyObject* r = CallShim(fn, args);
+  if (r == nullptr) return -1;
+  *out = r;
+  return 0;
+}
+
 // helper: call shim fn(handle) and return the NEW handle it produces
 static int HandleUnary(const char* fn, void* h, void** out) {
   Gil gil;
@@ -559,6 +570,22 @@ int MXTpuSymbolToJSON(void* sym, const char** out_json) {
   tls_strs.clear();
   tls_strs.emplace_back(PyUnicode_AsUTF8(r));
   *out_json = tls_strs.back().c_str();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuSymbolCreateFromFile(const char* fname, void** out) {
+  return PathCreate("symbol_from_file", fname, out);
+}
+
+int MXTpuSymbolSaveToFile(void* sym, const char* fname) {
+  Gil gil;
+  PyObject* args = PyTuple_New(2);
+  Py_INCREF(static_cast<PyObject*>(sym));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(sym));
+  PyTuple_SET_ITEM(args, 1, Str(fname));
+  PyObject* r = CallShim("symbol_save_to_file", args);
+  if (r == nullptr) return -1;
   Py_DECREF(r);
   return 0;
 }
@@ -874,16 +901,6 @@ int MXTpuOpGetInfo(const char* op, const char** description,
 }
 
 // ------------------------------------------------------------ RecordIO
-
-static int PathCreate(const char* fn, const char* path, void** out) {
-  Gil gil;
-  PyObject* args = PyTuple_New(1);
-  PyTuple_SET_ITEM(args, 0, Str(path));
-  PyObject* r = CallShim(fn, args);
-  if (r == nullptr) return -1;
-  *out = r;
-  return 0;
-}
 
 int MXTpuRecordIOWriterCreate(const char* path, void** out) {
   return PathCreate("recordio_writer_create", path, out);
@@ -1262,6 +1279,56 @@ int MXTpuDataIterGetLabel(void* it, void** out) {
   return DataIterFetch(it, "label", out);
 }
 
+// Current batch's per-example indices; *num = 0 when untracked
+// (reference MXDataIterGetIndex).
+int MXTpuDataIterGetIndex(void* it, int* num, const int** out) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  Py_INCREF(static_cast<PyObject*>(it));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(it));
+  PyObject* r = CallShim("dataiter_index", args);
+  if (r == nullptr) return -1;
+  tls_shape_data.clear();
+  Py_ssize_t n = PyList_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    tls_shape_data.push_back(static_cast<int>(
+        PyLong_AsLong(PyList_GET_ITEM(r, i))));
+  *num = static_cast<int>(n);
+  *out = tls_shape_data.data();
+  Py_DECREF(r);
+  return 0;
+}
+
+// description + param names for a registered iterator (reference
+// MXDataIterGetIterInfo).
+int MXTpuDataIterGetIterInfo(const char* name,
+                             const char** description,
+                             int* num_params,
+                             const char*** param_names) {
+  Gil gil;
+  PyObject* args = PyTuple_New(1);
+  PyTuple_SET_ITEM(args, 0, Str(name));
+  PyObject* r = CallShim("dataiter_info", args);
+  if (r == nullptr) return -1;
+  PyObject* desc = PyTuple_GET_ITEM(r, 0);
+  PyObject* par = PyTuple_GET_ITEM(r, 1);
+  tls_strs.clear();
+  tls_strps.clear();
+  const char* d = PyUnicode_AsUTF8(desc);
+  tls_strs.emplace_back(d ? d : "");
+  Py_ssize_t n = PyList_Size(par);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* s = PyUnicode_AsUTF8(PyList_GET_ITEM(par, i));
+    tls_strs.emplace_back(s ? s : "");
+  }
+  for (auto& s : tls_strs) tls_strps.push_back(s.c_str());
+  *description = tls_strps[0];
+  *num_params = static_cast<int>(n);
+  *param_names = tls_strps.data() + 1;
+  Py_DECREF(r);
+  return 0;
+}
+
 int MXTpuDataIterGetPadNum(void* it, int* pad) {
   Gil gil;
   PyObject* args = PyTuple_New(1);
@@ -1377,6 +1444,18 @@ int MXTpuKVStoreSetOptimizer(void* kv, const char* opt_name,
   PyTuple_SET_ITEM(args, 1, Str(opt_name));
   PyTuple_SET_ITEM(args, 2, StrDict(num_params, keys, vals));
   PyObject* r = CallShim("kvstore_set_optimizer", args);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTpuKVStoreSetBarrierBeforeExit(void* kv, int flag) {
+  Gil gil;
+  PyObject* args = PyTuple_New(2);
+  Py_INCREF(static_cast<PyObject*>(kv));
+  PyTuple_SET_ITEM(args, 0, static_cast<PyObject*>(kv));
+  PyTuple_SET_ITEM(args, 1, PyLong_FromLong(flag));
+  PyObject* r = CallShim("kvstore_set_barrier_before_exit", args);
   if (r == nullptr) return -1;
   Py_DECREF(r);
   return 0;

@@ -96,6 +96,12 @@ int MXTpuDataIterBeforeFirst(void* it);
 int MXTpuDataIterGetData(void* it, void** out);
 int MXTpuDataIterGetLabel(void* it, void** out);
 int MXTpuDataIterGetPadNum(void* it, int* pad);
+/* *num = 0 when the iterator doesn't track indices. */
+int MXTpuDataIterGetIndex(void* it, int* num, const int** out);
+int MXTpuDataIterGetIterInfo(const char* name,
+                             const char** description,
+                             int* num_params,
+                             const char*** param_names);
 
 /* ---- KVStore (reference c_api.h:1207-1397) ---- */
 int MXTpuKVStoreCreate(const char* type, void** out);
@@ -113,6 +119,7 @@ int MXTpuKVStoreSetOptimizer(void* kv, const char* opt_name,
                              int num_params, const char** keys,
                              const char** vals);
 int MXTpuKVStoreRunServer(void* kv);
+int MXTpuKVStoreSetBarrierBeforeExit(void* kv, int flag);
 
 /* ---- Executor extras (reference MXExecutorReshape, copy-params,
  * MXExecutorPrint) ---- */
@@ -166,6 +173,8 @@ int MXTpuSymbolInferType(void* sym, int num_in, const char** names,
                          const int* dtypes, int* num_arg,
                          const int** arg_dtypes);
 
+int MXTpuSymbolCreateFromFile(const char* fname, void** out);
+int MXTpuSymbolSaveToFile(void* sym, const char* fname);
 int MXTpuSymbolCreateGroup(int num, void** syms, void** out);
 int MXTpuSymbolInferShapePartial(void* sym, int num_in,
                                  const char** names,

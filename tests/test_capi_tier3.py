@@ -399,3 +399,50 @@ def test_set_memory_fraction_env(tmp_path):
         [sys.executable, "-c", code], env=env, capture_output=True,
         text=True)
     assert proc.returncode == 0 and "ok" in proc.stdout, proc.stderr
+
+
+def test_symbol_file_roundtrip_and_iter_info(lib, tmp_path):
+    _, fc = _mlp_symbol(lib)
+    path = str(tmp_path / "net.json").encode()
+    assert lib.MXTpuSymbolSaveToFile(fc, path) == 0, _err(lib)
+    loaded = ctypes.c_void_p()
+    assert lib.MXTpuSymbolCreateFromFile(path,
+                                         ctypes.byref(loaded)) == 0
+    num = ctypes.c_int()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXTpuSymbolList(loaded, b"arg", ctypes.byref(num),
+                               ctypes.byref(names)) == 0
+    assert b"fc1_weight" in [names[i] for i in range(num.value)]
+
+    desc = ctypes.c_char_p()
+    n_par = ctypes.c_int()
+    pars = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXTpuDataIterGetIterInfo(
+        b"NDArrayIter", ctypes.byref(desc), ctypes.byref(n_par),
+        ctypes.byref(pars)) == 0, _err(lib)
+    params = [pars[i].decode() for i in range(n_par.value)]
+    assert "batch_size" in params and desc.value
+
+
+def test_dataiter_index_and_kv_barrier_flag(lib, tmp_path):
+    it = ctypes.c_void_p()
+    csv_file = tmp_path / "t3_idx.csv"
+    csv_file.write_text("".join(f"{i},{i + 1}\n" for i in range(4)))
+    csv = str(csv_file)
+    ckeys = (ctypes.c_char_p * 3)(b"data_csv", b"data_shape",
+                                  b"batch_size")
+    cvals = (ctypes.c_char_p * 3)(csv.encode(), b"(2,)", b"2")
+    assert lib.MXTpuDataIterCreate(b"CSVIter", 3, ckeys, cvals,
+                                   ctypes.byref(it)) == 0, _err(lib)
+    has = ctypes.c_int()
+    assert lib.MXTpuDataIterNext(it, ctypes.byref(has)) == 0
+    assert has.value == 1
+    n_idx = ctypes.c_int(-1)
+    idx = ctypes.POINTER(ctypes.c_int)()
+    assert lib.MXTpuDataIterGetIndex(it, ctypes.byref(n_idx),
+                                     ctypes.byref(idx)) == 0, _err(lib)
+    assert n_idx.value >= 0  # 0 legal when untracked
+
+    kv = ctypes.c_void_p()
+    assert lib.MXTpuKVStoreCreate(b"local", ctypes.byref(kv)) == 0
+    assert lib.MXTpuKVStoreSetBarrierBeforeExit(kv, 0) == 0, _err(lib)

@@ -126,3 +126,17 @@ def test_resnet_s2d_stem_rejects_nchw():
     with _pytest.raises(ValueError):
         get_resnet(num_layers=18, image_shape=(3, 64, 64),
                    layout="NCHW", stem="space_to_depth")
+
+
+def test_googlenet_builds_and_runs():
+    """GoogLeNet/Inception-v1 (models/googlenet.py): shape-checks the
+    full tower at a reduced input size."""
+    net = models.get_googlenet(num_classes=11)
+    args, outs, _ = net.infer_shape(data=(2, 3, 224, 224))
+    assert outs == [(2, 11)]
+    assert dict(zip(net.list_arguments(), args))[
+        "in3a_3x3_weight"] == (128, 96, 3, 3)
+    ex = net.simple_bind(ctx=mx.cpu(), data=(1, 3, 96, 96),
+                         softmax_label=(1,), grad_req="null")
+    out = ex.forward(is_train=False)
+    assert out[0].shape == (1, 11)

@@ -1,0 +1,112 @@
+"""Inception-v3 (reference
+example/image-classification/symbol_inception-v3.py — the network the
+reference's memory-mirror benchmark runs, README.md:352-359): factorized
+7x7/asymmetric-conv inception blocks with BN everywhere, 299^2 input."""
+from .. import symbol as sym
+
+
+def _conv(data, num_filter, kernel=(1, 1), stride=(1, 1), pad=(0, 0),
+          name=None, suffix=""):
+    c = sym.Convolution(data, name=f"{name}{suffix}_conv",
+                        num_filter=num_filter, kernel=kernel,
+                        stride=stride, pad=pad, no_bias=True)
+    bn = sym.BatchNorm(c, name=f"{name}{suffix}_bn", fix_gamma=True,
+                       eps=2e-5)
+    return sym.Activation(bn, name=f"{name}{suffix}_relu",
+                          act_type="relu")
+
+
+def _pool(data, kernel, stride, pad, pool_type, name):
+    return sym.Pooling(data, kernel=kernel, stride=stride, pad=pad,
+                       pool_type=pool_type, name=name)
+
+
+def _inception_a(data, n1, n5r, n5, n3r, n3, proj, name):
+    b1 = _conv(data, n1, name=f"{name}_1x1")
+    b2 = _conv(data, n5r, name=f"{name}_5x5r")
+    b2 = _conv(b2, n5, (5, 5), pad=(2, 2), name=f"{name}_5x5")
+    b3 = _conv(data, n3r, name=f"{name}_3x3r")
+    b3 = _conv(b3, n3, (3, 3), pad=(1, 1), name=f"{name}_3x3a")
+    b3 = _conv(b3, n3, (3, 3), pad=(1, 1), name=f"{name}_3x3b")
+    b4 = _pool(data, (3, 3), (1, 1), (1, 1), "avg", f"{name}_pool")
+    b4 = _conv(b4, proj, name=f"{name}_proj")
+    return sym.Concat(b1, b2, b3, b4, dim=1, name=f"{name}_concat")
+
+
+def _reduction_a(data, n3, n2r, n2, name):
+    b1 = _conv(data, n3, (3, 3), stride=(2, 2), name=f"{name}_3x3")
+    b2 = _conv(data, n2r, name=f"{name}_dblr")
+    b2 = _conv(b2, n2, (3, 3), pad=(1, 1), name=f"{name}_dbla")
+    b2 = _conv(b2, n2, (3, 3), stride=(2, 2), name=f"{name}_dblb")
+    b3 = _pool(data, (3, 3), (2, 2), (0, 0), "max", f"{name}_pool")
+    return sym.Concat(b1, b2, b3, dim=1, name=f"{name}_concat")
+
+
+def _inception_b(data, n7, name):
+    """Asymmetric 1x7/7x1 factorization block (the v3 signature)."""
+    b1 = _conv(data, 192, name=f"{name}_1x1")
+    b2 = _conv(data, n7, name=f"{name}_7r")
+    b2 = _conv(b2, n7, (1, 7), pad=(0, 3), name=f"{name}_1x7")
+    b2 = _conv(b2, 192, (7, 1), pad=(3, 0), name=f"{name}_7x1")
+    b3 = _conv(data, n7, name=f"{name}_d7r")
+    b3 = _conv(b3, n7, (7, 1), pad=(3, 0), name=f"{name}_d7x1a")
+    b3 = _conv(b3, n7, (1, 7), pad=(0, 3), name=f"{name}_d1x7a")
+    b3 = _conv(b3, n7, (7, 1), pad=(3, 0), name=f"{name}_d7x1b")
+    b3 = _conv(b3, 192, (1, 7), pad=(0, 3), name=f"{name}_d1x7b")
+    b4 = _pool(data, (3, 3), (1, 1), (1, 1), "avg", f"{name}_pool")
+    b4 = _conv(b4, 192, name=f"{name}_proj")
+    return sym.Concat(b1, b2, b3, b4, dim=1, name=f"{name}_concat")
+
+
+def _reduction_b(data, name):
+    b1 = _conv(data, 192, name=f"{name}_3r")
+    b1 = _conv(b1, 320, (3, 3), stride=(2, 2), name=f"{name}_3x3")
+    b2 = _conv(data, 192, name=f"{name}_7r")
+    b2 = _conv(b2, 192, (1, 7), pad=(0, 3), name=f"{name}_1x7")
+    b2 = _conv(b2, 192, (7, 1), pad=(3, 0), name=f"{name}_7x1")
+    b2 = _conv(b2, 192, (3, 3), stride=(2, 2), name=f"{name}_3x3b")
+    b3 = _pool(data, (3, 3), (2, 2), (0, 0), "max", f"{name}_pool")
+    return sym.Concat(b1, b2, b3, dim=1, name=f"{name}_concat")
+
+
+def _inception_c(data, name):
+    """Expanded-filter-bank block (1x3/3x1 splits concatenated)."""
+    b1 = _conv(data, 320, name=f"{name}_1x1")
+    b2 = _conv(data, 384, name=f"{name}_3r")
+    b2a = _conv(b2, 384, (1, 3), pad=(0, 1), name=f"{name}_1x3")
+    b2b = _conv(b2, 384, (3, 1), pad=(1, 0), name=f"{name}_3x1")
+    b3 = _conv(data, 448, name=f"{name}_d3r")
+    b3 = _conv(b3, 384, (3, 3), pad=(1, 1), name=f"{name}_d3x3")
+    b3a = _conv(b3, 384, (1, 3), pad=(0, 1), name=f"{name}_d1x3")
+    b3b = _conv(b3, 384, (3, 1), pad=(1, 0), name=f"{name}_d3x1")
+    b4 = _pool(data, (3, 3), (1, 1), (1, 1), "avg", f"{name}_pool")
+    b4 = _conv(b4, 192, name=f"{name}_proj")
+    return sym.Concat(b1, b2a, b2b, b3a, b3b, b4, dim=1,
+                      name=f"{name}_concat")
+
+
+def get_inception_v3(num_classes=1000):
+    data = sym.Variable("data")  # (N, 3, 299, 299)
+    net = _conv(data, 32, (3, 3), stride=(2, 2), name="conv")
+    net = _conv(net, 32, (3, 3), name="conv_1")
+    net = _conv(net, 64, (3, 3), pad=(1, 1), name="conv_2")
+    net = _pool(net, (3, 3), (2, 2), (0, 0), "max", "pool")
+    net = _conv(net, 80, (1, 1), name="conv_3")
+    net = _conv(net, 192, (3, 3), name="conv_4")
+    net = _pool(net, (3, 3), (2, 2), (0, 0), "max", "pool1")
+    net = _inception_a(net, 64, 48, 64, 64, 96, 32, "mixed")
+    net = _inception_a(net, 64, 48, 64, 64, 96, 64, "mixed_1")
+    net = _inception_a(net, 64, 48, 64, 64, 96, 64, "mixed_2")
+    net = _reduction_a(net, 384, 64, 96, "mixed_3")
+    net = _inception_b(net, 128, "mixed_4")
+    net = _inception_b(net, 160, "mixed_5")
+    net = _inception_b(net, 160, "mixed_6")
+    net = _inception_b(net, 192, "mixed_7")
+    net = _reduction_b(net, "mixed_8")
+    net = _inception_c(net, "mixed_9")
+    net = _inception_c(net, "mixed_10")
+    net = sym.Pooling(net, global_pool=True, kernel=(8, 8),
+                      pool_type="avg", name="global_pool")
+    net = sym.Flatten(net, name="flatten")
+    net = sym.FullyConnected(net, num_hidden=num_classes, name="fc1")
+    return sym.SoftmaxOutput(net, name="softmax")

@@ -133,3 +133,37 @@ def test_copy_params_from():
     ex.arg_dict["data"][:] = np.array([[1.0, 1.0]], np.float32)
     out = ex.forward()[0]
     np.testing.assert_allclose(out.asnumpy(), [[3.0, 7.0]])
+
+
+def test_backward_mirror_matches_plain(monkeypatch):
+    """MXNET_BACKWARD_DO_MIRROR (jax.checkpoint remat, the reference
+    memory-mirror/memonger trade) must not change values."""
+
+    def build_and_grad():
+        net = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(net, name="fc1", num_hidden=16)
+        net = mx.sym.Activation(net, act_type="tanh")
+        net = mx.sym.FullyConnected(net, name="fc2", num_hidden=4)
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        ex = net.simple_bind(ctx=mx.cpu(), grad_req="write",
+                             data=(6, 8), softmax_label=(6,))
+        rs = np.random.RandomState(3)
+        for name, arr in sorted(ex.arg_dict.items()):
+            if name not in ("data", "softmax_label"):
+                arr[:] = rs.randn(*arr.shape).astype(np.float32) * 0.2
+        ex.arg_dict["data"][:] = rs.randn(6, 8).astype(np.float32)
+        ex.arg_dict["softmax_label"][:] = np.array(
+            [0, 1, 2, 3, 0, 1], np.float32)
+        out = ex.forward(is_train=True)[0].asnumpy()
+        ex.backward()
+        return out, {k: v.asnumpy() for k, v in ex.grad_dict.items()
+                     if v is not None}
+
+    monkeypatch.delenv("MXNET_BACKWARD_DO_MIRROR", raising=False)
+    out_plain, g_plain = build_and_grad()
+    monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1")
+    out_mirror, g_mirror = build_and_grad()
+    np.testing.assert_allclose(out_plain, out_mirror, rtol=1e-6)
+    for k in g_plain:
+        np.testing.assert_allclose(g_plain[k], g_mirror[k], rtol=1e-5,
+                                   err_msg=k)

@@ -140,3 +140,19 @@ def test_googlenet_builds_and_runs():
                          softmax_label=(1,), grad_req="null")
     out = ex.forward(is_train=False)
     assert out[0].shape == (1, 11)
+
+
+def test_big_zoo_shapes():
+    """AlexNet/VGG/Inception-BN/GoogLeNet infer end-to-end shapes at
+    the canonical 224^2 input (reference symbol_*.py zoo)."""
+    for build, side in ((models.get_alexnet, 224),
+                        (models.get_vgg, 224),
+                        (models.get_inception_bn, 224),
+                        (models.get_googlenet, 224),
+                        (models.get_inception_v3, 299)):
+        net = build(num_classes=13)
+        args, outs, _ = net.infer_shape(data=(2, 3, side, side))
+        assert outs == [(2, 13)], build.__name__
+    # inception-v3's canonical 2048-d pooled features
+    assert dict(zip(net.list_arguments(), args))["fc1_weight"] == \
+        (13, 2048)

@@ -1,0 +1,82 @@
+// cpp-package example: dataset packing (RecordIO), a runtime-compiled
+// Pallas kernel (Rtc), and profiler capture — all from C++.
+//
+// Build like mlp.cc:
+//   g++ -O2 -std=c++17 recordio_rtc.cc libmxtpu_c.so \
+//       $(python3-config --includes --ldflags --embed) -o recordio_rtc
+
+#include <cstdio>
+#include <string>
+#include <vector>
+
+#include "../include/mxnet-tpu-cpp/MxTpuCpp.hpp"
+
+int main(int argc, char** argv) {
+  const std::string rec_path =
+      argc > 1 ? argv[1] : "/tmp/cpp_recordio_rtc.rec";
+  const std::string trace_path =
+      argc > 2 ? argv[2] : "/tmp/cpp_recordio_rtc_trace.json";
+
+  mxtpu::ProfilerStart(trace_path);
+
+  // --- RecordIO round trip -------------------------------------------
+  {
+    mxtpu::RecordIOWriter w(rec_path);
+    w.Write("alpha");
+    w.Write(std::string(1000, 'x'));
+    w.Write("");  // empty records are legal
+    w.Write("omega");
+    std::printf("wrote 4 records, %ld bytes\n", w.Tell());
+    w.Close();  // explicit close surfaces flush failures
+  }
+  int count = 0;
+  std::string rec, first;
+  {
+    mxtpu::RecordIOReader r(rec_path);
+    while (r.Read(&rec)) {
+      if (count == 0) first = rec;
+      ++count;
+    }
+    r.Seek(0);
+    std::string again;
+    if (!r.Read(&again) || again != first) {
+      std::fprintf(stderr, "seek/reread mismatch\n");
+      return 1;
+    }
+  }
+  if (count != 4 || first != "alpha") {
+    std::fprintf(stderr, "recordio mismatch: %d records\n", count);
+    return 1;
+  }
+  std::printf("read back %d records\n", count);
+
+  // --- RTC: a Pallas kernel from source text -------------------------
+  const char* kSource =
+      "def saxpy(x_ref, y_ref, o_ref):\n"
+      "    o_ref[...] = 2.5 * x_ref[...] + y_ref[...]\n";
+  mxtpu::Rtc rtc("saxpy", kSource, "saxpy");
+
+  std::vector<int> shape{2, 4};
+  std::vector<float> xs(8), ys(8);
+  for (int i = 0; i < 8; ++i) {
+    xs[i] = static_cast<float>(i);
+    ys[i] = 100.0f;
+  }
+  mxtpu::NDArray x(shape, xs), y(shape, ys);
+  mxtpu::NDArray out = mxtpu::NDArray::Zeros(shape);
+  rtc.Push({&x, &y}, {&out});
+  std::vector<float> got = out.Data();
+  for (int i = 0; i < 8; ++i) {
+    float want = 2.5f * xs[i] + 100.0f;
+    if (got[i] < want - 1e-4f || got[i] > want + 1e-4f) {
+      std::fprintf(stderr, "rtc mismatch at %d: %f vs %f\n", i,
+                   got[i], want);
+      return 1;
+    }
+  }
+  std::printf("rtc saxpy ok\n");
+
+  mxtpu::ProfilerStop();
+  std::printf("recordio_rtc done\n");
+  return 0;
+}

@@ -397,6 +397,122 @@ class SGDOptimizer {
   std::map<void*, NDArray> mom_;
 };
 
+// RecordIO writer/reader (reference cpp-package had none; the C ABI's
+// MXTpuRecordIO* tier makes dataset packing reachable from C++).
+class RecordIOWriter {
+ public:
+  explicit RecordIOWriter(const std::string& path) {
+    void* h = nullptr;
+    Check(MXTpuRecordIOWriterCreate(path.c_str(), &h),
+          "RecordIOWriterCreate");
+    h_ = h;
+  }
+  RecordIOWriter(const RecordIOWriter&) = delete;
+  RecordIOWriter& operator=(const RecordIOWriter&) = delete;
+  ~RecordIOWriter() {
+    // destructor must not throw: close failures are only surfaced by
+    // an explicit Close()
+    if (h_ != nullptr) MXTpuRecordIOWriterFree(h_);
+  }
+  void Write(const std::string& record) {
+    Check(h_ == nullptr ? -1 : 0, "RecordIOWriter used after Close");
+    Check(MXTpuRecordIOWriterWriteRecord(
+              h_, record.data(), static_cast<long>(record.size())),
+          "RecordIOWriterWriteRecord");
+  }
+  long Tell() {
+    Check(h_ == nullptr ? -1 : 0, "RecordIOWriter used after Close");
+    long pos = 0;
+    Check(MXTpuRecordIOWriterTell(h_, &pos), "RecordIOWriterTell");
+    return pos;
+  }
+  // Surfaces flush failures (e.g. ENOSPC) — the C layer reports them
+  // while still releasing the handle.
+  void Close() {
+    if (h_ != nullptr) {
+      int rc = MXTpuRecordIOWriterFree(h_);
+      h_ = nullptr;
+      Check(rc, "RecordIOWriterFree");
+    }
+  }
+
+ private:
+  void* h_ = nullptr;
+};
+
+class RecordIOReader {
+ public:
+  explicit RecordIOReader(const std::string& path) {
+    void* h = nullptr;
+    Check(MXTpuRecordIOReaderCreate(path.c_str(), &h),
+          "RecordIOReaderCreate");
+    h_ = h;
+  }
+  RecordIOReader(const RecordIOReader&) = delete;
+  RecordIOReader& operator=(const RecordIOReader&) = delete;
+  ~RecordIOReader() {
+    if (h_ != nullptr) MXTpuRecordIOReaderFree(h_);
+  }
+  // false at end of file (a 0-length record still returns true).
+  bool Read(std::string* out) {
+    const char* buf = nullptr;
+    long size = 0;
+    Check(MXTpuRecordIOReaderReadRecord(h_, &buf, &size),
+          "RecordIOReaderReadRecord");
+    if (buf == nullptr) return false;
+    out->assign(buf, static_cast<size_t>(size));
+    return true;
+  }
+  void Seek(long pos) {
+    Check(MXTpuRecordIOReaderSeek(h_, pos), "RecordIOReaderSeek");
+  }
+
+ private:
+  void* h_ = nullptr;
+};
+
+// Runtime-compiled Pallas kernel (the reference cpp-package's MXRtc
+// analog; source text defines a Pallas kernel function).
+class Rtc {
+ public:
+  Rtc(const std::string& name, const std::string& py_source,
+      const std::string& kernel_fn) {
+    void* h = nullptr;
+    Check(MXTpuRtcCreate(name.c_str(), py_source.c_str(),
+                         kernel_fn.c_str(), &h),
+          "RtcCreate");
+    h_ = Handle(h);
+  }
+  // Outputs are pre-allocated NDArrays whose shapes/dtypes define the
+  // kernel's output spec; results are written into them.
+  void Push(const std::vector<const NDArray*>& ins,
+            const std::vector<NDArray*>& outs) {
+    std::vector<void*> in_h, out_h;
+    for (const auto* a : ins) in_h.push_back(a->get());
+    for (auto* a : outs) out_h.push_back(a->get());
+    Check(MXTpuRtcPush(h_.get(), static_cast<int>(in_h.size()),
+                       in_h.data(), static_cast<int>(out_h.size()),
+                       out_h.data()),
+          "RtcPush");
+  }
+
+ private:
+  Handle h_;
+};
+
+// Profiler controls (reference cpp-package exposed the same pair).
+inline void ProfilerStart(const std::string& filename,
+                          bool all_ops = true) {
+  Check(MXTpuSetProfilerConfig(all_ops ? 1 : 0, filename.c_str()),
+        "SetProfilerConfig");
+  Check(MXTpuSetProfilerState(1), "SetProfilerState");
+}
+
+inline void ProfilerStop() {
+  Check(MXTpuSetProfilerState(0), "SetProfilerState");
+  Check(MXTpuDumpProfile(), "DumpProfile");
+}
+
 }  // namespace mxtpu
 
 #endif  // MXNET_TPU_CPP_MXTPUCPP_HPP_

@@ -7,6 +7,12 @@ Must set flags before jax is imported anywhere.
 import os
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The suite — including every subprocess tests spawn (tools, examples,
+# launch.py workers) — must never dial the TPU tunnel: the axon plugin
+# connects at interpreter start whenever PALLAS_AXON_POOL_IPS is set,
+# and a wedged tunnel then hangs the process forever. Force-clear it
+# here so child processes inherit the guard through os.environ.
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

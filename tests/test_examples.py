@@ -61,6 +61,8 @@ def test_every_example_is_listed():
     missing = found - set(EXAMPLES)
     assert not missing, (
         f"examples without a smoke test entry: {sorted(missing)}")
+    stale = set(EXAMPLES) - found
+    assert not stale, f"smoke entries without a script: {sorted(stale)}"
 
 
 @pytest.mark.parametrize("rel", sorted(EXAMPLES))

@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Matrix factorization for recommendation (the reference
+example/recommenders role): user/item Embedding lookups, a dot-product
+score, and MSE training on synthetic low-rank ratings.
+
+Usage: python examples/recommenders/matrix_fact.py [--epochs N]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def build_net(num_users, num_items, k):
+    user = sym.Variable("user")
+    item = sym.Variable("item")
+    score = sym.Variable("score_label")
+    u = sym.Embedding(user, input_dim=num_users, output_dim=k,
+                      name="user_embed")
+    v = sym.Embedding(item, input_dim=num_items, output_dim=k,
+                      name="item_embed")
+    pred = sym.sum(u * v, axis=1)
+    return sym.LinearRegressionOutput(pred, score, name="score")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=12)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--factors", type=int, default=8)
+    args = ap.parse_args()
+
+    rs = np.random.RandomState(0)
+    num_users, num_items, k = 50, 40, args.factors
+    true_u = rs.randn(num_users, k).astype(np.float32) * 0.5
+    true_v = rs.randn(num_items, k).astype(np.float32) * 0.5
+
+    n = 4096
+    users = rs.randint(0, num_users, n).astype(np.float32)
+    items = rs.randint(0, num_items, n).astype(np.float32)
+    scores = np.einsum(
+        "nk,nk->n", true_u[users.astype(int)],
+        true_v[items.astype(int)]).astype(np.float32)
+    scores += rs.randn(n).astype(np.float32) * 0.05
+
+    it = mx.io.NDArrayIter(
+        {"user": users, "item": items}, {"score_label": scores},
+        batch_size=args.batch, shuffle=True)
+    mod = mx.mod.Module(build_net(num_users, num_items, k),
+                        data_names=("user", "item"),
+                        label_names=("score_label",),
+                        context=[mx.default_context()])
+    mod.fit(it, num_epoch=args.epochs, optimizer="adam",
+            optimizer_params={"learning_rate": 0.05},
+            eval_metric="mse",
+            initializer=mx.initializer.Normal(0.5))
+    it.reset()
+    mse = dict(mod.score(it, mx.metric.MSE()))["mse"]
+    var = float(scores.var())
+    print(f"mse={mse:.4f} (score variance {var:.4f})")
+    assert mse < 0.25 * var, "matrix factorization failed to learn"
+    print("matrix_fact done")
+
+
+if __name__ == "__main__":
+    main()

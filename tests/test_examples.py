@@ -31,6 +31,7 @@ EXAMPLES = {
     "gan/dcgan_mnist.py": ["--epochs", "1", "--batch", "32"],
     "speech/lstm_ctc.py": ["--epochs", "10"],
     "multi_task/multitask_mnist.py": ["--epochs", "6"],
+    "recommenders/matrix_fact.py": [],
     "autoencoder/ae_mnist.py": [],
 }
 

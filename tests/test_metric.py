@@ -79,3 +79,37 @@ def test_composite_and_custom():
     m.update([label], [pred])
     _, v = m.get()
     assert v == 1.0
+
+
+def test_regression_metrics_1d_pred_no_outer_broadcast():
+    """A (N,) prediction against a (N,) label must score elementwise —
+    the (N,1)-vs-(N,) outer-broadcast bug made every regression metric
+    report ~var(label)+var(pred) on 1-D outputs."""
+    import numpy as np
+
+    label = np.array([1.0, 2.0, 3.0], np.float32)
+    pred = np.array([1.5, 2.0, 2.0], np.float32)
+    for cls, want in ((mx.metric.MSE, (0.25 + 0 + 1.0) / 3),
+                      (mx.metric.MAE, (0.5 + 0 + 1.0) / 3),
+                      (mx.metric.RMSE, np.sqrt((0.25 + 0 + 1.0) / 3))):
+        m = cls()
+        m.update([mx.nd.array(label)], [mx.nd.array(pred)])
+        np.testing.assert_allclose(m.get()[1], want, rtol=1e-6,
+                                   err_msg=cls.__name__)
+        # 2-D (N,1) predictions keep working
+        m2 = cls()
+        m2.update([mx.nd.array(label)],
+                  [mx.nd.array(pred.reshape(-1, 1))])
+        np.testing.assert_allclose(m2.get()[1], want, rtol=1e-6)
+
+
+def test_regression_metric_per_sample_label_broadcast():
+    """(N,) label vs (N,M) preds broadcasts per sample (column-wise),
+    the reference convention for multi-output regression heads."""
+    import numpy as np
+
+    label = np.array([1.0, 2.0], np.float32)
+    pred = np.array([[1.0, 3.0], [2.0, 0.0]], np.float32)
+    m = mx.metric.MSE()
+    m.update([mx.nd.array(label)], [mx.nd.array(pred)])
+    np.testing.assert_allclose(m.get()[1], (0 + 4 + 0 + 4) / 4)

@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Fast-gradient-sign adversarial examples (the reference
+example/adversary role): train a small classifier, then perturb inputs
+along the sign of the input gradient and show the accuracy collapse.
+
+Exercises inputs_need_grad=True + get_input_grads through Module.
+
+Usage: python examples/adversary/fgsm_mnist.py [--epochs N]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def build_net(num_classes):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, name="fc1", num_hidden=64)
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, name="fc2", num_hidden=num_classes)
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def make_data(rs, n, d, k):
+    centers = rs.randn(k, d).astype(np.float32) * 1.5
+    y = rs.randint(0, k, n).astype(np.float32)
+    X = centers[y.astype(int)] + rs.randn(n, d).astype(np.float32) * 0.5
+    return X, y
+
+
+def accuracy(mod, X, y, batch):
+    correct = 0
+    for i in range(0, len(X) - batch + 1, batch):
+        b = mx.io.DataBatch(
+            data=[mx.nd.array(X[i:i + batch])],
+            label=[mx.nd.array(y[i:i + batch])])
+        mod.forward(b, is_train=False)
+        pred = mod.get_outputs()[0].asnumpy().argmax(axis=1)
+        correct += int((pred == y[i:i + batch]).sum())
+    n = (len(X) // batch) * batch
+    return correct / n
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--eps", type=float, default=1.5)
+    args = ap.parse_args()
+
+    np.random.seed(0)  # iterator shuffle + Xavier draw from global RNG
+    rs = np.random.RandomState(0)
+    d, k = 32, 6
+    X, y = make_data(rs, 2048, d, k)
+
+    it = mx.io.NDArrayIter(X, y, batch_size=args.batch, shuffle=True)
+    mod = mx.mod.Module(build_net(k), context=[mx.default_context()])
+    # inputs_need_grad so the SAME module yields input gradients
+    mod.bind(data_shapes=it.provide_data,
+             label_shapes=it.provide_label, for_training=True,
+             inputs_need_grad=True)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    for _ in range(args.epochs):
+        it.reset()
+        for b in it:
+            mod.forward_backward(b)
+            mod.update()
+
+    clean_acc = accuracy(mod, X, y, args.batch)
+
+    # FGSM: x_adv = x + eps * sign(dL/dx) at the TRUE label
+    X_adv = X.copy()
+    for i in range(0, len(X) - args.batch + 1, args.batch):
+        b = mx.io.DataBatch(
+            data=[mx.nd.array(X[i:i + args.batch])],
+            label=[mx.nd.array(y[i:i + args.batch])])
+        mod.forward(b, is_train=True)
+        mod.backward()
+        g = mod.get_input_grads()[0].asnumpy()
+        X_adv[i:i + args.batch] = X[i:i + args.batch] + \
+            args.eps * np.sign(g)
+
+    adv_acc = accuracy(mod, X_adv, y, args.batch)
+    print(f"clean accuracy={clean_acc:.3f}  "
+          f"adversarial accuracy={adv_acc:.3f} (eps={args.eps})")
+    assert clean_acc > 0.9, "classifier failed to train"
+    assert adv_acc < clean_acc - 0.3, "FGSM failed to degrade accuracy"
+    print("fgsm done")
+
+
+if __name__ == "__main__":
+    main()

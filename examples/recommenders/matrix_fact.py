@@ -61,7 +61,6 @@ def main():
             optimizer_params={"learning_rate": 0.05},
             eval_metric="mse",
             initializer=mx.initializer.Normal(0.5))
-    it.reset()
     mse = dict(mod.score(it, mx.metric.MSE()))["mse"]
     var = float(scores.var())
     print(f"mse={mse:.4f} (score variance {var:.4f})")

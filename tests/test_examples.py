@@ -32,6 +32,7 @@ EXAMPLES = {
     "speech/lstm_ctc.py": ["--epochs", "10"],
     "multi_task/multitask_mnist.py": ["--epochs", "6"],
     "recommenders/matrix_fact.py": [],
+    "adversary/fgsm_mnist.py": ["--epochs", "8"],
     "autoencoder/ae_mnist.py": [],
 }
 

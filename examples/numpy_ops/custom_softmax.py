@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Train through a Python-defined operator (the reference
+example/numpy-ops role): softmax + cross-entropy written as a
+CustomOp — numpy in forward, explicit backward — dropped into a
+Module graph in place of the built-in SoftmaxOutput.
+
+Usage: python examples/numpy_ops/custom_softmax.py [--epochs N]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+class NumpySoftmax(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        e = np.exp(x - x.max(axis=1, keepdims=True))
+        self.assign(out_data[0], req[0],
+                    mx.nd.array(e / e.sum(axis=1, keepdims=True)))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        # d(cross-entropy)/dx = softmax(x) - onehot(label)
+        y = out_data[0].asnumpy()
+        label = in_data[1].asnumpy().astype(int)
+        g = y.copy()
+        g[np.arange(len(label)), label] -= 1.0
+        # unnormalized, matching SoftmaxOutput's default grad scale
+        self.assign(in_grad[0], req[0], mx.nd.array(g))
+
+
+@mx.operator.register("numpy_softmax_ce")
+class NumpySoftmaxProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return [in_shape[0], (in_shape[0][0],)], [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return NumpySoftmax()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=64)
+    args = ap.parse_args()
+
+    np.random.seed(0)
+    rs = np.random.RandomState(0)
+    k, d, n = 5, 16, 1024
+    centers = rs.randn(k, d).astype(np.float32) * 3.0
+    y = rs.randint(0, k, n).astype(np.float32)
+    X = centers[y.astype(int)] + \
+        rs.randn(n, d).astype(np.float32) * 0.7
+
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, name="fc1", num_hidden=32)
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, name="fc2", num_hidden=k)
+    net = sym.Custom(data=net, label=sym.Variable("softmax_label"),
+                     op_type="numpy_softmax_ce", name="softmax")
+
+    it = mx.io.NDArrayIter(X, y, batch_size=args.batch, shuffle=True)
+    mod = mx.mod.Module(net, context=[mx.default_context()])
+    mod.fit(it, num_epoch=args.epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            eval_metric="acc")
+    acc = dict(mod.score(it, mx.metric.Accuracy()))["accuracy"]
+    print(f"accuracy through the numpy CustomOp: {acc:.3f}")
+    assert acc > 0.9, "custom-op training failed"
+    print("custom_softmax done")
+
+
+if __name__ == "__main__":
+    main()

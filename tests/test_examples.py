@@ -33,6 +33,7 @@ EXAMPLES = {
     "multi_task/multitask_mnist.py": ["--epochs", "6"],
     "recommenders/matrix_fact.py": [],
     "adversary/fgsm_mnist.py": ["--epochs", "8"],
+    "numpy_ops/custom_softmax.py": [],
     "autoencoder/ae_mnist.py": [],
 }
 

@@ -34,6 +34,7 @@ EXAMPLES = {
     "recommenders/matrix_fact.py": [],
     "adversary/fgsm_mnist.py": ["--epochs", "8"],
     "numpy_ops/custom_softmax.py": [],
+    "bi_lstm_sort/sort_lstm.py": ["--epochs", "8"],
     "autoencoder/ae_mnist.py": [],
 }
 

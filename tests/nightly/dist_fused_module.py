@@ -34,6 +34,11 @@ def main():
 
     kv.push = _no_push
 
+    # deterministic run: parameter init draws from the GLOBAL numpy
+    # RNG (initializer dispatch), which was previously unseeded and
+    # made this convergence gate flaky (observed 0.88-0.97 final acc)
+    np.random.seed(7)
+
     # tiny separable problem; each worker sees a disjoint slice
     rs = np.random.RandomState(42)  # same data both ranks, split below
     n, dim, classes = 512, 16, 4

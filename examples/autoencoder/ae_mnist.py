@@ -68,6 +68,7 @@ def main():
     ap.add_argument("--epochs", type=int, default=30)
     ap.add_argument("--lr", type=float, default=1e-2)
     args = ap.parse_args()
+    np.random.seed(0)  # initializer/shuffle draw from global RNG
     ctx = mx.default_context()
     X = make_data()
     dims = [X.shape[1], 32, 8]

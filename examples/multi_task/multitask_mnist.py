@@ -42,6 +42,7 @@ def main():
     ap.add_argument("--batch", type=int, default=64)
     args = ap.parse_args()
 
+    np.random.seed(0)  # initializer/shuffle draw from global RNG
     rs = np.random.RandomState(0)
     n, d, k = 1024, 32, 8
     centers = rs.randn(k, d).astype(np.float32) * 2.0

@@ -37,6 +37,7 @@ def main():
     ap.add_argument("--factors", type=int, default=8)
     args = ap.parse_args()
 
+    np.random.seed(0)  # initializer/shuffle draw from global RNG
     rs = np.random.RandomState(0)
     num_users, num_items, k = 50, 40, args.factors
     true_u = rs.randn(num_users, k).astype(np.float32) * 0.5

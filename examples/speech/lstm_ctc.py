@@ -68,6 +68,7 @@ def main():
     ap.add_argument("--lr", type=float, default=0.01)
     args = ap.parse_args()
 
+    np.random.seed(0)  # initializer/shuffle draw from global RNG
     rs = np.random.RandomState(0)
     ctx = mx.default_context()
     net = build_net()

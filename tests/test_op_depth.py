@@ -3,7 +3,9 @@
 reference test_operator.py-style density for the top ops by usage:
 Convolution stride/pad/dilate/groups grids against a pure-numpy
 reference, Pooling variants, BatchNorm axes/modes, broadcast corner
-shapes, degenerate shapes, and a bf16 tolerance tier).
+shapes, degenerate shapes, a bf16 tolerance tier, dot/batch_dot
+transpose grids, take/Embedding indexing, SequenceLast/Mask/Reverse
+with lengths, and topk return-type variants).
 """
 import numpy as np
 import pytest
@@ -298,3 +300,112 @@ def test_bf16_tolerance_tier(opname, tag):
     bf16 = np.asarray(run(jnp.bfloat16).astype(jnp.float32))
     scale = max(np.abs(f32).max(), 1e-6)
     assert np.abs(bf16 - f32).max() / scale < 0.05, tag
+
+
+# ---------------------------------------------------- matmul-class grids
+
+@pytest.mark.parametrize("ta,tb", [(False, False), (True, False),
+                                   (False, True), (True, True)])
+def test_dot_transpose_grid(ta, tb):
+    rs = np.random.RandomState(0)
+    a = rs.randn(*( (4, 3) if not ta else (3, 4) )).astype(np.float32)
+    b = rs.randn(*( (3, 5) if not tb else (5, 3) )).astype(np.float32)
+    out = np.asarray(_run("dot", [a, b], transpose_a=ta,
+                          transpose_b=tb))
+    want = (a.T if ta else a) @ (b.T if tb else b)
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+@pytest.mark.parametrize("ta,tb", [(False, False), (True, False),
+                                   (False, True), (True, True)])
+def test_batch_dot_transpose_grid(ta, tb):
+    rs = np.random.RandomState(1)
+    a = rs.randn(*( (2, 4, 3) if not ta else (2, 3, 4) )).astype(
+        np.float32)
+    b = rs.randn(*( (2, 3, 5) if not tb else (2, 5, 3) )).astype(
+        np.float32)
+    out = np.asarray(_run("batch_dot", [a, b], transpose_a=ta,
+                          transpose_b=tb))
+    at = np.swapaxes(a, 1, 2) if ta else a
+    bt = np.swapaxes(b, 1, 2) if tb else b
+    np.testing.assert_allclose(out, at @ bt, rtol=1e-5)
+
+
+# ------------------------------------------------------- indexing grids
+
+@pytest.mark.parametrize("axis,mode", [(0, "clip"), (1, "clip"),
+                                       (0, "wrap")])
+def test_take_grid(axis, mode):
+    rs = np.random.RandomState(2)
+    a = rs.randn(5, 6).astype(np.float32)
+    idx = np.array([0.0, 4.0, 7.0, -1.0], np.float32)  # out of range
+    out = np.asarray(_run("take", [a, idx], axis=axis, mode=mode))
+    n = a.shape[axis]
+    ints = idx.astype(np.int64)
+    if mode == "clip":
+        ints = np.clip(ints, 0, n - 1)
+    else:
+        ints = ints % n
+    np.testing.assert_allclose(out, np.take(a, ints, axis=axis),
+                               rtol=1e-6)
+
+
+def test_embedding_many_shapes():
+    rs = np.random.RandomState(3)
+    w = rs.randn(11, 7).astype(np.float32)
+    for shape in [(4,), (2, 3), (2, 2, 2)]:
+        ids = rs.randint(0, 11, shape).astype(np.float32)
+        out = np.asarray(_run("Embedding", [ids, w], input_dim=11,
+                              output_dim=7))
+        assert out.shape == shape + (7,)
+        np.testing.assert_allclose(out, w[ids.astype(int)], rtol=1e-6)
+
+
+# ------------------------------------------------------- sequence grids
+
+def test_sequence_ops_with_lengths():
+    rs = np.random.RandomState(4)
+    x = rs.randn(5, 3, 2).astype(np.float32)  # (T, N, C)
+    lengths = np.array([2.0, 5.0, 3.0], np.float32)
+
+    last = np.asarray(_run("SequenceLast", [x, lengths],
+                           use_sequence_length=True))
+    for i, l in enumerate(lengths.astype(int)):
+        np.testing.assert_allclose(last[i], x[l - 1, i], rtol=1e-6)
+
+    masked = np.asarray(_run("SequenceMask", [x, lengths],
+                             use_sequence_length=True, value=-1.0))
+    for i, l in enumerate(lengths.astype(int)):
+        np.testing.assert_allclose(masked[l:, i],
+                                   -np.ones_like(x[l:, i]))
+        np.testing.assert_allclose(masked[:l, i], x[:l, i], rtol=1e-6)
+
+    rev = np.asarray(_run("SequenceReverse", [x, lengths],
+                          use_sequence_length=True))
+    for i, l in enumerate(lengths.astype(int)):
+        np.testing.assert_allclose(rev[:l, i], x[:l, i][::-1],
+                                   rtol=1e-6)
+        np.testing.assert_allclose(rev[l:, i], x[l:, i], rtol=1e-6)
+
+
+# ------------------------------------------------------- ordering grids
+
+@pytest.mark.parametrize("k,ret_typ", [(1, "indices"), (3, "indices"),
+                                       (3, "value"), (2, "both")])
+def test_topk_grid(k, ret_typ):
+    rs = np.random.RandomState(5)
+    x = rs.randn(4, 6).astype(np.float32)
+    out = _run("topk", [x], k=k, ret_typ=ret_typ, axis=-1)
+    order = np.argsort(-x, axis=-1)[:, :k]
+    if ret_typ == "both":
+        vals, idxs = (np.asarray(o) for o in out)
+        np.testing.assert_allclose(
+            vals, np.take_along_axis(x, order, -1), rtol=1e-6)
+        np.testing.assert_allclose(idxs, order.astype(np.float32))
+    elif ret_typ == "value":
+        np.testing.assert_allclose(
+            np.asarray(out), np.take_along_axis(x, order, -1),
+            rtol=1e-6)
+    else:
+        np.testing.assert_allclose(np.asarray(out),
+                                   order.astype(np.float32))

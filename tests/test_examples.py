@@ -35,6 +35,7 @@ EXAMPLES = {
     "adversary/fgsm_mnist.py": ["--epochs", "8"],
     "numpy_ops/custom_softmax.py": [],
     "bi_lstm_sort/sort_lstm.py": ["--epochs", "8"],
+    "model_parallel/lstm_layers.py": ["--epochs", "6"],
     "autoencoder/ae_mnist.py": [],
 }
 

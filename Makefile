@@ -1,0 +1,60 @@
+# Build/test entry points (the reference drove everything through
+# make; here the Python path needs no compilation, so targets wrap the
+# native builds, test tiers, docs generation, and deploy bundle).
+#
+# The CPU guard (JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS=) keeps every
+# target off the TPU tunnel; drop it to run something on the chip.
+
+PY      ?= python
+CPUENV  := JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS=
+XLA8    := XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: all test nightly examples lint libs predict docs dryrun clean
+
+all: libs test
+
+# full unit suite on the virtual 8-device CPU mesh
+test:
+	$(CPUENV) $(PY) -m pytest tests/ -q --ignore=tests/nightly
+
+# distributed tier: multi-process workers on one host (CI pattern)
+nightly:
+	$(CPUENV) $(PY) tools/launch.py -n 2 --launcher local \
+	    $(PY) tests/nightly/dist_sync_kvstore.py
+	$(CPUENV) $(PY) tools/launch.py -n 2 --launcher local \
+	    $(PY) tests/nightly/dist_async_kvstore.py
+	$(CPUENV) $(PY) tools/launch.py -n 2 --launcher local \
+	    $(PY) tests/nightly/dist_fused_module.py
+	$(CPUENV) $(PY) tools/launch.py -n 2 --launcher local \
+	    $(PY) tests/nightly/dist_fault_detect.py
+	$(CPUENV) $(PY) tests/nightly/multi_kvstore_types.py
+
+examples:
+	$(CPUENV) $(PY) -m pytest tests/test_examples.py -q
+
+lint:
+	$(CPUENV) $(PY) -m pytest tests/test_lint.py tests/test_docs.py -q
+
+# native libraries: embeddable core C API + predict-only ABI
+libs:
+	$(CPUENV) $(PY) -c "from mxnet_tpu import native; \
+	    print(native.build_core_lib()); \
+	    print(native.build_predict_lib())"
+
+# amalgamated single-file predict bundle -> build/
+predict:
+	$(CPUENV) $(PY) tools/amalgamation.py --out build
+
+docs:
+	$(CPUENV) $(PY) tools/gen_env_docs.py
+
+# multi-chip sharding dryrun (DP / SP+TP / PP / EP) on 8 virtual devices
+dryrun:
+	PALLAS_AXON_POOL_IPS= $(PY) __graft_entry__.py
+
+bench:
+	$(PY) bench.py
+
+clean:
+	rm -rf build __pycache__ */__pycache__ */*/__pycache__
+	rm -f native/libmxtpu_c.so native/libmxtpu_predict.so

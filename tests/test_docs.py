@@ -49,3 +49,12 @@ def test_doc_file_references_exist():
             text = f.read()
         for ref in pat.findall(text):
             assert os.path.exists(os.path.join(ROOT, ref)), (fn, ref)
+
+
+def test_api_doc_in_sync():
+    import gen_api_docs
+
+    with open(os.path.join(ROOT, "docs/api.md")) as f:
+        on_disk = f.read()
+    assert on_disk == gen_api_docs.render(), (
+        "docs/api.md is stale — run python tools/gen_api_docs.py")

@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Generate docs/api.md — a one-line-per-name index of the public
+Python surface (the reference's generated API docs role,
+docs/packages/python/). GENERATED: run after adding public API;
+tests/test_docs.py asserts the checked-in file matches.
+"""
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MODULES = [
+    ("mxnet_tpu", "top level (context helpers, memory, version)"),
+    ("mxnet_tpu.ndarray", "imperative arrays + generated op namespace"),
+    ("mxnet_tpu.symbol", "symbolic graphs + generated op namespace"),
+    ("mxnet_tpu.executor", "bound computation (forward/backward)"),
+    ("mxnet_tpu.autograd", "imperative tape"),
+    ("mxnet_tpu.module", "training API"),
+    ("mxnet_tpu.io", "data iterators"),
+    ("mxnet_tpu.image", "image pipeline"),
+    ("mxnet_tpu.image_det", "detection pipeline"),
+    ("mxnet_tpu.recordio", "RecordIO files"),
+    ("mxnet_tpu.kvstore", "parameter synchronization"),
+    ("mxnet_tpu.optimizer", "optimizers + updater"),
+    ("mxnet_tpu.metric", "evaluation metrics"),
+    ("mxnet_tpu.initializer", "parameter initializers"),
+    ("mxnet_tpu.lr_scheduler", "learning-rate schedules"),
+    ("mxnet_tpu.callback", "fit callbacks"),
+    ("mxnet_tpu.monitor", "per-tensor training monitor"),
+    ("mxnet_tpu.profiler", "host+device tracing"),
+    ("mxnet_tpu.rnn", "RNN cells + bucketing IO"),
+    ("mxnet_tpu.operator", "Python custom ops"),
+    ("mxnet_tpu.rtc", "runtime Pallas kernels"),
+    ("mxnet_tpu.random", "seeded RNG"),
+    ("mxnet_tpu.model", "checkpoints + FeedForward"),
+    ("mxnet_tpu.fault", "failure detection / auto-resume"),
+    ("mxnet_tpu.visualization", "network plots/summaries"),
+    ("mxnet_tpu.models", "model zoo builders"),
+    ("mxnet_tpu.parallel", "mesh/sharding primitives"),
+]
+
+
+def _one_line(doc):
+    if not doc:
+        return ""
+    line = doc.strip().splitlines()[0].strip()
+    return line[:96]
+
+
+def render():
+    import importlib
+
+    out = [
+        "# Python API index",
+        "",
+        "One line per public name (GENERATED — run",
+        "`python tools/gen_api_docs.py`). Generated op namespaces",
+        "(`nd.*` / `sym.*`, 200+ ops) are indexed by",
+        "`MXTpuListAllOpNames`/`mx.sym` dir() rather than listed here.",
+        "",
+    ]
+    for mod_name, blurb in MODULES:
+        mod = importlib.import_module(mod_name)
+        out.append(f"## `{mod_name}` — {blurb}")
+        out.append("")
+        names = getattr(mod, "__all__", None) or [
+            n for n in sorted(dir(mod)) if not n.startswith("_")]
+        rows = []
+        for n in names:
+            obj = getattr(mod, n, None)
+            if inspect.ismodule(obj):
+                continue
+            if not (inspect.isclass(obj) or callable(obj)):
+                continue
+            # only names that BELONG to the package (not numpy/jax
+            # re-exports)
+            owner = getattr(obj, "__module__", "") or ""
+            if not owner.startswith("mxnet_tpu"):
+                continue
+            kind = "class" if inspect.isclass(obj) else "def"
+            rows.append(f"- `{n}` ({kind}) — "
+                        f"{_one_line(inspect.getdoc(obj))}")
+        out.extend(rows or ["- (namespace/generated content)"])
+        out.append("")
+    return "\n".join(out)
+
+
+if __name__ == "__main__":
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "api.md")
+    with open(path, "w") as f:
+        f.write(render())
+    print(f"wrote {path}")

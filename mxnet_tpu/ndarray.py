@@ -689,6 +689,25 @@ def _op_param_order(opdef):
     return order
 
 
+def _op_doc(opdef, func_name, flavor):
+    """Docstring for a generated op function: the registered fn's doc
+    when present, else a synthesized signature summary."""
+    doc = opdef.fn.__doc__
+    ins = ", ".join(opdef.arg_names or ("*inputs",))
+    params = sorted(set(opdef.coerce) | set(opdef.defaults))
+    lines = [doc.strip()] if doc else [f"{opdef.name} operator."]
+    lines.append("")
+    lines.append(f"{flavor} form. Inputs: {ins}.")
+    if params:
+        lines.append(f"Params: {', '.join(params)}.")
+    if opdef.aux_names:
+        lines.append(f"Aux states: {', '.join(opdef.aux_names)}.")
+    alias = [a for a in (opdef.aliases or ()) if a != func_name]
+    if alias:
+        lines.append(f"Also available as: {', '.join(alias)}.")
+    return "\n".join(lines)
+
+
 def _make_op_function(opdef, func_name):
     input_names = tuple(opdef.arg_names or ()) + tuple(opdef.aux_names)
     param_order = _op_param_order(opdef)
@@ -735,7 +754,7 @@ def _make_op_function(opdef, func_name):
         return invoke(opdef, inputs, params, out=out)
 
     op_func.__name__ = func_name
-    op_func.__doc__ = opdef.fn.__doc__
+    op_func.__doc__ = _op_doc(opdef, func_name, "Imperative")
     return op_func
 
 

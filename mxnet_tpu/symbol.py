@@ -706,7 +706,9 @@ def _make_symbol_function(opdef, func_name):
         return _create(opdef, input_syms, params, name=name)
 
     creator.__name__ = func_name
-    creator.__doc__ = opdef.fn.__doc__
+    from .ndarray import _op_doc
+
+    creator.__doc__ = _op_doc(opdef, func_name, "Symbolic")
     return creator
 
 

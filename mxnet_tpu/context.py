@@ -17,6 +17,10 @@ from .base import MXNetError
 
 
 class Context:
+    """Device handle: cpu/gpu/tpu/cpu_pinned + id, backed by a jax.Device.
+
+    The reference Context (include/mxnet/base.h:116-207) with tpu
+    first-class."""
     devtype2str = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 4: "tpu"}
     devstr2type = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "tpu": 4}
 
@@ -90,22 +94,27 @@ def _devices_for_platform(platform: str):
 
 
 def cpu(device_id: int = 0) -> Context:
+    """CPU context."""
     return Context("cpu", device_id)
 
 
 def gpu(device_id: int = 0) -> Context:
+    """GPU context (resolves to the accelerator; alias tier)."""
     return Context("gpu", device_id)
 
 
 def tpu(device_id: int = 0) -> Context:
+    """TPU context."""
     return Context("tpu", device_id)
 
 
 def cpu_pinned(device_id: int = 0) -> Context:
+    """Pinned-host context (maps to cpu under jax)."""
     return Context("cpu_pinned", device_id)
 
 
 def current_context() -> Context:
+    """Innermost `with Context(...)` scope, else the default."""
     stack = getattr(Context._default_ctx, "stack", None)
     if stack:
         return stack[-1]
@@ -119,6 +128,7 @@ def default_context() -> Context:
 
 
 def num_devices(device_type: str = "tpu") -> int:
+    """Process-local device count for a device type."""
     devs = _devices_for_platform(device_type)
     return len(devs)
 

@@ -33,6 +33,13 @@ from .symbol import _topo
 
 
 class Executor:
+    """A Symbol bound to devices and arrays, runnable forward/backward.
+
+    The whole graph traces into ONE jit computation with `jax.vjp` as
+    the Gradient pass (reference GraphExecutor,
+    src/executor/graph_executor.cc); surface: forward/backward/
+    outputs/arg_dict/reshape/monitor."""
+
     def __init__(self, symbol, ctx, args, args_grad, grad_req, aux_states,
                  group2ctx=None, shared_exec=None):
         self._symbol = symbol

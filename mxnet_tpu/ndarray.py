@@ -68,6 +68,13 @@ class Chunk:
 
 
 class NDArray:
+    """Mutable n-d array with imperative semantics over jax buffers.
+
+    A version-tracked Chunk indirection gives the reference's
+    imperative model (in-place ops, write-through views, engine-var
+    identity, lazy asnumpy sync) on immutable XLA arrays — see
+    include/mxnet/ndarray.h:58."""
+
     __slots__ = ("_chunk", "_base", "_index", "_ctx", "writable")
 
     def __init__(self, data, ctx=None, base=None, index=None, writable=True):

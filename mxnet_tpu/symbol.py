@@ -105,6 +105,12 @@ def _topo(heads):
 
 
 class Symbol:
+    """Symbolic graph handle: a list of (Node, output-index) heads.
+
+    Compose with op calls, inspect (list_arguments/outputs/internals),
+    infer shapes/types, serialize to the reference JSON, and bind into
+    an Executor (reference python/mxnet/symbol.py surface)."""
+
     def __init__(self, outputs):
         self._outputs = list(outputs)  # [(Node, int)]
 
@@ -522,6 +528,10 @@ def _graph_infer(heads, known_shapes, known_dtypes, partial=False):
 
 def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
              dtype=None, init=None, **kwargs):
+    """A named graph input/parameter Symbol.
+
+    Extra kwargs become __attr__ annotations (shape, sharding,
+    ctx_group, init, ...)."""
     node = Node(None, name)
     if attr:
         node._extra_attrs.update({k: str(v) for k, v in attr.items()})
@@ -547,6 +557,7 @@ var = Variable
 
 
 def Group(symbols):
+    """One multi-output Symbol from many (reference mx.sym.Group)."""
     outputs = []
     for s in symbols:
         outputs.extend(s._outputs)

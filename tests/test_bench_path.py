@@ -1,0 +1,56 @@
+"""The flagship bench configuration, gated at tiny scale on CPU: the
+exact path bench.py measures (Module + KVStore('tpu') fused step +
+cast_compute(bfloat16) + NHWC + space-to-depth stem) must train with
+finite loss and updating parameters — so driver bench runs can't be
+broken by a config-interaction regression the per-feature tests miss.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import get_resnet
+
+
+def test_flagship_bench_config_trains():
+    np.random.seed(0)
+    batch, classes = 8, 5
+    net = get_resnet(num_classes=classes, num_layers=18,
+                     image_shape=(3, 64, 64), layout="NHWC",
+                     stem="space_to_depth")
+    mod = mx.mod.Module(net, context=[mx.cpu()])
+    mod.bind(data_shapes=[("data", (batch, 64, 64, 3))],
+             label_shapes=[("softmax_label", (batch,))])
+    mod.init_params(mx.initializer.Xavier(factor_type="in",
+                                          magnitude=2.0))
+    mod.init_optimizer(
+        kvstore="tpu", optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                          "wd": 1e-4})
+    mod.cast_compute(jnp.bfloat16)
+
+    rs = np.random.RandomState(0)
+    data = mx.nd.array(rs.uniform(-1, 1, (batch, 64, 64, 3))
+                       .astype("float32"))
+    label = mx.nd.array(rs.randint(0, classes, (batch,))
+                        .astype("float32"))
+    b = mx.io.DataBatch(data=[data], label=[label])
+
+    before = {k: v.asnumpy().copy()
+              for k, v in mod.get_params()[0].items()}
+    for _ in range(3):
+        mod.forward_backward(b)
+        mod.update()
+    mod.sync()
+
+    out = None
+    mod.forward(b, is_train=False)
+    out = mod.get_outputs()[0].asnumpy()
+    assert np.isfinite(out).all(), "non-finite outputs on bench path"
+
+    after = mod.get_params()[0]
+    moved = sum(
+        float(np.abs(after[k].asnumpy() - before[k]).max()) > 0
+        for k in before)
+    assert moved > len(before) * 0.8, "most params must update"
+    # the step accounting the bench divides by must be positive
+    assert mod.train_step_flops() > 0

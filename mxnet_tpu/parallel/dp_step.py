@@ -65,7 +65,8 @@ class FusedTrainStep:
 
     def __init__(self, executor, optimizer, param_names, label_names=(),
                  mesh=None, data_axis="data", compute_dtype=None,
-                 param_specs=None, data_specs=None, logger=logging):
+                 param_specs=None, data_specs=None, batch_scale=None,
+                 logger=logging):
         self._ex = executor
         self._opt = optimizer
         self._logger = logger
@@ -109,6 +110,18 @@ class FusedTrainStep:
         self._base_rng = executor._rng
         self._t = 0  # steps taken through this fused step
         self._nproc = jax.process_count()
+        # how many per-process batches make one global batch: nproc when
+        # the batch shards over a process-spanning data axis, 1 when the
+        # mesh is pure model/seq/pipe (every process feeds the identical
+        # full batch — standard SPMD replicated-input contract). The
+        # Module passes the value from its _multiproc_mesh_plan so ONE
+        # decision governs executor shapes, staging, and rescale_grad.
+        if batch_scale is not None:
+            self._batch_scale = int(batch_scale)
+        else:
+            self._batch_scale = (
+                self._nproc if self._nproc > 1 and mesh is not None
+                and data_axis in mesh.axis_names else 1)
 
         if self._nproc > 1:
             # every process must start from ONE weight lineage (the
@@ -170,11 +183,9 @@ class FusedTrainStep:
         """Place a host/device value under `sharding`. Multi-process:
         the mesh spans processes, so build the global jax.Array from the
         (identical-everywhere) host value instead of device_put."""
-        if self._nproc == 1:
-            return jax.device_put(value, sharding)
-        host = np.asarray(value)
-        return jax.make_array_from_callback(
-            host.shape, sharding, lambda idx: host[idx])
+        from .mesh import global_put
+
+        return global_put(value, sharding)
 
     def _state_sharding(self, state, name):
         """Sharding pytree for one param's optimizer state: leaves with
@@ -353,10 +364,9 @@ class FusedTrainStep:
         Module calls this when params changed outside the fused step —
         set_params, init_params(force_init), an eager update)."""
         def place(x, sh):
-            x = jnp.copy(jnp.asarray(x))
             if sh is not None:
-                x = jax.device_put(x, sh)
-            return x
+                return self._put(np.asarray(x), sh)
+            return jnp.copy(jnp.asarray(x))
 
         for n in self._param_names:
             sh = self._param_sh[n] if self._param_sh is not None else None
@@ -368,14 +378,21 @@ class FusedTrainStep:
         """(params, auxs) as safe-to-expose copies: the live buffers
         will be donated by the next step(), so callers must never hold
         references to them. In mesh mode the copies are materialized on
-        a single device so eager executors can consume them."""
+        a single device so eager executors can consume them.
+
+        Multi-process with model-sharded params this is COLLECTIVE
+        (full_host all-gathers): every process must reach it — get_params
+        / checkpointing must not be rank-guarded (jax multihost
+        contract; the reference's rank-0-only save worked because dist
+        kvstore values were always replicated)."""
         if self._mesh is None:
             leaf = jnp.copy
         elif self._nproc > 1:
-            # params/auxs are replicated in multi-process mode (guarded
-            # at construction), so the local shard IS the full value
-            leaf = lambda v: jnp.asarray(np.asarray(
-                v.addressable_data(0)))
+            # replicated leaves read their local copy; model-sharded
+            # params all-gather to replicated first (full_host)
+            from .mesh import full_host
+
+            leaf = lambda v: jnp.asarray(full_host(v))
         else:
             dev0 = self._mesh.devices.flat[0]
             leaf = lambda v: jax.device_put(v, dev0)
@@ -399,7 +416,11 @@ class FusedTrainStep:
     STATE_FORMAT = "mxnet_tpu/fused_v1"
 
     def get_states(self):
-        host = jax.tree_util.tree_map(np.asarray, self.states)
+        # collective when states are model-sharded multi-process: all
+        # processes must call (see snapshot's contract note)
+        from .mesh import full_host
+
+        host = jax.tree_util.tree_map(full_host, self.states)
         return pickle.dumps(
             {"format": self.STATE_FORMAT, "t": self._t, "states": host}
         )

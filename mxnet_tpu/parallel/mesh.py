@@ -9,6 +9,8 @@ roster, kvstore_dist.h:35-51, without the server tier).
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
@@ -35,12 +37,66 @@ def data_parallel_mesh(n_devices=None):
 
 def make_mesh(axis_sizes: dict):
     """Build a mesh from {axis_name: size}; sizes must multiply to a
-    divisor of the device count. E.g. {'data': 2, 'model': 4}."""
+    divisor of the device count. E.g. {'data': 2, 'model': 4}.
+
+    Multi-process, the 'data' axis is laid out process-major regardless
+    of its position in `axis_sizes`: jax.devices() orders devices by
+    process, so making 'data' the slowest-varying axis aligns process
+    boundaries with batch shards — each process feeds a contiguous
+    global-batch slice (make_array_from_process_local_data's contract)
+    while the model/seq/pipe axes stay intra-process, riding ICI rather
+    than DCN (the scaling-book mesh-major recipe)."""
     names = tuple(axis_sizes.keys())
     sizes = tuple(axis_sizes.values())
     n = int(np.prod(sizes))
-    devs = np.asarray(jax.devices()[:n]).reshape(sizes)
-    return Mesh(devs, names)
+    devs = jax.devices()[:n]
+    if jax.process_count() > 1 and DATA_AXIS in names and len(names) > 1:
+        di = names.index(DATA_AXIS)
+        order = (di,) + tuple(
+            i for i in range(len(names)) if i != di)
+        arr = np.asarray(devs).reshape(
+            tuple(sizes[i] for i in order))
+        arr = np.transpose(arr, np.argsort(order))
+    else:
+        arr = np.asarray(devs).reshape(sizes)
+    return Mesh(arr, names)
+
+
+def global_put(value, sharding):
+    """Place a host value (identical on every process) under `sharding`,
+    including shardings that span processes — the multi-host analog of
+    jax.device_put (which requires addressable devices). Each process
+    supplies only its addressable shards, cut from the full host value."""
+    if jax.process_count() == 1:
+        return jax.device_put(value, sharding)
+    host = np.asarray(value)
+    return jax.make_array_from_callback(
+        host.shape, sharding, lambda idx: host[idx])
+
+
+@functools.lru_cache(maxsize=None)
+def _replicator(mesh):
+    """One cached jitted identity per mesh: reshard-to-replicated (an
+    XLA all-gather). A fresh jit per call would recompile every time."""
+    repl = NamedSharding(mesh, PartitionSpec())
+    return jax.jit(lambda x: x, out_shardings=repl)
+
+
+def full_host(arr):
+    """The FULL global value of a jax Array as np.ndarray, on every
+    process. Process-spanning sharded arrays are resharded to replicated
+    first (ONE compiled all-gather over ICI/DCN — no per-shard host
+    hops), then read from the local copy.
+
+    COLLECTIVE for process-spanning sharded arrays: every process must
+    call it (rank-guarded calls deadlock), same contract as any jax
+    multihost computation."""
+    if getattr(arr, "is_fully_addressable", True):
+        return np.asarray(arr)
+    sh = arr.sharding
+    if not getattr(sh, "is_fully_replicated", False):
+        arr = _replicator(sh.mesh)(arr)
+    return np.asarray(arr.addressable_data(0))
 
 
 def set_mesh(mesh):

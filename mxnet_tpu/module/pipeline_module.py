@@ -4,17 +4,29 @@ user API.
 The reference's only inter-layer model parallelism was manual ctx-group
 placement with cross-device copies (example/model-parallel-lstm/
 lstm.py:48-99, graph_executor.cc:242-318 _CrossDeviceCopy). TPU-native
-redesign: the user supplies ONE stage Symbol (data -> same-shape
-output); S parameter sets for it live stage-major on a 'pipe' mesh
-axis, and microbatches stream through the ppermute ring schedule of
-parallel/pipeline.py inside a single donated jit — forward, backward
-through the whole pipeline, and the optimizer update all in one XLA
-program.
+redesign, two tiers:
 
-Differences from Module: the stage symbol must be shape-preserving and
-aux-free (no BatchNorm moving stats in v1), and the loss is declared at
-construction (`loss='l2'` against a label shaped like the output, or a
-callable jax loss(out, label) -> scalar).
+  - HOMOGENEOUS (one stage Symbol): S parameter sets for the same
+    symbol live stage-major on a 'pipe' mesh axis; microbatches stream
+    through the ppermute ring schedule of parallel/pipeline.py inside a
+    single donated jit.
+  - HETEROGENEOUS (a list of stage Symbols): arbitrary per-stage
+    graphs — shape changes at boundaries, aux state (BatchNorm) —
+    via flat padded per-stage parameter buckets + a lax.switch stage
+    body (parallel/pipeline.py pipeline_apply_hetero). This covers the
+    reference's arbitrary ctx-group splits: embedding + N blocks +
+    head pipelines as S stages.
+
+Both tiers run forward, backward through the whole pipeline, and the
+optimizer update in one XLA program. The loss is declared at
+construction: 'l2', 'softmax_ce' (integer class labels against last-dim
+logits), or a callable jax loss(out, label) -> scalar.
+
+Heterogeneous constraints (v2): every stage's single non-parameter
+input must be named like the module's data (data_names[0]); parameters
+and aux states must be float32 (they ride a shared flat fp32 bucket);
+the optimizer treats each stage's bucket as one parameter (uniform
+lr/wd across params — per-name lr_mult does not apply inside a stage).
 """
 from __future__ import annotations
 
@@ -27,19 +39,36 @@ from .. import context as ctx
 from .. import ndarray as nd
 from .. import optimizer as opt
 from ..base import MXNetError
-from ..initializer import InitDesc
+from ..initializer import InitDesc, Uniform
+
+_FLAT = "pipeline_flat"
 
 
 class PipelineModule(BaseModule):
-    def __init__(self, stage_symbol, num_stages, num_microbatches,
+    def __init__(self, stage_symbol, num_stages=None, num_microbatches=1,
                  data_names=("data",), label_names=("label",),
                  context=None, loss="l2", logger=logging):
         super().__init__(logger=logger)
         if len(data_names) != 1 or len(label_names) != 1:
             raise MXNetError(
                 "PipelineModule takes exactly one data and one label")
-        self._symbol = stage_symbol
-        self._num_stages = int(num_stages)
+        if isinstance(stage_symbol, (list, tuple)):
+            self._hetero = True
+            self._stage_syms = list(stage_symbol)
+            if num_stages is not None and \
+                    int(num_stages) != len(self._stage_syms):
+                raise MXNetError(
+                    f"num_stages {num_stages} != len(stage list) "
+                    f"{len(self._stage_syms)}")
+            self._num_stages = len(self._stage_syms)
+            self._symbol = self._stage_syms[-1]
+        else:
+            self._hetero = False
+            if num_stages is None:
+                raise MXNetError("num_stages required for a single "
+                                 "stage symbol")
+            self._symbol = stage_symbol
+            self._num_stages = int(num_stages)
         self._num_micro = int(num_microbatches)
         self._data_names = list(data_names)
         self._label_names = list(label_names)
@@ -48,14 +77,15 @@ class PipelineModule(BaseModule):
         if isinstance(self._context, (list, tuple)):
             self._context = self._context[0]
         self._loss = loss
-        if stage_symbol.list_auxiliary_states():
+        if not self._hetero and self._symbol.list_auxiliary_states():
             raise MXNetError(
-                "PipelineModule v1 does not support aux states "
-                "(BatchNorm moving stats) in the stage symbol")
-        self._param_names = [
-            n for n in stage_symbol.list_arguments()
-            if n not in self._data_names
-        ]
+                "aux states (BatchNorm moving stats) need the "
+                "heterogeneous tier: pass a LIST of stage symbols")
+        if not self._hetero:
+            self._param_names = [
+                n for n in self._symbol.list_arguments()
+                if n not in self._data_names
+            ]
         self.binded = False
         self.params_initialized = False
         self.optimizer_initialized = False
@@ -72,8 +102,13 @@ class PipelineModule(BaseModule):
         if self.binded and not force_rebind:
             self.logger.warning("Already bound, ignoring bind()")
             return
-        name, shape = (data_shapes[0].name, data_shapes[0].shape) \
-            if hasattr(data_shapes[0], "name") else data_shapes[0]
+        desc = data_shapes[0]
+        if hasattr(desc, "name"):
+            name, shape = desc.name, desc.shape
+            dtype = getattr(desc, "dtype", None) or "float32"
+        else:
+            name, shape = desc[0], desc[1]
+            dtype = "float32"
         if name != self._data_names[0]:
             raise MXNetError(f"expected data name {self._data_names[0]}")
         batch = shape[0]
@@ -82,9 +117,23 @@ class PipelineModule(BaseModule):
                 f"batch {batch} not divisible into {self._num_micro} "
                 "microbatches")
         self._batch_shape = tuple(shape)
+        self._data_dtype = np.dtype(dtype)
         self._mb_shape = (batch // self._num_micro,) + tuple(shape[1:])
         self._mesh = make_mesh({"pipe": self._num_stages})
+        self._nproc = jax.process_count()
 
+        if self._hetero:
+            self._bind_hetero()
+        else:
+            self._bind_homogeneous()
+        self._rng = jax.random.PRNGKey(0)
+        self.binded = True
+        self.for_training = for_training
+        self._jitted = None
+        self._jitted_infer = None
+        self._t = 0
+
+    def _bind_homogeneous(self):
         # one eager executor at microbatch shape supplies the pure
         # stage function + the per-stage parameter shapes
         self._stage_exec = self._symbol.simple_bind(
@@ -95,18 +144,107 @@ class PipelineModule(BaseModule):
         if out_shapes[0] != self._mb_shape:
             raise MXNetError(
                 f"stage symbol must preserve shape: {self._mb_shape} "
-                f"-> {out_shapes[0]}")
+                f"-> {out_shapes[0]} (shape-changing stages need the "
+                "heterogeneous tier: pass a LIST of stage symbols)")
         self._param_shapes = {
             n: tuple(self._stage_exec.arg_dict[n].shape)
             for n in self._param_names
         }
-        self._rng = jax.random.PRNGKey(0)
-        self.binded = True
-        self.for_training = for_training
-        self._jitted = None
-        self._t = 0
+        self._out_shape = out_shapes[0]
+
+    def _bind_hetero(self):
+        """Chain-bind the stage symbols at microbatch shape (stage s's
+        input shape = stage s-1's output shape) and lay out the flat
+        per-stage parameter/aux buckets."""
+        dname = self._data_names[0]
+        self._stage_execs = []
+        self._in_shapes, self._in_dtypes = [], []
+        self._out_shapes_h, self._out_dtypes = [], []
+        in_shape, in_dtype = self._mb_shape, self._data_dtype
+        for s, sym in enumerate(self._stage_syms):
+            if dname not in sym.list_arguments():
+                raise MXNetError(
+                    f"stage {s} has no input named {dname!r}; each "
+                    "stage's single non-parameter input must use the "
+                    "module's data name")
+            ex = sym.simple_bind(
+                ctx=self._context, grad_req="null",
+                type_dict={dname: in_dtype}, **{dname: in_shape})
+            self._stage_execs.append(ex)
+            self._in_shapes.append(tuple(in_shape))
+            self._in_dtypes.append(np.dtype(in_dtype))
+            o = ex.outputs[0]
+            self._out_shapes_h.append(tuple(o.shape))
+            self._out_dtypes.append(np.dtype(str(o.dtype)))
+            in_shape, in_dtype = tuple(o.shape), np.dtype(str(o.dtype))
+        self._out_shape = self._out_shapes_h[-1]
+
+        # flat bucket layout: per stage, [(name, offset, size, shape)]
+        def layout(names, shapes_of):
+            segs, off = [], 0
+            for n in names:
+                shp = shapes_of(n)
+                sz = int(np.prod(shp)) if shp else 1
+                segs.append((n, off, sz, tuple(shp)))
+                off += sz
+            return segs, off
+
+        self._param_segs, self._aux_segs = [], []
+        psizes, asizes = [], []
+        for s, ex in enumerate(self._stage_execs):
+            pnames = [n for n in ex._arg_names if n != dname]
+            for n in pnames + list(ex._aux_names):
+                arr = ex.arg_dict.get(n)
+                if arr is None:
+                    arr = ex.aux_dict[n]
+                d = arr._data.dtype
+                if np.dtype(str(d)) != np.float32:
+                    raise MXNetError(
+                        f"stage {s} param/aux {n!r} is {d}; the "
+                        "heterogeneous pipeline bucket is float32-only")
+            segs, L = layout(
+                pnames, lambda n: ex.arg_dict[n].shape)
+            self._param_segs.append(segs)
+            psizes.append(L)
+            asegs, A = layout(
+                list(ex._aux_names), lambda n: ex.aux_dict[n].shape)
+            self._aux_segs.append(asegs)
+            asizes.append(A)
+        self._lmax = max(psizes) if psizes else 0
+        self._amax = max(asizes) if asizes else 0
+        self._param_names = [
+            f"stage{s}/{n}"
+            for s, segs in enumerate(self._param_segs)
+            for (n, _, _, _) in segs
+        ]
 
     # ------------------------------------------------------- parameters
+    def _sharding(self, leaf):
+        """Stage-major leaves shard over 'pipe'; scalars replicate."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if getattr(leaf, "ndim", 0) >= 1 and \
+                leaf.shape[0] == self._num_stages:
+            return NamedSharding(self._mesh, P("pipe"))
+        return NamedSharding(self._mesh, P())
+
+    def _place(self, tree):
+        import jax
+
+        from ..parallel.mesh import global_put
+
+        return jax.tree_util.tree_map(
+            lambda v: global_put(v, self._sharding(v)), tree)
+
+    def _bcast(self, tree):
+        """Rank-0's host values everywhere (one weight lineage, the
+        fused-step construction rule)."""
+        if self._nproc == 1:
+            return tree
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.broadcast_one_to_all(tree)
+
     def init_params(self, initializer=None, arg_params=None,
                     aux_params=None, allow_missing=False,
                     force_init=False):
@@ -116,6 +254,10 @@ class PipelineModule(BaseModule):
             return
         if not self.binded:
             raise MXNetError("bind before init_params")
+        if self._hetero:
+            self._init_params_hetero(initializer, arg_params,
+                                     aux_params, allow_missing)
+            return
         attrs = self._symbol.attr_dict()
         rs = np.random.RandomState(0)
         stacked = {}
@@ -123,7 +265,7 @@ class PipelineModule(BaseModule):
             if arg_params and pname in arg_params:
                 v = arg_params[pname].asnumpy()
                 if v.shape == (self._num_stages,) + pshape:
-                    stacked[pname] = jnp.asarray(v)
+                    stacked[pname] = v
                     continue
                 stages = [v] * self._num_stages
             elif initializer is not None:
@@ -137,35 +279,86 @@ class PipelineModule(BaseModule):
                           .astype("float32")] * self._num_stages
             else:
                 raise MXNetError(f"no value for parameter {pname}")
-            stacked[pname] = jnp.asarray(np.stack(stages))
-        self.params = self._place(stacked)  # {name: (S,) + shape}
+            stacked[pname] = np.stack(stages)
+        stacked = self._bcast(stacked)
+        self.params = self._place(
+            {k: jnp.asarray(v) for k, v in stacked.items()})
         self.params_initialized = True
 
-    def _sharding(self, leaf):
-        """Stage-major leaves shard over 'pipe'; scalars replicate."""
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
+    def _init_params_hetero(self, initializer, arg_params, aux_params,
+                            allow_missing):
+        import jax.numpy as jnp
 
-        if getattr(leaf, "ndim", 0) >= 1 and \
-                leaf.shape[0] == self._num_stages:
-            return NamedSharding(self._mesh, P("pipe"))
-        return NamedSharding(self._mesh, P())
-
-    def _place(self, tree):
-        import jax
-
-        return jax.tree_util.tree_map(
-            lambda v: jax.device_put(v, self._sharding(v)), tree)
+        rs = np.random.RandomState(0)
+        flat = np.zeros((self._num_stages, self._lmax), np.float32)
+        for s, segs in enumerate(self._param_segs):
+            attrs = self._stage_syms[s].attr_dict()
+            for (n, off, sz, shp) in segs:
+                key = f"stage{s}/{n}"
+                if arg_params and key in arg_params:
+                    v = arg_params[key].asnumpy()
+                elif arg_params and n in arg_params and \
+                        tuple(arg_params[n].shape) == shp:
+                    v = arg_params[n].asnumpy()
+                elif initializer is not None:
+                    a = nd.zeros(shp, ctx=self._context)
+                    initializer(InitDesc(n, attrs.get(n)), a)
+                    v = a.asnumpy()
+                elif allow_missing:
+                    v = rs.uniform(-0.07, 0.07, shp).astype("float32")
+                else:
+                    raise MXNetError(f"no value for parameter {key}")
+                flat[s, off:off + sz] = np.ravel(v)
+        auxf = np.zeros((self._num_stages, max(self._amax, 1)),
+                        np.float32)[:, :self._amax]
+        init = initializer if initializer is not None \
+            else Uniform(0.07)
+        for s, segs in enumerate(self._aux_segs):
+            attrs = self._stage_syms[s].attr_dict()
+            for (n, off, sz, shp) in segs:
+                key = f"stage{s}/{n}"
+                if aux_params and key in aux_params:
+                    v = aux_params[key].asnumpy()
+                else:
+                    # the initializer's name dispatch supplies aux
+                    # defaults (moving_mean zeros, moving_var ONES —
+                    # same path Module.init_params takes)
+                    a = nd.zeros(shp, ctx=self._context)
+                    init(InitDesc(n, attrs.get(n)), a)
+                    v = a.asnumpy()
+                auxf[s, off:off + sz] = np.ravel(v)
+        flat, auxf = self._bcast((flat, auxf))
+        self.params = self._place({_FLAT: jnp.asarray(flat)})
+        self._flat_auxs = self._place(jnp.asarray(auxf))
+        self.params_initialized = True
 
     def get_params(self):
-        host = {k: nd.array(np.asarray(v)) for k, v in self.params.items()}
-        return host, {}
+        """COLLECTIVE multi-process (params are pipe-sharded across
+        processes): every process must call it."""
+        from ..parallel.mesh import full_host
+
+        if not self._hetero:
+            host = {k: nd.array(full_host(v))
+                    for k, v in self.params.items()}
+            return host, {}
+        flat = full_host(self.params[_FLAT])
+        auxf = full_host(self._flat_auxs)
+        args, auxs = {}, {}
+        for s in range(self._num_stages):
+            for (n, off, sz, shp) in self._param_segs[s]:
+                args[f"stage{s}/{n}"] = nd.array(
+                    flat[s, off:off + sz].reshape(shp))
+            for (n, off, sz, shp) in self._aux_segs[s]:
+                auxs[f"stage{s}/{n}"] = nd.array(
+                    auxf[s, off:off + sz].reshape(shp))
+        return args, auxs
 
     # -------------------------------------------------------- optimizer
     def init_optimizer(self, kvstore=None, optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False):
         from ..parallel.dp_step import supports_fused, _to_jnp_tree
+        from ..parallel.mesh import full_host
 
         if isinstance(optimizer, str):
             optimizer = opt.create(optimizer, **dict(optimizer_params))
@@ -176,44 +369,115 @@ class PipelineModule(BaseModule):
         self._optimizer = optimizer
         self.states = self._place({
             n: _to_jnp_tree(
-                optimizer.create_state(i, nd.array(np.asarray(v))))
+                optimizer.create_state(i, nd.array(full_host(v))))
             for i, (n, v) in enumerate(self.params.items())
         })
         self.optimizer_initialized = True
 
     # ------------------------------------------------------ computation
+    def _loss_of(self, out, label):
+        import jax
+        import jax.numpy as jnp
+
+        if callable(self._loss):
+            return self._loss(out, label)
+        if self._loss == "softmax_ce":
+            logp = jax.nn.log_softmax(out, axis=-1)
+            lab = label.astype(jnp.int32)
+            nll = -jnp.take_along_axis(
+                logp, lab[..., None], axis=-1)[..., 0]
+            return jnp.mean(nll)
+        return jnp.mean(jnp.square(out - label))
+
+    def _hetero_stage_fns(self, rng, is_train):
+        """The per-stage bodies pipeline_apply_hetero switches over:
+        unflatten this stage's bucket, run its graph, re-flatten aux."""
+        import jax
+        import jax.numpy as jnp
+
+        dname = self._data_names[0]
+        fns = []
+        for s, ex in enumerate(self._stage_execs):
+            def make(s=s, ex=ex):
+                run = ex._run_graph
+                segs = self._param_segs[s]
+                asegs = self._aux_segs[s]
+
+                def fn(pvec, avec, x, mb_idx):
+                    args = {
+                        n: pvec[off:off + sz].reshape(shp)
+                        for (n, off, sz, shp) in segs
+                    }
+                    auxs = {
+                        n: avec[off:off + sz].reshape(shp)
+                        for (n, off, sz, shp) in asegs
+                    }
+                    r = jax.random.fold_in(
+                        jax.random.fold_in(rng, s), mb_idx)
+                    outs, aux_upd = run(
+                        {**args, dname: x}, auxs, r, is_train)
+                    a2 = avec
+                    for (n, off, sz, shp) in asegs:
+                        if n in aux_upd:
+                            a2 = a2.at[off:off + sz].set(
+                                jnp.ravel(aux_upd[n]).astype(
+                                    jnp.float32))
+                    return outs[0], a2
+
+                fn.in_shape = self._in_shapes[s]
+                fn.in_dtype = self._in_dtypes[s]
+                fn.out_shape = self._out_shapes_h[s]
+                fn.out_dtype = self._out_dtypes[s]
+                return fn
+
+            fns.append(make())
+        return fns
+
     def _build(self):
         import jax
         import jax.numpy as jnp
 
-        from ..parallel.pipeline import pipeline_apply
+        from ..parallel.pipeline import (pipeline_apply,
+                                         pipeline_apply_hetero)
 
-        run = self._stage_exec._run_graph
         mesh = self._mesh
         m = self._num_micro
-        names = self._param_names
-        loss = self._loss
         opt_ = self._optimizer
+        names = list(self.params)
 
-        def loss_fn(params, data, label, rng):
-            def stage_fn(local_params, x, stage_idx):
-                del stage_idx
-                outs, _ = run({**local_params, self._data_names[0]: x},
-                              {}, rng, True)
-                return outs[0]
+        if self._hetero:
+            def loss_fn(params, flat_auxs, data, label, rng):
+                fns = self._hetero_stage_fns(rng, True)
+                mbs = data.reshape((m,) + self._mb_shape)
+                out, new_auxs = pipeline_apply_hetero(
+                    fns, params[_FLAT], flat_auxs, mbs, mesh, "pipe")
+                out = out.reshape((self._batch_shape[0],)
+                                  + self._out_shape[1:])
+                return self._loss_of(out, label), (out, new_auxs)
+        else:
+            run = self._stage_exec._run_graph
 
-            mbs = data.reshape((m,) + self._mb_shape)
-            out = pipeline_apply(stage_fn, params, mbs, mesh, "pipe")
-            out = out.reshape(data.shape)
-            if callable(loss):
-                return loss(out, label), out
-            return jnp.mean(jnp.square(out - label)), out
+            def loss_fn(params, flat_auxs, data, label, rng):
+                def stage_fn(local_params, x, stage_idx):
+                    del stage_idx
+                    outs, _ = run(
+                        {**local_params, self._data_names[0]: x},
+                        {}, rng, True)
+                    return outs[0]
 
-        def train_step(params, states, data, label, lr, t, rng):
+                mbs = data.reshape((m,) + self._mb_shape)
+                out = pipeline_apply(stage_fn, params, mbs, mesh,
+                                     "pipe")
+                out = out.reshape(data.shape)
+                return self._loss_of(out, label), (out, flat_auxs)
+
+        def train_step(params, states, flat_auxs, data, label, lr, t,
+                       rng):
             # rng is a traced argument — a closure capture would be
             # baked into the first compile and freeze stochastic ops
-            (lval, out), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, data, label, rng)
+            (lval, (out, new_auxs)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, flat_auxs, data, label,
+                                       rng)
             new_p, new_s = {}, {}
             for n in names:
                 w2, s2 = opt_.apply_dense(
@@ -221,42 +485,76 @@ class PipelineModule(BaseModule):
                     lr * opt_._lr_mult_for(n), t)
                 new_p[n] = w2
                 new_s[n] = s2
-            return lval, out, new_p, new_s
+            return lval, out, new_p, new_s, new_auxs
 
+        import jax.tree_util as jtu
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         repl = NamedSharding(mesh, P())
-        param_sh = jax.tree_util.tree_map(self._sharding, self.params)
-        state_sh = jax.tree_util.tree_map(self._sharding, self.states)
+        param_sh = jtu.tree_map(self._sharding, self.params)
+        state_sh = jtu.tree_map(self._sharding, self.states)
+        aux_sh = self._sharding(self._hetero_aux_template())
         return jax.jit(
-            train_step, donate_argnums=(0, 1),
-            in_shardings=(param_sh, state_sh, repl, repl, None, None,
-                          None),
-            out_shardings=(None, None, param_sh, state_sh),
+            train_step, donate_argnums=(0, 1, 2),
+            in_shardings=(param_sh, state_sh, aux_sh, repl, repl,
+                          None, None, None),
+            out_shardings=(None, None, param_sh, state_sh, aux_sh),
         )
+
+    def _hetero_aux_template(self):
+        import jax.numpy as jnp
+
+        if self._hetero:
+            return self._flat_auxs
+        # homogeneous tier has no aux; thread a zero-width stack so
+        # both tiers share one train_step signature
+        if not hasattr(self, "_flat_auxs"):
+            self._flat_auxs = self._place(
+                jnp.zeros((self._num_stages, 0), jnp.float32))
+        return self._flat_auxs
+
+    def _stage_data(self, arr):
+        """Batch input -> committed global array (replicated over the
+        mesh); multi-process every rank must feed the identical batch."""
+        from ..parallel.mesh import global_put
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        v = arr._data if isinstance(arr, nd.NDArray) else np.asarray(arr)
+        return global_put(np.asarray(v),
+                          NamedSharding(self._mesh, P()))
 
     def forward_backward(self, data_batch):
         import jax
-        import numpy as np_
 
         assert self.binded and self.params_initialized \
             and self.optimizer_initialized
         self._t += 1
         self._step_rng = jax.random.fold_in(self._rng, self._t)
+        self._hetero_aux_template()
         if self._jitted is None:
             self._jitted = self._build()
-        data = data_batch.data[0]
-        label = data_batch.label[0]
-        data = data._data if isinstance(data, nd.NDArray) \
-            else np_.asarray(data)
-        label = label._data if isinstance(label, nd.NDArray) \
-            else np_.asarray(label)
+        data = self._stage_data(data_batch.data[0])
+        label = self._stage_data(data_batch.label[0])
         o = self._optimizer
         o.num_update += 1
         lr = o.lr_scheduler(o.num_update) if o.lr_scheduler else o.lr
-        self._loss_val, out, self.params, self.states = self._jitted(
-            self.params, self.states, data, label,
+        (self._loss_val, out, self.params, self.states,
+         self._flat_auxs) = self._jitted(
+            self.params, self.states, self._flat_auxs, data, label,
             np.float32(lr), np.int32(self._t), self._step_rng)
+        self._set_outputs(out)
+
+    def _set_outputs(self, out):
+        """Multi-process arrays span processes (not addressable as a
+        whole); read through the local replica."""
+        if self._nproc > 1:
+            import jax.numpy as jnp
+
+            from ..parallel.mesh import full_host
+
+            if getattr(self, "_loss_val", None) is not None:
+                self._loss_val = np.asarray(full_host(self._loss_val))
+            out = jnp.asarray(full_host(out))
         self._outputs = [nd.NDArray(out)]
 
     def update(self):
@@ -272,29 +570,44 @@ class PipelineModule(BaseModule):
     def _build_infer(self):
         import jax
 
-        from ..parallel.pipeline import pipeline_apply
+        from ..parallel.pipeline import (pipeline_apply,
+                                         pipeline_apply_hetero)
 
-        run = self._stage_exec._run_graph
         mesh = self._mesh
         m = self._num_micro
 
-        def infer(params, data, rng):
-            def stage_fn(local_params, x, stage_idx):
-                del stage_idx
-                outs, _ = run(
-                    {**local_params, self._data_names[0]: x},
-                    {}, rng, False)
-                return outs[0]
+        if self._hetero:
+            def infer(params, flat_auxs, data, rng):
+                fns = self._hetero_stage_fns(rng, False)
+                mbs = data.reshape((m,) + self._mb_shape)
+                out, _ = pipeline_apply_hetero(
+                    fns, params[_FLAT], flat_auxs, mbs, mesh, "pipe")
+                return out.reshape((self._batch_shape[0],)
+                                   + self._out_shape[1:])
+        else:
+            run = self._stage_exec._run_graph
 
-            mbs = data.reshape((m,) + self._mb_shape)
-            out = pipeline_apply(stage_fn, params, mbs, mesh, "pipe")
-            return out.reshape(data.shape)
+            def infer(params, flat_auxs, data, rng):
+                def stage_fn(local_params, x, stage_idx):
+                    del stage_idx
+                    outs, _ = run(
+                        {**local_params, self._data_names[0]: x},
+                        {}, rng, False)
+                    return outs[0]
 
+                mbs = data.reshape((m,) + self._mb_shape)
+                out = pipeline_apply(stage_fn, params, mbs, mesh,
+                                     "pipe")
+                return out.reshape(data.shape)
+
+        import jax.tree_util as jtu
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         repl = NamedSharding(mesh, P())
-        param_sh = jax.tree_util.tree_map(self._sharding, self.params)
-        return jax.jit(infer, in_shardings=(param_sh, repl, None))
+        param_sh = jtu.tree_map(self._sharding, self.params)
+        aux_sh = self._sharding(self._hetero_aux_template())
+        return jax.jit(
+            infer, in_shardings=(param_sh, aux_sh, repl, None))
 
     def forward(self, data_batch, is_train=None):
         """Inference through the pipeline: NO backward, NO update, no
@@ -307,11 +620,11 @@ class PipelineModule(BaseModule):
             self.forward_backward(data_batch)
             return
         assert self.binded and self.params_initialized
+        self._hetero_aux_template()
         if getattr(self, "_jitted_infer", None) is None:
             self._jitted_infer = self._build_infer()
-        data = data_batch.data[0]
-        data = data._data if isinstance(data, nd.NDArray) \
-            else np.asarray(data)
+        data = self._stage_data(data_batch.data[0])
         out = self._jitted_infer(
-            self.params, data, jax.random.fold_in(self._rng, 0))
-        self._outputs = [nd.NDArray(out)]
+            self.params, self._flat_auxs, data,
+            jax.random.fold_in(self._rng, 0))
+        self._set_outputs(out)

@@ -96,3 +96,131 @@ def pipeline_apply(fn, stage_params, microbatches, mesh,
         out_specs=P(),
     )
     return fn_sharded(stage_params, microbatches)
+
+
+def pipeline_apply_hetero(stage_fns, flat_params, flat_auxs,
+                          microbatches, mesh, axis_name="pipe"):
+    """GPipe over HETEROGENEOUS stages — arbitrary per-stage programs,
+    shape changes at boundaries, aux (BatchNorm) state — still ONE
+    compiled SPMD program with per-stage memory scaling.
+
+    The reference could split an arbitrary graph across devices with
+    ctx groups (example/model-parallel-lstm/lstm.py:48-99); a
+    homogeneous stage stack can't express embedding + blocks + head.
+    SPMD needs every device to run the same program, so heterogeneity
+    is encoded as data, not code:
+
+      - each stage's parameters are flattened into one padded fp
+        vector; the stack (S, Lmax) shards over `axis_name`, so a
+        device holds ONLY its stage's weights (memory scales with S);
+      - the stage body is `lax.switch(axis_index)` over the S stage
+        functions — one program, S branches, each statically shaped;
+      - boundary activations ride the ppermute ring as flat padded
+        vectors of size max-over-boundaries; each branch unflattens
+        its true input shape and re-pads its output.
+
+    stage_fns: list of S callables
+        fn_s(flat_param_vec, flat_aux_vec, x, mb_idx)
+          -> (y, new_flat_aux_vec)
+        where x is stage s's true-shaped input (for s=0 taken directly
+        from `microbatches`, so integer token inputs are fine) and y is
+        its true-shaped output. in/out shapes are declared by
+        `stage_fns[s].in_shape` / `.out_shape` / `.out_dtype`
+        attributes (set by the caller).
+    flat_params: (S, Lmax) stage-major padded parameter stack.
+    flat_auxs:   (S, Amax) stage-major padded aux stack (Amax may be 0).
+    microbatches: (M, ...) stage-0 inputs, replicated.
+    Returns ((M, *out_shape_last) outputs, (S, Amax) updated auxs).
+    """
+    s = mesh.shape[axis_name]
+    m = microbatches.shape[0]
+    assert len(stage_fns) == s
+
+    import numpy as np
+
+    out_shapes = [tuple(f.out_shape) for f in stage_fns]
+    out_dtype = stage_fns[-1].out_dtype
+    # ring payload: the largest flattened boundary activation
+    emax = max(int(np.prod(sh)) for sh in out_shapes)
+
+    def shard_fn(params, auxs, mb):
+        idx = jax.lax.axis_index(axis_name)
+        p_local = params[0]  # (Lmax,) this stage's padded weights
+        a_local = auxs[0]    # (Amax,)
+        ticks = s + m - 1
+        buf = jnp.zeros((emax,), jnp.float32)
+        buf = jax.lax.pcast(buf, (axis_name,), to="varying")
+        outs = jnp.zeros((m,) + out_shapes[-1], out_dtype)
+        outs = jax.lax.pcast(outs, (axis_name,), to="varying")
+        a_var = a_local  # sharded input: already axis-varying
+
+        def make_branch(si):
+            fn = stage_fns[si]
+
+            def branch(buf, a, mb_idx):
+                if si == 0:
+                    x = mb[mb_idx]
+                else:
+                    e = int(np.prod(fn.in_shape))
+                    x = buf[:e].reshape(fn.in_shape).astype(
+                        fn.in_dtype)
+                y, a2 = fn(p_local, a, x, mb_idx)
+                flat = jnp.ravel(y).astype(jnp.float32)
+                pad = emax - flat.shape[0]
+                if pad:
+                    flat = jnp.concatenate(
+                        [flat, jnp.zeros((pad,), jnp.float32)])
+                return flat, a2, y if si == s - 1 else None
+
+            return branch
+
+        branches = [make_branch(si) for si in range(s)]
+
+        def run_stage(buf, a, mb_idx):
+            # last-stage output must be a uniform shape across
+            # branches for lax.switch: non-last branches fabricate a
+            # zero one
+            def wrap(b):
+                def f(args):
+                    buf, a, mb_idx = args
+                    flat, a2, y = b(buf, a, mb_idx)
+                    if y is None:
+                        y = jax.lax.pcast(
+                            jnp.zeros(out_shapes[-1], out_dtype),
+                            (axis_name,), to="varying")
+                    return flat, a2, y
+                return f
+
+            return jax.lax.switch(
+                idx, [wrap(b) for b in branches], (buf, a, mb_idx))
+
+        def tick(t, carry):
+            buf, outs, a = carry
+            mb_idx = jnp.clip(t - idx, 0, m - 1)
+            active = (t - idx >= 0) & (t - idx < m)
+            y_flat, a2, y_last = run_stage(buf, a, mb_idx)
+            y_flat = jnp.where(active, y_flat, buf)
+            a = jnp.where(active, a2, a)
+            done_idx = jnp.clip(t - (s - 1), 0, m - 1)
+            write = (idx == s - 1) & (t >= s - 1)
+            outs = jnp.where(
+                write, outs.at[done_idx].set(y_last), outs)
+            perm = [(i, (i + 1) % s) for i in range(s)]
+            buf_next = jax.lax.ppermute(y_flat, axis_name, perm)
+            return buf_next, outs, a
+
+        buf, outs, a_var = jax.lax.fori_loop(
+            0, ticks, tick, (buf, outs, a_var))
+        outs = jax.lax.psum(
+            jnp.where(idx == s - 1, outs, jnp.zeros_like(outs)),
+            axis_name,
+        )
+        return outs, a_var[None]
+
+    fn_sharded = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(axis_name), P(axis_name), P()),
+        out_specs=(P(), P(axis_name)),
+    )
+    return fn_sharded(flat_params, flat_auxs, microbatches)

@@ -9,7 +9,7 @@ import sys
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _launch(script, timeout=600, n=2, retries=1):
+def _launch(script, timeout=600, n=2, retries=1, extra_args=()):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PALLAS_AXON_POOL_IPS"] = ""
@@ -24,6 +24,7 @@ def _launch(script, timeout=600, n=2, retries=1):
                 "-n", str(n),
                 sys.executable,
                 os.path.join(ROOT, "tests", "nightly", script),
+                *extra_args,
             ],
             env=env, capture_output=True, text=True, timeout=timeout,
         )
@@ -58,6 +59,33 @@ def test_dist_sync_kvstore_two_workers():
     proc = _launch("dist_sync_kvstore.py")
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert proc.stdout.count("dist_sync_kvstore OK") == 2, (
+        proc.stdout + proc.stderr
+    )
+
+
+def test_dist_model_parallel_two_workers(tmp_path):
+    """Multi-host model parallelism (VERDICT r3 #2): the SP+TP
+    transformer and the dryrun PP config train over ONE
+    process-spanning mesh — 2 procs x 4 devices, TP shardings intact —
+    and their parameters bit-track a single-process 8-device run of
+    the same configs."""
+    import subprocess as sp
+    import sys as _sys
+
+    ref_out = str(tmp_path / "dist_mp_ref.npz")
+    script = os.path.join(ROOT, "tests", "nightly",
+                          "dist_model_parallel.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env.pop("XLA_FLAGS", None)  # the script sets its own device count
+    ref = sp.run([_sys.executable, script, "--ref-out", ref_out],
+                 env=env, capture_output=True, text=True, timeout=600)
+    assert ref.returncode == 0, ref.stdout + ref.stderr
+    proc = _launch("dist_model_parallel.py", timeout=900,
+                   extra_args=("--ref-out", ref_out))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count("dist_model_parallel OK") == 2, (
         proc.stdout + proc.stderr
     )
 

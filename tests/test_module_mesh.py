@@ -245,6 +245,176 @@ def test_pipeline_module_forward_is_pure_inference():
     assert pm._t == t_before
 
 
+def _lm_stages(vocab=23, d=8, t=6):
+    """4 heterogeneous stages: embedding -> block -> block -> head.
+    Shapes change at both ends ((B,T) ints -> (B,T,D) -> (B,T,V))."""
+    def var():
+        return mx.sym.Variable("data")
+
+    emb = mx.sym.Embedding(var(), input_dim=vocab, output_dim=d,
+                           name="emb")
+    blk1 = mx.sym.Activation(
+        mx.sym.FullyConnected(var(), num_hidden=d, flatten=False,
+                              no_bias=True, name="b1fc"),
+        act_type="tanh", name="b1act")
+    blk2 = mx.sym.Activation(
+        mx.sym.FullyConnected(var(), num_hidden=d, flatten=False,
+                              no_bias=True, name="b2fc"),
+        act_type="tanh", name="b2act")
+    head = mx.sym.FullyConnected(var(), num_hidden=vocab,
+                                 flatten=False, no_bias=True,
+                                 name="head")
+    return [emb, blk1, blk2, head]
+
+
+def test_pipeline_hetero_lm_trains():
+    """Heterogeneous pipeline (VERDICT r3 #4): an embedding + blocks +
+    head LM trains as 4 stages — shape changes at both boundaries,
+    integer token inputs — and the loss decreases."""
+    vocab, d, t = 23, 8, 6
+    pm = mx.mod.PipelineModule(
+        _lm_stages(vocab, d, t), num_microbatches=4,
+        context=mx.cpu(), loss="softmax_ce")
+    B = 16
+    pm.bind(data_shapes=[("data", (B, t))])
+    pm.init_params(mx.initializer.Xavier())
+    pm.init_optimizer(optimizer="sgd",
+                      optimizer_params=(("learning_rate", 2.0),
+                                        ("momentum", 0.9)))
+    rs = np.random.RandomState(0)
+    x = rs.randint(0, vocab, (B, t)).astype("float32")
+    y = np.roll(x, -1, axis=1)
+    losses = []
+    for _ in range(20):
+        b = mx.io.DataBatch(data=[mx.nd.array(x)],
+                            label=[mx.nd.array(y)])
+        pm.forward_backward(b)
+        pm.update()
+        losses.append(pm.loss_value)
+    assert losses[-1] < losses[0] * 0.75, losses
+    out = pm.get_outputs()[0].asnumpy()
+    assert out.shape == (B, t, vocab) and np.isfinite(out).all()
+    # each stage's bucket is genuinely distributed over the pipe axis
+    flat = pm.params["pipeline_flat"]
+    assert len(flat.sharding.device_set) == 4
+
+
+def test_pipeline_hetero_matches_unpipelined():
+    """The heterogeneous GPipe schedule computes exactly the
+    unpipelined sequential composition: identical init + identical
+    batches -> identical parameters after 3 SGD steps."""
+    import jax
+    import jax.numpy as jnp
+
+    vocab, d, t = 13, 4, 4
+    B, M, steps, lr = 8, 4, 3, 0.2
+    pm = mx.mod.PipelineModule(
+        _lm_stages(vocab, d, t), num_microbatches=M,
+        context=mx.cpu(), loss="softmax_ce")
+    pm.bind(data_shapes=[("data", (B, t))])
+    pm.init_params(mx.initializer.Xavier(rnd_type="gaussian",
+                                         magnitude=1.0))
+    pm.init_optimizer(optimizer="sgd",
+                      optimizer_params=(("learning_rate", lr),))
+    init_params, _ = pm.get_params()
+    init_host = {k: v.asnumpy() for k, v in init_params.items()}
+
+    rs = np.random.RandomState(5)
+    xs = [rs.randint(0, vocab, (B, t)).astype("float32")
+          for _ in range(steps)]
+    ys = [np.roll(x, -1, axis=1) for x in xs]
+    for x, y in zip(xs, ys):
+        pm.forward_backward(mx.io.DataBatch(
+            data=[mx.nd.array(x)], label=[mx.nd.array(y)]))
+        pm.update()
+    got, _ = pm.get_params()
+
+    # unpipelined reference: same stage graphs composed sequentially
+    # on one device, full-batch loss, plain SGD
+    execs = pm._stage_execs
+    segs = pm._param_segs
+
+    def compose(params, x):
+        h = jnp.asarray(x)
+        for s, ex in enumerate(execs):
+            args = {n: params[f"stage{s}/{n}"]
+                    for (n, _, _, _) in segs[s]}
+            outs, _ = ex._run_graph(
+                {**args, "data": h}, {}, jax.random.PRNGKey(0), True)
+            h = outs[0]
+        return h
+
+    def loss(params, x, y):
+        logp = jax.nn.log_softmax(compose(params, x), axis=-1)
+        lab = jnp.asarray(y).astype(jnp.int32)
+        return -jnp.mean(jnp.take_along_axis(
+            logp, lab[..., None], axis=-1))
+
+    ref = {k: jnp.asarray(v) for k, v in init_host.items()}
+    gfn = jax.jit(jax.grad(loss))
+    for x, y in zip(xs, ys):
+        g = gfn(ref, x, y)
+        ref = {k: ref[k] - lr * g[k] for k in ref}
+    for k in ref:
+        np.testing.assert_allclose(
+            got[k].asnumpy(), np.asarray(ref[k]), rtol=2e-4,
+            atol=2e-5, err_msg=k)
+
+
+def test_pipeline_hetero_batchnorm_aux():
+    """Aux state (BatchNorm moving stats) rides the pipeline: stats
+    update per microbatch in order, matching a sequential-microbatch
+    reference, and inference uses the trained stats."""
+    import jax
+
+    d_in, d_mid = 6, 5
+    B, M = 8, 4
+    s1 = mx.sym.FullyConnected(mx.sym.Variable("data"),
+                               num_hidden=d_mid, no_bias=True,
+                               name="fc1")
+    s2 = mx.sym.BatchNorm(mx.sym.Variable("data"), name="bn")
+    s3 = mx.sym.FullyConnected(mx.sym.Variable("data"),
+                               num_hidden=2, no_bias=True, name="fc2")
+    pm = mx.mod.PipelineModule(
+        [s1, s2, s3], num_microbatches=M, context=mx.cpu(), loss="l2")
+    pm.bind(data_shapes=[("data", (B, d_in))])
+    pm.init_params(mx.initializer.Xavier())
+    pm.init_optimizer(optimizer="sgd",
+                      optimizer_params=(("learning_rate", 0.0),))
+    _, aux0 = pm.get_params()
+    mean0 = aux0["stage1/bn_moving_mean"].asnumpy().copy()
+
+    rs = np.random.RandomState(2)
+    x = (rs.rand(B, d_in).astype("float32") * 3 + 1)
+    y = np.zeros((B, 2), "float32")
+    pm.forward_backward(mx.io.DataBatch(
+        data=[mx.nd.array(x)], label=[mx.nd.array(y)]))
+    pm.update()
+    _, aux1 = pm.get_params()
+    mean1 = aux1["stage1/bn_moving_mean"].asnumpy()
+    var1 = aux1["stage1/bn_moving_var"].asnumpy()
+    assert np.abs(mean1 - mean0).max() > 0, "stats never updated"
+
+    # sequential-microbatch reference through the same stage graphs
+    import jax.numpy as jnp
+
+    ex1, ex2 = pm._stage_execs[0], pm._stage_execs[1]
+    w1 = pm.get_params()[0]["stage0/fc1_weight"].asnumpy()
+    auxs = {"bn_moving_mean": jnp.zeros(d_mid),
+            "bn_moving_var": jnp.ones(d_mid)}
+    args2 = {"bn_gamma": jnp.ones(d_mid), "bn_beta": jnp.zeros(d_mid)}
+    mb = B // M
+    for i in range(M):
+        h = jnp.asarray(x[i * mb:(i + 1) * mb] @ w1.T)
+        _, upd = ex2._run_graph(
+            {**args2, "data": h}, auxs, jax.random.PRNGKey(0), True)
+        auxs = {k: upd.get(k, v) for k, v in auxs.items()}
+    np.testing.assert_allclose(mean1, np.asarray(
+        auxs["bn_moving_mean"]), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(var1, np.asarray(
+        auxs["bn_moving_var"]), rtol=1e-4, atol=1e-5)
+
+
 def test_sharding_attr_unknown_axis_ignored():
     """A __sharding__ attr referencing an axis absent from the mesh is
     dropped with a warning, not a crash."""

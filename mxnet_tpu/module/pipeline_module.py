@@ -76,6 +76,10 @@ class PipelineModule(BaseModule):
             else ctx.current_context()
         if isinstance(self._context, (list, tuple)):
             self._context = self._context[0]
+        if not callable(loss) and loss not in ("l2", "softmax_ce"):
+            raise MXNetError(
+                f"unknown loss {loss!r}: expected 'l2', 'softmax_ce' "
+                "or a callable jax loss(out, label) -> scalar")
         self._loss = loss
         if not self._hetero and self._symbol.list_auxiliary_states():
             raise MXNetError(
@@ -309,8 +313,7 @@ class PipelineModule(BaseModule):
                 else:
                     raise MXNetError(f"no value for parameter {key}")
                 flat[s, off:off + sz] = np.ravel(v)
-        auxf = np.zeros((self._num_stages, max(self._amax, 1)),
-                        np.float32)[:, :self._amax]
+        auxf = np.zeros((self._num_stages, self._amax), np.float32)
         init = initializer if initializer is not None \
             else Uniform(0.07)
         for s, segs in enumerate(self._aux_segs):

@@ -140,8 +140,12 @@ def pipeline_apply_hetero(stage_fns, flat_params, flat_auxs,
 
     out_shapes = [tuple(f.out_shape) for f in stage_fns]
     out_dtype = stage_fns[-1].out_dtype
-    # ring payload: the largest flattened boundary activation
-    emax = max(int(np.prod(sh)) for sh in out_shapes)
+    # ring payload: the largest flattened boundary activation. The
+    # LAST stage's output never rides the ring (stage 0 ignores its
+    # incoming buf), so it is excluded — for an LM whose head emits
+    # vocab-sized logits this keeps the ppermute at d_model width.
+    emax = max((int(np.prod(sh)) for sh in out_shapes[:-1]),
+               default=1)
 
     def shard_fn(params, auxs, mb):
         idx = jax.lax.axis_index(axis_name)
@@ -166,6 +170,8 @@ def pipeline_apply_hetero(stage_fns, flat_params, flat_auxs,
                         fn.in_dtype)
                 y, a2 = fn(p_local, a, x, mb_idx)
                 flat = jnp.ravel(y).astype(jnp.float32)
+                if flat.shape[0] > emax:  # last stage: ring discards it
+                    flat = flat[:emax]
                 pad = emax - flat.shape[0]
                 if pad:
                     flat = jnp.concatenate(

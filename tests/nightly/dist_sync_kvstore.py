@@ -26,6 +26,13 @@ def main():
     nworker = kv.num_workers
     assert nworker == int(os.environ["MXNET_TPU_NUM_WORKERS"])
 
+    # the cross-process sum must run DEVICE-NATIVE (one jitted
+    # all-reduce over the process mesh); forbid the host fallback
+    def _no_host(*a, **k):
+        raise AssertionError("host-staged _host_sum ran")
+
+    kv._host_sum = _no_host
+
     shape = (3, 4)
     keys = ["k1", "k2"]
     for k in keys:

@@ -41,9 +41,15 @@ def main():
     import numpy as np
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+    # join the worker group BEFORE any process_count check when run
+    # under tools/launch.py (env-var rendezvous, kvstore_tpu.py)
+    from mxnet_tpu.parallel.kvstore_tpu import maybe_init_distributed
+
+    maybe_init_distributed()
+
     n_elem = args.size_mb * (1 << 20) // 4
     host = np.random.default_rng(0).random(n_elem, np.float32)
-    dev = jax.devices()[0]
+    dev = jax.local_devices()[0]
 
     def fence(x):
         jax.block_until_ready(x)
@@ -67,9 +73,12 @@ def main():
     _emit("device_to_host", args.size_mb / 1024 * args.iters / dt,
           args.size_mb)
 
-    # ---- all-reduce over the device mesh (the fused gradient path)
+    # ---- all-reduce over the device mesh (the fused gradient path);
+    # single-process only: the fence fetches the full array, which a
+    # process-spanning mesh forbids (multi-process is measured by the
+    # cross_process_sum section below)
     devs = jax.devices()
-    if len(devs) > 1:
+    if len(devs) > 1 and jax.process_count() == 1:
         mesh = Mesh(np.asarray(devs), ("data",))
         repl = NamedSharding(mesh, P())
         sh = NamedSharding(mesh, P("data"))
@@ -104,6 +113,26 @@ def main():
     dt = time.perf_counter() - t0
     _emit("kvstore_push_pull", 2 * args.size_mb / 1024 * args.iters / dt,
           args.size_mb, {"kv_type": kv.type})
+
+    # ---- cross-process gradient sum: device-native vs host-staged
+    # (VERDICT r3 #3 acceptance). On the CPU loopback mesh both paths
+    # share one TCP transport, so the device path's edge is only the
+    # eliminated numpy staging; on real multi-host TPU the host path
+    # additionally pays PCIe D2H+H2D while the device path rides
+    # ICI/DCN directly.
+    if jax.process_count() > 1:
+        val = mx.nd.array(host.reshape(-1, 1024))
+        for name in ("device", "host"):
+            fn = getattr(kv, f"_{name}_sum")
+            fn(val).asnumpy()  # warm (compile + rendezvous)
+            t0 = time.perf_counter()
+            for _ in range(args.iters):
+                r = fn(val)
+            r.asnumpy()
+            dt = time.perf_counter() - t0
+            _emit(f"cross_process_sum_{name}",
+                  args.size_mb / 1024 * args.iters / dt,
+                  args.size_mb, {"workers": jax.process_count()})
 
 
 if __name__ == "__main__":

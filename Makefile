@@ -35,11 +35,14 @@ examples:
 lint:
 	$(CPUENV) $(PY) -m pytest tests/test_lint.py tests/test_docs.py -q
 
-# native libraries: embeddable core C API + predict-only ABI
+# native libraries: embeddable core C API + predict-only ABI +
+# IO cores (recordio reader, JPEG decode pool, dependency engine)
 libs:
 	$(CPUENV) $(PY) -c "from mxnet_tpu import native; \
 	    print(native.build_core_lib()); \
-	    print(native.build_predict_lib())"
+	    print(native.build_predict_lib()); \
+	    native.get_lib(); native.get_lib_imgdec(); \
+	    native.get_lib_engine(); print('io/engine libs OK')"
 
 # amalgamated single-file predict bundle -> build/
 predict:

@@ -90,6 +90,37 @@ def _emit(record):
     sys.stdout.flush()
 
 
+def _synth_recordio(n, classes, side=(280, 320)):
+    """ImageNet-shaped .rec of natural-entropy synthetic JPEGs (smooth
+    fields + mild noise — realistic decode cost, unlike pure noise)."""
+    import tempfile
+
+    import numpy as np
+
+    from mxnet_tpu import recordio
+
+    tmp = tempfile.mkdtemp(prefix="bench_rec_")
+    path = os.path.join(tmp, "bench")
+    rec = recordio.MXIndexedRecordIO(path + ".idx", path + ".rec", "w")
+    rs = np.random.RandomState(0)
+    h, w = side
+    yy, xx = np.mgrid[0:h, 0:w].astype("float32")
+    for i in range(n):
+        f1, f2 = rs.uniform(10, 60, 2)
+        base = np.stack([
+            128 + 100 * np.sin(xx / f1 + i) * np.cos(yy / f2),
+            128 + 90 * np.cos(xx / f2) * np.sin(yy / f1 + i),
+            128 + 80 * np.sin((xx + yy) / (f1 + f2)),
+        ], axis=2)
+        img = (base + rs.normal(0, 8, (h, w, 3))).clip(0, 255) \
+            .astype("uint8")
+        rec.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % classes), i, 0), img,
+            quality=90))
+    rec.close()
+    return path + ".rec"
+
+
 def main():
     # The real chip registers as platform "axon" (tunnel), not "tpu" —
     # anything non-cpu counts as the accelerator.
@@ -159,21 +190,58 @@ def main():
     if dtype == "bfloat16":
         mod.cast_compute(jnp.bfloat16)
 
+    # BENCH_DATA=recordio trains from the REAL input pipeline
+    # (ImageRecordIter: native JPEG decode+augment workers + prefetch
+    # overlap) so the reported number is MFU-with-IO; default feeds a
+    # resident synthetic batch (pure-compute MFU). BENCH_REC points at
+    # an existing .rec; otherwise an ImageNet-shaped one is synthesized.
+    data_mode = os.environ.get("BENCH_DATA", "synthetic")
     rs = np.random.RandomState(0)
-    data = mx.nd.array(rs.uniform(-1, 1, dshape).astype("float32"),
-                       ctx=ctx)
-    label = mx.nd.array(rs.randint(0, classes, (batch,)).astype("float32"),
-                        ctx=ctx)
-    batch_obj = mx.io.DataBatch(data=[data], label=[label])
+    if data_mode == "recordio":
+        rec_path = os.environ.get("BENCH_REC") or _synth_recordio(
+            n=max(2048, batch), classes=classes)
+        from mxnet_tpu.image import ImageRecordIter
+
+        rec_it = ImageRecordIter(
+            path_imgrec=rec_path, batch_size=batch, data_shape=image,
+            rand_crop=True, rand_mirror=True,
+            mean_r=123.68, mean_g=116.28, mean_b=103.53,
+            std_r=58.395, std_g=57.12, std_b=57.375,
+            preprocess_threads=int(
+                os.environ.get("BENCH_DATA_THREADS", "8")),
+            data_layout=layout)
+
+        def batches():
+            while True:
+                got = False
+                for b in rec_it:
+                    if b.pad == 0:
+                        got = True
+                        yield b
+                if not got:
+                    raise RuntimeError(
+                        f"recordio dataset yields no full batch of "
+                        f"{batch}; point BENCH_REC at a larger .rec")
+                rec_it.reset()
+
+        feed = batches()
+        next_batch = lambda: next(feed)  # noqa: E731
+    else:
+        data = mx.nd.array(rs.uniform(-1, 1, dshape).astype("float32"),
+                           ctx=ctx)
+        label = mx.nd.array(
+            rs.randint(0, classes, (batch,)).astype("float32"), ctx=ctx)
+        batch_obj = mx.io.DataBatch(data=[data], label=[label])
+        next_batch = lambda: batch_obj  # noqa: E731
 
     # warmup / compile
-    mod.forward_backward(batch_obj)
+    mod.forward_backward(next_batch())
     mod.update()
     mod.sync()
 
     t0 = time.perf_counter()
     for _ in range(iters):
-        mod.forward_backward(batch_obj)
+        mod.forward_backward(next_batch())
         mod.update()
     mod.sync()
     dt = time.perf_counter() - t0
@@ -193,7 +261,8 @@ def main():
     mem = mx.memory_stats(ctx)
     _emit({
         "metric": f"resnet{num_layers}_train_throughput_{platform}"
-                  f"_b{batch}_{dtype}_{layout.lower()}",
+                  f"_b{batch}_{dtype}_{layout.lower()}"
+                  + ("_recio" if data_mode == "recordio" else ""),
         "value": round(img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(vs, 3),

@@ -328,8 +328,13 @@ class ImageIter(_io.DataIter):
                  path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
                  aug_list=None, imglist=None, data_name="data",
                  label_name="softmax_label", preprocess_threads=4,
-                 **kwargs):
+                 data_layout="NCHW", **kwargs):
         super().__init__(batch_size)
+        # NHWC emits channel-last batches directly (TPU-native layout;
+        # the native decoder writes either layout at identical cost)
+        self.data_layout = data_layout.upper()
+        if self.data_layout not in ("NCHW", "NHWC"):
+            raise MXNetError(f"bad data_layout {data_layout!r}")
         # decode+augment worker pool (the analog of the reference's
         # OMP-parallel ImageRecordIOParser2 threads,
         # src/io/iter_image_recordio_2.cc:28 — PIL/cv2 release the GIL
@@ -391,8 +396,11 @@ class ImageIter(_io.DataIter):
         self.path_root = path_root
 
         self.check_data_shape(data_shape)
+        c_, h_, w_ = data_shape
+        out_shape = (c_, h_, w_) if self.data_layout == "NCHW" \
+            else (h_, w_, c_)
         self.provide_data = [_io.DataDesc(data_name,
-                                          (batch_size,) + data_shape)]
+                                          (batch_size,) + out_shape)]
         if label_width > 1:
             self.provide_label = [
                 _io.DataDesc(label_name, (batch_size, label_width))]
@@ -419,8 +427,14 @@ class ImageIter(_io.DataIter):
             self.seq = self.seq[part_index * C: (part_index + 1) * C]
         if aug_list is None:
             self.auglist = CreateAugmenter(data_shape, **kwargs)
+            # fused native decode path (JPEG -> crop/mirror/normalize
+            # -> CHW float32 in C++ worker threads) when the augment
+            # set maps onto it; None = python augmenters
+            self._native_dec = self._try_native_decoder(
+                data_shape, kwargs)
         else:
             self.auglist = aug_list
+            self._native_dec = None
         self.cur = 0
         # decoded-but-unbatched (img, label) pairs: augmenters with
         # fan-out > 1 can overshoot a batch; the excess carries over
@@ -456,6 +470,38 @@ class ImageIter(_io.DataIter):
         header, img = recordio.unpack(s)
         return header.label, img
 
+    def _try_native_decoder(self, data_shape, kwargs):
+        """NativeImageDecoder covering this iterator's augment set, or
+        None when the set needs the python augmenters (color jitter,
+        PCA noise, random-sized crop, custom interpolation)."""
+        if data_shape[0] != 3:
+            return None
+        covered = {"resize", "rand_crop", "rand_mirror", "mean", "std",
+                   "inter_method"}
+        for k, v in kwargs.items():
+            if k not in covered and v:
+                return None
+        if kwargs.get("inter_method", 2) != 2:
+            return None
+        mean = kwargs.get("mean")
+        std = kwargs.get("std")
+        if mean is True:
+            mean = np.array([123.68, 116.28, 103.53])
+        if std is True:
+            std = np.array([58.395, 57.12, 57.375])
+        try:
+            from . import native as _native
+
+            return _native.NativeImageDecoder(
+                nthreads=self.preprocess_threads,
+                resize_short=int(kwargs.get("resize", 0) or 0),
+                rand_crop=bool(kwargs.get("rand_crop", False)),
+                rand_mirror=bool(kwargs.get("rand_mirror", False)),
+                mean=mean, std=std, layout=self.data_layout)
+        except Exception as exc:
+            logging.debug("native image decoder unavailable: %s", exc)
+            return None
+
     def _decode_augment(self, raw):
         """Worker: raw bytes -> list of augmented HWC numpy images."""
         data = [imdecode(raw)]
@@ -465,8 +511,13 @@ class ImageIter(_io.DataIter):
             data = [ret for src in data for ret in aug(src)]
         return [d.asnumpy() for d in data]
 
-    def _write_sample(self, batch_data, batch_label, i, img, label):
-        batch_data[i] = img.transpose(2, 0, 1)
+    def _batch_shape(self):
+        c, h, w = self.data_shape
+        return ((self.batch_size, c, h, w)
+                if self.data_layout == "NCHW"
+                else (self.batch_size, h, w, c))
+
+    def _write_label(self, batch_label, i, label):
         lab = label.asnumpy() if isinstance(label, nd.NDArray) \
             else np.asarray(label)
         if self.label_width == 1:
@@ -474,14 +525,71 @@ class ImageIter(_io.DataIter):
         else:
             batch_label[i] = lab.reshape(-1)[: self.label_width]
 
+    def _write_sample(self, batch_data, batch_label, i, img, label):
+        batch_data[i] = img.transpose(2, 0, 1) \
+            if self.data_layout == "NCHW" else img
+        self._write_label(batch_label, i, label)
+
+    def _next_native(self):
+        """Batch assembly through the fused native decoder: raw JPEG
+        bytes go straight to the C++ pool, which writes normalized CHW
+        float32 rows into the batch buffer (the reference's OMP threads
+        writing into the batch, iter_image_recordio_2.cc:28-490).
+        Non-JPEG/corrupt records fall back to the python decoder
+        per-image."""
+        batch_size = self.batch_size
+        batch_data = np.zeros(self._batch_shape(), dtype=np.float32)
+        batch_label = np.zeros(
+            (batch_size,) if self.label_width == 1
+            else (batch_size, self.label_width), dtype=np.float32)
+        i = 0
+        exhausted = False
+        while i < batch_size and not exhausted:
+            raw = []
+            try:
+                while len(raw) < batch_size - i:
+                    raw.append(self.next_sample())
+            except StopIteration:
+                exhausted = True
+            if not raw:
+                break
+            blobs = [bytes(s) for _, s in raw]
+            out_view = batch_data[i:i + len(raw)]
+            ok = self._native_dec.decode_batch(
+                blobs, out_view, seed=random.getrandbits(63))
+            valid = []
+            for j, (label, s) in enumerate(raw):
+                if not ok[j]:
+                    # non-JPEG or corrupt: python path for this image
+                    imgs = self._decode_augment(s)
+                    if not imgs:
+                        logging.debug("Invalid image, skipping.")
+                        continue
+                    out_view[j] = imgs[0].transpose(2, 0, 1) \
+                        if self.data_layout == "NCHW" else imgs[0]
+                valid.append(j)
+            for dst, j in enumerate(valid):
+                if dst != j:
+                    out_view[dst] = out_view[j]
+                self._write_label(batch_label, i + dst, raw[j][0])
+            i += len(valid)
+        if i == 0:
+            raise StopIteration
+        return _io.DataBatch(
+            data=[nd.array(batch_data)], label=[nd.array(batch_label)],
+            pad=batch_size - i, index=None,
+            provide_data=self.provide_data,
+            provide_label=self.provide_label)
+
     def next(self):
         """Assemble a batch: samples are read sequentially from the
         record stream, then decode+augment fans out over the worker
         pool (reference: OMP threads write straight into the batch,
         iter_image_recordio_2.cc:28-490)."""
+        if self._native_dec is not None:
+            return self._next_native()
         batch_size = self.batch_size
-        c, h, w = self.data_shape
-        batch_data = np.zeros((batch_size, c, h, w), dtype=np.float32)
+        batch_data = np.zeros(self._batch_shape(), dtype=np.float32)
         batch_label = np.zeros(
             (batch_size,) if self.label_width == 1
             else (batch_size, self.label_width), dtype=np.float32)
@@ -549,7 +657,8 @@ def ImageRecordIter(path_imgrec=None, data_shape=(3, 224, 224),
                     mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0,
                     rand_crop=False, rand_mirror=False, path_imgidx=None,
                     preprocess_threads=4, prefetch_buffer=4,
-                    part_index=0, num_parts=1, label_width=1, **kwargs):
+                    part_index=0, num_parts=1, label_width=1,
+                    data_layout="NCHW", **kwargs):
     """Compatibility constructor matching the C++ ImageRecordIter params
     (src/io/iter_image_recordio_2.cc:559 registration), returning an
     ImageIter wrapped in a PrefetchingIter (the analog of the fused
@@ -565,6 +674,7 @@ def ImageRecordIter(path_imgrec=None, data_shape=(3, 224, 224),
         path_imgrec=path_imgrec, path_imgidx=path_imgidx, shuffle=shuffle,
         rand_crop=rand_crop, rand_mirror=rand_mirror, mean=mean, std=std,
         part_index=part_index, num_parts=num_parts,
-        label_width=label_width,
+        label_width=label_width, preprocess_threads=preprocess_threads,
+        data_layout=data_layout,
     )
     return _io.PrefetchingIter(it)

@@ -99,6 +99,144 @@ def available():
         return False
 
 
+_IMGDEC_SRC = os.path.join(_NATIVE_DIR, "image_decode.cc")
+_IMGDEC_SO = os.path.join(_NATIVE_DIR, "libimage_decode.so")
+_imgdec_lib = None
+
+
+def get_lib_imgdec():
+    """Load (building if needed) the native JPEG decode+augment pool
+    (native/image_decode.cc; links the system libjpeg)."""
+    global _imgdec_lib
+    if _imgdec_lib is not None:
+        return _imgdec_lib
+    with _lock:
+        if _imgdec_lib is not None:
+            return _imgdec_lib
+        if not os.path.exists(_IMGDEC_SRC):
+            raise MXNetError(f"native source missing: {_IMGDEC_SRC}")
+        if (
+            not os.path.exists(_IMGDEC_SO)
+            or os.path.getmtime(_IMGDEC_SO)
+            < os.path.getmtime(_IMGDEC_SRC)
+        ):
+            proc = subprocess.run(
+                ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                 "-pthread", _IMGDEC_SRC, "-ljpeg", "-o", _IMGDEC_SO],
+                capture_output=True, text=True,
+            )
+            if proc.returncode != 0:
+                raise MXNetError(
+                    f"native image decoder build failed:\n{proc.stderr}"
+                )
+        lib = ctypes.CDLL(_IMGDEC_SO)
+        lib.imgdec_create.restype = ctypes.c_void_p
+        lib.imgdec_create.argtypes = [ctypes.c_int]
+        lib.imgdec_destroy.restype = None
+        lib.imgdec_destroy.argtypes = [ctypes.c_void_p]
+        lib.imgdec_batch.restype = None
+        lib.imgdec_batch.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint8),   # blob
+            ctypes.POINTER(ctypes.c_int64),   # offsets
+            ctypes.POINTER(ctypes.c_int64),   # lens
+            ctypes.c_int,                     # n
+            ctypes.c_int, ctypes.c_int,       # out_h, out_w
+            ctypes.c_int,                     # resize_short
+            ctypes.c_int, ctypes.c_int,       # rand_crop, rand_mirror
+            ctypes.c_int,                     # chw layout
+            ctypes.c_uint64,                  # seed
+            ctypes.POINTER(ctypes.c_float),   # mean (or None)
+            ctypes.POINTER(ctypes.c_float),   # std (or None)
+            ctypes.POINTER(ctypes.c_float),   # out
+            ctypes.POINTER(ctypes.c_uint8),   # ok flags
+        ]
+        _imgdec_lib = lib
+        return _imgdec_lib
+
+
+class NativeImageDecoder(object):
+    """Fused JPEG decode -> resize-short -> crop -> mirror -> normalize
+    -> CHW float32, on a persistent native thread pool (the
+    ImageRecordIOParser2 analog, iter_image_recordio_2.cc:28)."""
+
+    def __init__(self, nthreads=4, resize_short=0, rand_crop=False,
+                 rand_mirror=False, mean=None, std=None,
+                 layout="NCHW"):
+        import numpy as np
+
+        self._lib = get_lib_imgdec()
+        self._h = self._lib.imgdec_create(int(nthreads))
+        self.resize_short = int(resize_short)
+        self.rand_crop = bool(rand_crop)
+        self.rand_mirror = bool(rand_mirror)
+        self.layout = layout.upper()
+        def three(v, what):
+            # C++ reads exactly [0..2]: broadcast scalars, reject odd
+            # lengths (an OOB read would corrupt normalization silently)
+            if v is None:
+                return None
+            a = np.asarray(v, np.float32).ravel()
+            if a.size == 1:
+                a = np.repeat(a, 3)
+            if a.size != 3:
+                raise ValueError(
+                    f"{what} must be a scalar or length-3, got "
+                    f"shape {np.shape(v)}")
+            return np.ascontiguousarray(a)
+
+        self._mean = three(mean, "mean")
+        self._std = three(std, "std")
+
+    def decode_batch(self, blobs, out, seed=0):
+        """Decode `blobs` (list of bytes) into out[(n,3,H,W) float32]
+        (or (n,H,W,3) for layout NHWC). Returns a uint8 array of
+        per-image success flags."""
+        import numpy as np
+
+        n = len(blobs)
+        if self.layout == "NHWC":
+            h, w, c = out.shape[1], out.shape[2], out.shape[3]
+        else:
+            c, h, w = out.shape[1], out.shape[2], out.shape[3]
+        assert c == 3 and out.dtype == np.float32
+        blob = np.frombuffer(b"".join(blobs), dtype=np.uint8)
+        lens = np.asarray([len(b) for b in blobs], np.int64)
+        offs = np.zeros(n, np.int64)
+        np.cumsum(lens[:-1], out=offs[1:])
+        ok = np.zeros(n, np.uint8)
+        fptr = ctypes.POINTER(ctypes.c_float)
+        self._lib.imgdec_batch(
+            self._h,
+            blob.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n, h, w, self.resize_short,
+            1 if self.rand_crop else 0,
+            1 if self.rand_mirror else 0,
+            0 if self.layout == "NHWC" else 1,
+            ctypes.c_uint64(seed & (2**64 - 1)),
+            self._mean.ctypes.data_as(fptr)
+            if self._mean is not None else None,
+            self._std.ctypes.data_as(fptr)
+            if self._std is not None else None,
+            out.ctypes.data_as(fptr),
+            ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        return ok
+
+    def close(self):
+        if self._h:
+            self._lib.imgdec_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
 _PREDICT_SRC = os.path.join(_NATIVE_DIR, "capi_predict.cc")
 _PREDICT_SO = os.path.join(_NATIVE_DIR, "libmxtpu_predict.so")
 

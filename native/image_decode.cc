@@ -1,0 +1,379 @@
+// Threaded JPEG decode + augment + batch-layout worker pool.
+//
+// TPU-native analog of the reference's fused OMP parser
+// (src/io/iter_image_recordio_2.cc ImageRecordIOParser2): one native
+// call turns a batch of JPEG blobs into the final training tensor —
+// decode (libjpeg, DCT-scaled to the smallest sufficient size),
+// resize-shorter-side, random/center crop (scale_down semantics),
+// horizontal mirror, mean/std normalize, CHW float32 write — with a
+// persistent pthread pool so no per-batch thread spawn and no Python
+// in the per-image loop.
+//
+// Plain C ABI consumed via ctypes (mxnet_tpu/native.py); pybind11 is
+// deliberately not used (not in the image).
+
+#include <cstdio>  // jpeglib.h needs FILE declared first
+
+#include <jpeglib.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <csetjmp>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- jpeg
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jb;
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  JpegErr* e = reinterpret_cast<JpegErr*>(cinfo->err);
+  longjmp(e->jb, 1);
+}
+
+// Decode a JPEG blob to RGB. Picks libjpeg's M/8 DCT scaling so the
+// decoded image is the smallest one still >= min_side on its shorter
+// edge (the cheap first resize the reference gets from
+// cv::IMREAD_REDUCED). Returns false on any decode error.
+bool decode_jpeg(const uint8_t* buf, size_t len, int min_side,
+                 std::vector<uint8_t>* out, int* w, int* h) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jpeg_err_exit;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(buf),
+               static_cast<unsigned long>(len));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  // choose num/8 scaling: smallest output whose shorter side >= min_side
+  if (min_side > 0) {
+    const int shorter = cinfo.image_width < cinfo.image_height
+                            ? cinfo.image_width
+                            : cinfo.image_height;
+    int num = 8;
+    while (num > 1 && shorter * (num - 1) / 8 >= min_side) --num;
+    cinfo.scale_num = num;
+    cinfo.scale_denom = 8;
+  }
+  jpeg_start_decompress(&cinfo);
+  *w = cinfo.output_width;
+  *h = cinfo.output_height;
+  if (cinfo.output_components != 3) {
+    // grayscale/CMYK: decode then expand below via libjpeg's own
+    // conversion was requested (JCS_RGB), so components==3 normally;
+    // anything else is unsupported here
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  out->resize(static_cast<size_t>(*w) * *h * 3);
+  const int stride = *w * 3;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = out->data() +
+                   static_cast<size_t>(cinfo.output_scanline) * stride;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// ------------------------------------------------------------- resize
+// Bilinear RGB resize (uint8), matching PIL/cv2 half-pixel sampling.
+void resize_bilinear(const uint8_t* src, int sw, int sh, uint8_t* dst,
+                     int dw, int dh) {
+  const float sx = static_cast<float>(sw) / dw;
+  const float sy = static_cast<float>(sh) / dh;
+  for (int y = 0; y < dh; ++y) {
+    float fy = (y + 0.5f) * sy - 0.5f;
+    if (fy < 0) fy = 0;
+    int y0 = static_cast<int>(fy);
+    int y1 = y0 + 1 < sh ? y0 + 1 : sh - 1;
+    const float wy = fy - y0;
+    for (int x = 0; x < dw; ++x) {
+      float fx = (x + 0.5f) * sx - 0.5f;
+      if (fx < 0) fx = 0;
+      int x0 = static_cast<int>(fx);
+      int x1 = x0 + 1 < sw ? x0 + 1 : sw - 1;
+      const float wx = fx - x0;
+      const uint8_t* p00 = src + (static_cast<size_t>(y0) * sw + x0) * 3;
+      const uint8_t* p01 = src + (static_cast<size_t>(y0) * sw + x1) * 3;
+      const uint8_t* p10 = src + (static_cast<size_t>(y1) * sw + x0) * 3;
+      const uint8_t* p11 = src + (static_cast<size_t>(y1) * sw + x1) * 3;
+      uint8_t* d = dst + (static_cast<size_t>(y) * dw + x) * 3;
+      for (int c = 0; c < 3; ++c) {
+        const float top = p00[c] + (p01[c] - p00[c]) * wx;
+        const float bot = p10[c] + (p11[c] - p10[c]) * wx;
+        d[c] = static_cast<uint8_t>(top + (bot - top) * wy + 0.5f);
+      }
+    }
+  }
+}
+
+// ---------------------------------------------------------------- rng
+// splitmix64: deterministic per (seed, image index) — reproducible
+// augmentation independent of thread scheduling.
+uint64_t splitmix(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+struct Job {
+  const uint8_t* blob = nullptr;
+  const int64_t* offs = nullptr;
+  const int64_t* lens = nullptr;
+  int n = 0;
+  int out_h = 0, out_w = 0;
+  int resize_short = 0;
+  int rand_crop = 0;
+  int rand_mirror = 0;
+  int chw = 1;                  // 1 = (3,H,W) planes, 0 = (H,W,3)
+  uint64_t seed = 0;
+  const float* mean = nullptr;  // len 3 or null
+  const float* stdv = nullptr;  // len 3 or null
+  float* out = nullptr;         // (n, 3, out_h, out_w) or (n,H,W,3)
+  uint8_t* ok = nullptr;        // per-image success
+};
+
+void scale_down(int sw, int sh, int* cw, int* ch) {
+  // reference image.py:33 — shrink the crop to fit the source while
+  // keeping the requested aspect
+  float w = static_cast<float>(*cw), h = static_cast<float>(*ch);
+  if (sh < h) {
+    w = w * sh / h;
+    h = static_cast<float>(sh);
+  }
+  if (sw < w) {
+    h = h * sw / w;
+    w = static_cast<float>(sw);
+  }
+  *cw = static_cast<int>(w);
+  *ch = static_cast<int>(h);
+}
+
+void process_one(const Job& j, int i, std::vector<uint8_t>* scratch,
+                 std::vector<uint8_t>* scratch2) {
+  j.ok[i] = 0;
+  const uint8_t* buf = j.blob + j.offs[i];
+  const size_t len = static_cast<size_t>(j.lens[i]);
+  // DCT-scaled decode is only geometry-preserving when a shorter-side
+  // resize follows (it approximates that resize's first octaves); a
+  // bare crop must see the full-resolution image, like the python path
+  const int min_side = j.resize_short > 0 ? j.resize_short : 0;
+  int w = 0, h = 0;
+  if (!decode_jpeg(buf, len, min_side, scratch, &w, &h)) return;
+
+  // resize shorter side
+  if (j.resize_short > 0 && (w < h ? w : h) != j.resize_short) {
+    int nw, nh;
+    if (h > w) {
+      nw = j.resize_short;
+      nh = static_cast<int>(
+          static_cast<int64_t>(j.resize_short) * h / w);
+    } else {
+      nh = j.resize_short;
+      nw = static_cast<int>(
+          static_cast<int64_t>(j.resize_short) * w / h);
+    }
+    scratch2->resize(static_cast<size_t>(nw) * nh * 3);
+    resize_bilinear(scratch->data(), w, h, scratch2->data(), nw, nh);
+    scratch->swap(*scratch2);
+    w = nw;
+    h = nh;
+  }
+
+  // crop (random or center) at scale_down size, then resize to target
+  int cw = j.out_w, ch = j.out_h;
+  scale_down(w, h, &cw, &ch);
+  uint64_t r = splitmix(j.seed ^ (0x85ebca6bULL * (i + 1)));
+  int x0, y0;
+  if (j.rand_crop) {
+    x0 = static_cast<int>(r % (w - cw + 1));
+    r = splitmix(r);
+    y0 = static_cast<int>(r % (h - ch + 1));
+    r = splitmix(r);
+  } else {
+    x0 = (w - cw) / 2;
+    y0 = (h - ch) / 2;
+  }
+  const bool mirror = j.rand_mirror && (splitmix(r) & 1);
+
+  const uint8_t* crop_src = scratch->data();
+  std::vector<uint8_t>& cropped = *scratch2;
+  const uint8_t* final_px;
+  int fw = j.out_w, fh = j.out_h;
+  if (cw == j.out_w && ch == j.out_h) {
+    // in-place window, no resize needed
+    final_px = nullptr;  // sampled with stride below
+  } else {
+    // gather the crop contiguously, then resize up to target
+    static thread_local std::vector<uint8_t> gather;
+    gather.resize(static_cast<size_t>(cw) * ch * 3);
+    for (int y = 0; y < ch; ++y)
+      std::memcpy(gather.data() + static_cast<size_t>(y) * cw * 3,
+                  crop_src + ((static_cast<size_t>(y0) + y) * w + x0) * 3,
+                  static_cast<size_t>(cw) * 3);
+    cropped.resize(static_cast<size_t>(fw) * fh * 3);
+    resize_bilinear(gather.data(), cw, ch, cropped.data(), fw, fh);
+    final_px = cropped.data();
+  }
+
+  // normalize + mirror + CHW float32 write
+  const float m0 = j.mean ? j.mean[0] : 0.f,
+              m1 = j.mean ? j.mean[1] : 0.f,
+              m2 = j.mean ? j.mean[2] : 0.f;
+  const float s0 = j.stdv ? 1.f / j.stdv[0] : 1.f,
+              s1 = j.stdv ? 1.f / j.stdv[1] : 1.f,
+              s2 = j.stdv ? 1.f / j.stdv[2] : 1.f;
+  float* dst = j.out + static_cast<size_t>(i) * 3 * fh * fw;
+  const size_t plane = static_cast<size_t>(fh) * fw;
+  for (int y = 0; y < fh; ++y) {
+    for (int x = 0; x < fw; ++x) {
+      const int sx = mirror ? fw - 1 - x : x;
+      const uint8_t* p =
+          final_px
+              ? final_px + (static_cast<size_t>(y) * fw + sx) * 3
+              : crop_src +
+                    ((static_cast<size_t>(y0) + y) * w + x0 + sx) * 3;
+      const size_t o = static_cast<size_t>(y) * fw + x;
+      if (j.chw) {
+        dst[o] = (p[0] - m0) * s0;
+        dst[plane + o] = (p[1] - m1) * s1;
+        dst[2 * plane + o] = (p[2] - m2) * s2;
+      } else {
+        dst[3 * o] = (p[0] - m0) * s0;
+        dst[3 * o + 1] = (p[1] - m1) * s1;
+        dst[3 * o + 2] = (p[2] - m2) * s2;
+      }
+    }
+  }
+  j.ok[i] = 1;
+}
+
+// ---------------------------------------------------------------- pool
+struct Pool {
+  std::vector<std::thread> workers;
+  std::mutex mu;
+  std::condition_variable cv_work, cv_done;
+  Job job;                    // written under mu before notify
+  std::atomic<int> next{0};   // image claim counter
+  int finished = 0;           // workers done with this generation
+  uint64_t generation = 0;
+  bool stop = false;
+
+  explicit Pool(int nthreads) {
+    for (int t = 0; t < nthreads; ++t)
+      workers.emplace_back([this] { worker(); });
+  }
+
+  void worker() {
+    std::vector<uint8_t> scratch, scratch2;
+    uint64_t seen = 0;
+    for (;;) {
+      Job local;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_work.wait(lk,
+                     [&] { return stop || generation != seen; });
+        if (stop) return;
+        seen = generation;
+        local = job;  // private copy: no unsynchronized reads later
+      }
+      for (;;) {
+        const int i = next.fetch_add(1);
+        if (i >= local.n) break;
+        process_one(local, i, &scratch, &scratch2);
+      }
+      {
+        // run() returns only after EVERY worker has left its claim
+        // loop, so a straggler can never race the next batch's
+        // job/next reset (each generation is a full barrier)
+        std::lock_guard<std::mutex> lk(mu);
+        if (++finished == static_cast<int>(workers.size()))
+          cv_done.notify_all();
+      }
+    }
+  }
+
+  void run(const Job& j) {
+    if (workers.empty() || j.n == 1) {
+      // inline on the caller: no handoff latency for tiny batches
+      std::vector<uint8_t> s1, s2;
+      for (int i = 0; i < j.n; ++i) process_one(j, i, &s1, &s2);
+      return;
+    }
+    std::unique_lock<std::mutex> lk(mu);
+    job = j;
+    next.store(0);
+    finished = 0;
+    ++generation;
+    cv_work.notify_all();
+    cv_done.wait(lk, [&] {
+      return finished == static_cast<int>(workers.size());
+    });
+  }
+
+  ~Pool() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      stop = true;
+      cv_work.notify_all();
+    }
+    for (auto& t : workers) t.join();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* imgdec_create(int nthreads) {
+  return new Pool(nthreads > 0 ? nthreads : 0);
+}
+
+void imgdec_destroy(void* h) { delete static_cast<Pool*>(h); }
+
+// Decode+augment a batch of JPEG blobs into (n,3,out_h,out_w) float32.
+// ok[i]=1 per successfully decoded image (0 => caller falls back).
+void imgdec_batch(void* h, const uint8_t* blob, const int64_t* offs,
+                  const int64_t* lens, int n, int out_h, int out_w,
+                  int resize_short, int rand_crop, int rand_mirror,
+                  int chw, uint64_t seed, const float* mean,
+                  const float* stdv, float* out, uint8_t* ok) {
+  Job j;
+  j.blob = blob;
+  j.offs = offs;
+  j.lens = lens;
+  j.n = n;
+  j.out_h = out_h;
+  j.out_w = out_w;
+  j.resize_short = resize_short;
+  j.rand_crop = rand_crop;
+  j.rand_mirror = rand_mirror;
+  j.chw = chw;
+  j.seed = seed;
+  j.mean = mean;
+  j.stdv = stdv;
+  j.out = out;
+  j.ok = ok;
+  static_cast<Pool*>(h)->run(j);
+}
+
+}  // extern "C"

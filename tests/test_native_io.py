@@ -178,3 +178,120 @@ def test_prefetch_capacity_survives_reset(tmp_path):
     r.reset()
     assert r._r.capacity == 7
     r.close()
+
+
+# ------------------------- native fused JPEG decode+augment pool
+
+def _make_rec(tmp_path, n=12, h=96, w=112):
+    from mxnet_tpu import recordio
+
+    path = str(tmp_path / "imgs")
+    rec = recordio.MXIndexedRecordIO(path + ".idx", path + ".rec", "w")
+    rs = np.random.RandomState(0)
+    yy, xx = np.mgrid[0:h, 0:w].astype("float32")
+    for i in range(n):
+        base = np.stack([
+            128 + 100 * np.sin(xx / 17.0 + i) * np.cos(yy / 23.0),
+            128 + 90 * np.cos(xx / 29.0) * np.sin(yy / 13.0 + i),
+            128 + 80 * np.sin((xx + yy) / 37.0),
+        ], axis=2)
+        img = (base + rs.normal(0, 6, (h, w, 3))).clip(0, 255) \
+            .astype("uint8")
+        rec.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, quality=95))
+    rec.close()
+    return path + ".rec"
+
+
+def test_native_decoder_center_crop_matches_python(tmp_path):
+    """Deterministic config (center crop, normalize, no mirror): the
+    native path must match the python decode pipeline (JPEG decode and
+    crop are bit-exact; normalization differs by one ulp because C++
+    multiplies by 1/std)."""
+    from mxnet_tpu.image import ImageIter
+
+    rec = _make_rec(tmp_path)
+    kw = dict(batch_size=4, data_shape=(3, 64, 64), path_imgrec=rec,
+              shuffle=False, mean=np.array([123.68, 116.28, 103.53]),
+              std=np.array([58.395, 57.12, 57.375]))
+    nat = ImageIter(preprocess_threads=2, **kw)
+    assert nat._native_dec is not None, "native decode path inactive"
+    py = ImageIter(preprocess_threads=1, **kw)
+    py._native_dec = None
+    for bn, bp in zip(nat, py):
+        np.testing.assert_allclose(
+            bn.data[0].asnumpy(), bp.data[0].asnumpy(),
+            rtol=0, atol=1e-5)
+        np.testing.assert_array_equal(
+            bn.label[0].asnumpy(), bp.label[0].asnumpy())
+
+
+def test_native_decoder_random_augment_shapes(tmp_path):
+    """rand_crop+rand_mirror via the native path: right shapes, finite,
+    normalized range, and actually random across epochs."""
+    from mxnet_tpu.image import ImageIter
+
+    rec = _make_rec(tmp_path)
+    it = ImageIter(batch_size=4, data_shape=(3, 64, 64),
+                   path_imgrec=rec, shuffle=False, rand_crop=True,
+                   rand_mirror=True, resize=80, preprocess_threads=2)
+    assert it._native_dec is not None
+    b1 = it.next().data[0].asnumpy()
+    it.reset()
+    b2 = it.next().data[0].asnumpy()
+    assert b1.shape == (4, 3, 64, 64)
+    assert np.isfinite(b1).all() and b1.min() >= 0 and b1.max() <= 255
+    assert np.abs(b1 - b2).max() > 0  # augmentation varies
+
+
+def test_native_decoder_nhwc_layout(tmp_path):
+    """data_layout='NHWC' emits channel-last batches that equal the
+    NCHW batch transposed."""
+    from mxnet_tpu.image import ImageIter
+
+    rec = _make_rec(tmp_path)
+    kw = dict(batch_size=4, data_shape=(3, 64, 64), path_imgrec=rec,
+              shuffle=False)
+    a = ImageIter(data_layout="NCHW", **kw)
+    b = ImageIter(data_layout="NHWC", **kw)
+    assert a._native_dec is not None and b._native_dec is not None
+    da = a.next().data[0].asnumpy()
+    db = b.next().data[0].asnumpy()
+    assert db.shape == (4, 64, 64, 3)
+    np.testing.assert_array_equal(db, da.transpose(0, 2, 3, 1))
+
+
+def test_native_decoder_nonjpeg_fallback(tmp_path):
+    """A PNG record cannot take the libjpeg path; it must fall back to
+    the python decoder per-image, not crash or skip."""
+    from mxnet_tpu import recordio
+    from mxnet_tpu.image import ImageIter
+
+    path = str(tmp_path / "mixed")
+    rec = recordio.MXIndexedRecordIO(path + ".idx", path + ".rec", "w")
+    rs = np.random.RandomState(1)
+    for i in range(4):
+        img = rs.randint(0, 255, (80, 80, 3)).astype("uint8")
+        fmt = ".png" if i == 1 else ".jpg"
+        rec.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, img_fmt=fmt))
+    rec.close()
+    it = ImageIter(batch_size=4, data_shape=(3, 64, 64),
+                   path_imgrec=path + ".rec", shuffle=False)
+    assert it._native_dec is not None
+    batch = it.next()
+    assert batch.pad == 0
+    np.testing.assert_array_equal(
+        batch.label[0].asnumpy(), np.arange(4, dtype=np.float32))
+    assert np.isfinite(batch.data[0].asnumpy()).all()
+
+
+def test_native_decoder_not_used_for_color_jitter(tmp_path):
+    """Augment options outside the native set keep the python path."""
+    from mxnet_tpu.image import ImageIter
+
+    rec = _make_rec(tmp_path)
+    it = ImageIter(batch_size=2, data_shape=(3, 64, 64),
+                   path_imgrec=rec, shuffle=False, brightness=0.4)
+    assert it._native_dec is None
+    assert np.isfinite(it.next().data[0].asnumpy()).all()

@@ -500,6 +500,137 @@ class Rtc {
   Handle h_;
 };
 
+// Forward-only inference over the predict ABI (libmxtpu_predict.so or
+// the amalgamated bundle; reference c_predict_api.h consumed from the
+// image-classification/predict-cpp example). Supports partial-output
+// heads, reshape-with-shared-weights, and step-wise forward.
+class Predictor {
+ public:
+  Predictor(const std::string& symbol_json,
+            const std::string& param_blob,
+            const std::map<std::string, std::vector<unsigned>>& shapes,
+            const std::vector<std::string>& output_keys = {}) {
+    std::vector<const char*> keys;
+    std::vector<unsigned> ind(1, 0);
+    std::vector<unsigned> dims;
+    for (const auto& kv : shapes) {
+      keys.push_back(kv.first.c_str());
+      dims.insert(dims.end(), kv.second.begin(), kv.second.end());
+      ind.push_back(static_cast<unsigned>(dims.size()));
+    }
+    int rc;
+    if (output_keys.empty()) {
+      rc = MXTpuPredCreate(symbol_json.c_str(), param_blob.data(),
+                           static_cast<int>(param_blob.size()),
+                           static_cast<int>(keys.size()), keys.data(),
+                           ind.data(), dims.data(), &h_);
+    } else {
+      std::vector<const char*> outs;
+      for (const auto& o : output_keys) outs.push_back(o.c_str());
+      rc = MXTpuPredCreatePartialOut(
+          symbol_json.c_str(), param_blob.data(),
+          static_cast<int>(param_blob.size()),
+          static_cast<int>(keys.size()), keys.data(), ind.data(),
+          dims.data(), static_cast<int>(outs.size()), outs.data(),
+          &h_);
+    }
+    Check(rc, "PredCreate");
+  }
+
+  void SetInput(const std::string& key, const std::vector<float>& v) {
+    Check(MXTpuPredSetInput(h_, key.c_str(), v.data(),
+                            static_cast<int>(v.size())),
+          "PredSetInput");
+  }
+
+  void Forward() { Check(MXTpuPredForward(h_), "PredForward"); }
+
+  // returns steps left; outputs valid once it reaches 0
+  int PartialForward(int step) {
+    int left = 0;
+    Check(MXTpuPredPartialForward(h_, step, &left),
+          "PredPartialForward");
+    return left;
+  }
+
+  std::vector<unsigned> GetOutputShape(int index = 0) {
+    unsigned dims[16];
+    int n = MXTpuPredGetOutputShape(h_, index, dims, 16);
+    Check(n < 0 ? -1 : 0, "PredGetOutputShape");
+    if (n > 16) n = 16;  // only cap dims were written
+    return std::vector<unsigned>(dims, dims + n);
+  }
+
+  std::vector<float> GetOutput(int index = 0) {
+    int n = MXTpuPredGetOutput(h_, index, nullptr, 0);
+    Check(n < 0 ? -1 : 0, "PredGetOutput size");
+    std::vector<float> out(n);
+    Check(MXTpuPredGetOutput(h_, index, out.data(), n) < 0 ? -1 : 0,
+          "PredGetOutput");
+    return out;
+  }
+
+  // new predictor at new shapes, sharing this one's weights
+  Predictor Reshape(
+      const std::map<std::string, std::vector<unsigned>>& shapes) {
+    std::vector<const char*> keys;
+    std::vector<unsigned> ind(1, 0);
+    std::vector<unsigned> dims;
+    for (const auto& kv : shapes) {
+      keys.push_back(kv.first.c_str());
+      dims.insert(dims.end(), kv.second.begin(), kv.second.end());
+      ind.push_back(static_cast<unsigned>(dims.size()));
+    }
+    void* out = nullptr;
+    Check(MXTpuPredReshape(static_cast<int>(keys.size()), keys.data(),
+                           ind.data(), dims.data(), h_, &out),
+          "PredReshape");
+    return Predictor(out);
+  }
+
+  ~Predictor() {
+    if (h_ != nullptr) MXTpuPredFree(h_);
+  }
+  Predictor(Predictor&& o) : h_(o.h_) { o.h_ = nullptr; }
+  Predictor(const Predictor&) = delete;
+  Predictor& operator=(const Predictor&) = delete;
+
+ private:
+  explicit Predictor(void* h) : h_(h) {}
+  void* h_ = nullptr;
+};
+
+// Named float32 arrays parsed from an NDArray container blob
+// (reference MXNDList*, used to ship mean images with predictors).
+class NDList {
+ public:
+  explicit NDList(const std::string& blob) {
+    Check(MXTpuNDListCreate(blob.data(),
+                            static_cast<int>(blob.size()), &h_, &n_),
+          "NDListCreate");
+  }
+  int size() const { return n_; }
+  // borrow entry i (pointers valid while this NDList lives)
+  void Get(int i, std::string* key, const float** data,
+           std::vector<unsigned>* shape) {
+    const char* k = nullptr;
+    const unsigned* shp = nullptr;
+    unsigned ndim = 0;
+    Check(MXTpuNDListGet(h_, i, &k, data, &shp, &ndim), "NDListGet");
+    *key = k;
+    shape->assign(shp, shp + ndim);
+  }
+  ~NDList() {
+    if (h_ != nullptr) MXTpuNDListFree(h_);
+  }
+  NDList(const NDList&) = delete;
+  NDList& operator=(const NDList&) = delete;
+
+ private:
+  void* h_ = nullptr;
+  int n_ = 0;
+};
+
 // Profiler controls (reference cpp-package exposed the same pair).
 inline void ProfilerStart(const std::string& filename,
                           bool all_ops = true) {

@@ -132,6 +132,43 @@ class Predictor(object):
         self._input_shapes = dict(new_input_shapes)
         self._bind()
 
+    def reshaped(self, new_input_shapes):
+        """MXPredReshape: a NEW predictor bound at `new_input_shapes`
+        that shares this one's loaded parameters (the reference returns
+        a second handle whose weights alias the first,
+        c_predict_api.cc MXPredReshape)."""
+        p = object.__new__(Predictor)
+        p._ctx = self._ctx
+        p._symbol = self._symbol
+        p._arg_params = self._arg_params
+        p._aux_params = self._aux_params
+        p._input_shapes = dict(new_input_shapes)
+        p._bind()
+        return p
+
+    @property
+    def num_steps(self):
+        """Step count exposed to MXPredPartialForward: the symbol's
+        internal-output count (the reference steps per graph node,
+        c_predict_api.h:142-151)."""
+        return len(self._symbol.get_internals().list_outputs())
+
+    def partial_forward(self, step):
+        """MXPredPartialForward: returns steps left after `step`.
+
+        EMULATED under XLA: the whole graph compiles into one program,
+        so there is no per-node scheduling to stop at — intermediate
+        calls are bookkeeping only, and the full forward runs when the
+        caller reaches the final step (step_left == 0), after which
+        outputs are valid. The reference's calling loop
+        (`while step_left > 0: MXPredPartialForward(h, step++, ...)`)
+        therefore behaves identically."""
+        step = max(0, int(step))
+        left = max(0, self.num_steps - step)
+        if left == 0:
+            self.forward()
+        return left
+
     @staticmethod
     def from_checkpoint(prefix, epoch, input_shapes, ctx=None,
                         output_names=None):

@@ -226,4 +226,274 @@ void MXTpuPredFree(void* handle) {
   delete p;
 }
 
+// Create a predictor whose outputs are INTERNAL layer heads
+// (reference MXPredCreatePartialOut, c_predict_api.h:92): same
+// arguments as MXTpuPredCreate plus num_output/output_keys naming the
+// internal nodes to expose.
+int MXTpuPredCreatePartialOut(const char* symbol_json,
+                              const void* param_bytes, int param_size,
+                              int num_input, const char** input_keys,
+                              const unsigned* shape_ind,
+                              const unsigned* shape_data,
+                              int num_output, const char** output_keys,
+                              void** out) {
+  EnsurePython();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* mod = nullptr;
+  PyObject* shapes = nullptr;
+  PyObject* params = nullptr;
+  PyObject* outs = nullptr;
+  do {
+    mod = PyImport_ImportModule("mxnet_tpu.predictor");
+    if (mod == nullptr) {
+      SetError("import mxnet_tpu.predictor");
+      break;
+    }
+    shapes = PyDict_New();
+    for (int i = 0; i < num_input; ++i) {
+      PyObject* tup = PyTuple_New(shape_ind[i + 1] - shape_ind[i]);
+      for (unsigned j = shape_ind[i]; j < shape_ind[i + 1]; ++j) {
+        PyTuple_SET_ITEM(tup, j - shape_ind[i],
+                         PyLong_FromUnsignedLong(shape_data[j]));
+      }
+      PyDict_SetItemString(shapes, input_keys[i], tup);
+      Py_DECREF(tup);
+    }
+    params = PyBytes_FromStringAndSize(
+        static_cast<const char*>(param_bytes), param_size);
+    outs = PyList_New(num_output);
+    for (int i = 0; i < num_output; ++i) {
+      PyList_SET_ITEM(outs, i, PyUnicode_FromString(output_keys[i]));
+    }
+    PyObject* cls = PyObject_GetAttrString(mod, "Predictor");
+    PyObject* obj = PyObject_CallFunction(
+        cls, "sOOOO", symbol_json, params, shapes, Py_None, outs);
+    Py_DECREF(cls);
+    if (obj == nullptr) {
+      SetError("Predictor(partial_out)");
+      break;
+    }
+    auto* p = new Predictor();
+    p->obj = obj;
+    *out = p;
+    rc = 0;
+  } while (false);
+  Py_XDECREF(mod);
+  Py_XDECREF(shapes);
+  Py_XDECREF(params);
+  Py_XDECREF(outs);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+// New predictor handle bound at new input shapes, SHARING the source
+// handle's loaded weights (reference MXPredReshape).
+int MXTpuPredReshape(int num_input, const char** input_keys,
+                     const unsigned* shape_ind,
+                     const unsigned* shape_data, void* handle,
+                     void** out) {
+  auto* p = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* shapes = PyDict_New();
+  for (int i = 0; i < num_input; ++i) {
+    PyObject* tup = PyTuple_New(shape_ind[i + 1] - shape_ind[i]);
+    for (unsigned j = shape_ind[i]; j < shape_ind[i + 1]; ++j) {
+      PyTuple_SET_ITEM(tup, j - shape_ind[i],
+                       PyLong_FromUnsignedLong(shape_data[j]));
+    }
+    PyDict_SetItemString(shapes, input_keys[i], tup);
+    Py_DECREF(tup);
+  }
+  PyObject* obj = PyObject_CallMethod(p->obj, "reshaped", "O", shapes);
+  if (obj != nullptr) {
+    auto* q = new Predictor();
+    q->obj = obj;
+    *out = q;
+    rc = 0;
+  } else {
+    SetError("reshaped");
+  }
+  Py_DECREF(shapes);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+// Run the forward up to `step` graph nodes; *step_left reports how
+// many remain (reference MXPredPartialForward, c_predict_api.h:151;
+// see Predictor.partial_forward for the XLA emulation contract).
+int MXTpuPredPartialForward(void* handle, int step, int* step_left) {
+  auto* p = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* r = PyObject_CallMethod(
+      p->obj, "partial_forward", "i", step);
+  if (r != nullptr) {
+    *step_left = static_cast<int>(PyLong_AsLong(r));
+    Py_DECREF(r);
+    rc = 0;
+  } else {
+    SetError("partial_forward");
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+// Shape of output `index`: writes up to cap dims into dims, returns
+// ndim (reference MXPredGetOutputShape, c_predict_api.h:112 — there
+// the pointers borrow internal storage; here the caller owns the
+// buffer, which removes the valid-until-next-call footgun).
+int MXTpuPredGetOutputShape(void* handle, int index, unsigned* dims,
+                            int cap) {
+  auto* p = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* shp = PyObject_CallMethod(
+      p->obj, "get_output_shape", "i", index);
+  if (shp != nullptr) {
+    Py_ssize_t n = PyTuple_Check(shp) ? PyTuple_Size(shp) : -1;
+    if (n >= 0) {
+      for (Py_ssize_t i = 0; i < n && i < cap; ++i) {
+        dims[i] = static_cast<unsigned>(
+            PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shp, i)));
+      }
+      rc = static_cast<int>(n);
+    } else {
+      SetError("get_output_shape: not a tuple");
+    }
+    Py_DECREF(shp);
+  } else {
+    SetError("get_output_shape");
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+// ---------------------------------------------------------- NDList
+// Parse an NDArray container blob (nd.save format) into a list of
+// named float32 arrays readable from C (reference MXNDListCreate/
+// Get/Free, c_predict_api.h:179-204). Pointers returned by Get stay
+// valid until Free (the C side owns host copies).
+
+struct NDListEntry {
+  std::string key;
+  std::vector<float> data;
+  std::vector<unsigned> shape;
+};
+
+struct NDList {
+  std::vector<NDListEntry> entries;
+};
+
+int MXTpuNDListCreate(const char* nd_file_bytes, int nd_file_size,
+                      void** out, int* out_len) {
+  EnsurePython();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* mod = nullptr;
+  PyObject* blob = nullptr;
+  PyObject* d = nullptr;
+  do {
+    mod = PyImport_ImportModule("mxnet_tpu.ndarray");
+    if (mod == nullptr) {
+      SetError("import mxnet_tpu.ndarray");
+      break;
+    }
+    blob = PyBytes_FromStringAndSize(nd_file_bytes, nd_file_size);
+    d = PyObject_CallMethod(mod, "load_frombuffer", "O", blob);
+    if (d == nullptr) {
+      SetError("load_frombuffer");
+      break;
+    }
+    auto* lst = new NDList();
+    bool ok = true;
+    // one entry converter: NDArray -> contiguous float32 memcpy
+    // (tobytes; per-element boxing would blow up on real checkpoints)
+    auto convert = [&](PyObject* key, PyObject* val) {
+      NDListEntry e;
+      if (key != nullptr) {
+        const char* k = PyUnicode_AsUTF8(key);
+        e.key = k ? k : "";
+      }
+      PyObject* arr = PyObject_CallMethod(val, "asnumpy", nullptr);
+      PyObject* f32 = arr ? PyObject_CallMethod(
+          arr, "astype", "s", "float32") : nullptr;
+      PyObject* shp = f32 ? PyObject_GetAttrString(f32, "shape")
+                          : nullptr;
+      PyObject* bytes = f32 ? PyObject_CallMethod(f32, "tobytes",
+                                                  nullptr) : nullptr;
+      char* raw = nullptr;
+      Py_ssize_t nbytes = 0;
+      if (bytes != nullptr && shp != nullptr &&
+          PyBytes_AsStringAndSize(bytes, &raw, &nbytes) == 0) {
+        Py_ssize_t nd_ = PyTuple_Size(shp);
+        for (Py_ssize_t i = 0; i < nd_; ++i) {
+          e.shape.push_back(static_cast<unsigned>(
+              PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shp, i))));
+        }
+        e.data.resize(nbytes / sizeof(float));
+        std::memcpy(e.data.data(), raw, nbytes);
+        lst->entries.push_back(std::move(e));
+      } else {
+        SetError("NDList entry conversion");
+        ok = false;
+      }
+      Py_XDECREF(bytes);
+      Py_XDECREF(shp);
+      Py_XDECREF(f32);
+      Py_XDECREF(arr);
+    };
+    if (PyDict_Check(d)) {
+      PyObject *key, *val;
+      Py_ssize_t pos = 0;
+      while (ok && PyDict_Next(d, &pos, &key, &val)) {
+        convert(key, val);
+      }
+    } else if (PyList_Check(d)) {
+      // unnamed save (nd.save(f, [a, b])): entries with empty keys,
+      // reference MXNDListCreate behavior for name-less containers
+      for (Py_ssize_t i = 0; ok && i < PyList_Size(d); ++i) {
+        convert(nullptr, PyList_GET_ITEM(d, i));
+      }
+    } else {
+      SetError("NDList: unexpected container type");
+      ok = false;
+    }
+    if (!ok) {
+      delete lst;
+      break;
+    }
+    *out = lst;
+    *out_len = static_cast<int>(lst->entries.size());
+    rc = 0;
+  } while (false);
+  Py_XDECREF(mod);
+  Py_XDECREF(blob);
+  Py_XDECREF(d);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXTpuNDListGet(void* handle, int index, const char** out_key,
+                   const float** out_data, const unsigned** out_shape,
+                   unsigned* out_ndim) {
+  auto* lst = static_cast<NDList*>(handle);
+  if (index < 0 ||
+      index >= static_cast<int>(lst->entries.size())) {
+    g_last_error = "NDListGet: index out of range";
+    return -1;
+  }
+  const NDListEntry& e = lst->entries[index];
+  *out_key = e.key.c_str();
+  *out_data = e.data.data();
+  *out_shape = e.shape.data();
+  *out_ndim = static_cast<unsigned>(e.shape.size());
+  return 0;
+}
+
+void MXTpuNDListFree(void* handle) {
+  delete static_cast<NDList*>(handle);
+}
+
 }  // extern "C"

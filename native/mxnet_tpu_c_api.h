@@ -248,6 +248,34 @@ int MXTpuPredSetInput(void* handle, const char* key, const float* data,
 int MXTpuPredForward(void* handle);
 int MXTpuPredGetOutput(void* handle, int index, float* buf, int cap);
 void MXTpuPredFree(void* handle);
+/* outputs = named INTERNAL layer heads (MXPredCreatePartialOut) */
+int MXTpuPredCreatePartialOut(const char* symbol_json,
+                              const void* param_bytes, int param_size,
+                              int num_input, const char** input_keys,
+                              const unsigned* shape_ind,
+                              const unsigned* shape_data,
+                              int num_output, const char** output_keys,
+                              void** out);
+/* new handle at new input shapes, sharing weights (MXPredReshape) */
+int MXTpuPredReshape(int num_input, const char** input_keys,
+                     const unsigned* shape_ind,
+                     const unsigned* shape_data, void* handle,
+                     void** out);
+/* step-wise forward; outputs valid once *step_left == 0
+   (MXPredPartialForward; emulated under XLA — one fused program) */
+int MXTpuPredPartialForward(void* handle, int step, int* step_left);
+/* writes up to cap dims, returns ndim (MXPredGetOutputShape; caller
+   owns the buffer — no valid-until-next-call aliasing) */
+int MXTpuPredGetOutputShape(void* handle, int index, unsigned* dims,
+                            int cap);
+/* NDArray container blob -> named float32 arrays readable from C
+   (MXNDListCreate/Get/Free); Get pointers live until Free */
+int MXTpuNDListCreate(const char* nd_file_bytes, int nd_file_size,
+                      void** out, int* out_len);
+int MXTpuNDListGet(void* handle, int index, const char** out_key,
+                   const float** out_data, const unsigned** out_shape,
+                   unsigned* out_ndim);
+void MXTpuNDListFree(void* handle);
 
 #ifdef __cplusplus
 }

@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Kim-style CNN for sentence classification (reference
+example/cnn_text_classification/text_cnn.py in miniature): embedding
+-> parallel convolutions of widths 2/3/4 over time -> max-over-time
+pooling -> concat -> dropout -> FC softmax.
+
+Synthetic task: a sentence is positive iff it contains the bigram
+(PATTERN_A, PATTERN_B) anywhere — exactly what a width-2 filter over
+embeddings can detect.
+
+  python examples/cnn_text/text_cnn.py --epochs 8
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+)
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+VOCAB, SEQ, EMBED = 40, 20, 16
+PATTERN_A, PATTERN_B = 7, 11
+
+
+def make_dataset(n, seed=0):
+    rs = np.random.RandomState(seed)
+    X = rs.randint(0, VOCAB, (n, SEQ)).astype(np.float32)
+    # scrub accidental bigrams, then plant one in the positive half
+    for i in range(n):
+        for t in range(SEQ - 1):
+            if X[i, t] == PATTERN_A and X[i, t + 1] == PATTERN_B:
+                X[i, t + 1] = (PATTERN_B + 1) % VOCAB
+    y = np.zeros((n,), np.float32)
+    for i in range(0, n, 2):
+        t = rs.randint(0, SEQ - 1)
+        X[i, t], X[i, t + 1] = PATTERN_A, PATTERN_B
+        y[i] = 1.0
+    return X, y
+
+
+def get_symbol(filter_sizes=(2, 3, 4), num_filter=8):
+    data = mx.sym.Variable("data")
+    emb = mx.sym.Embedding(data, input_dim=VOCAB, output_dim=EMBED,
+                           name="embed")
+    # (B, SEQ, EMBED) -> (B, 1, SEQ, EMBED) for 2-D convs over time
+    x = mx.sym.Reshape(emb, shape=(-1, 1, SEQ, EMBED))
+    pooled = []
+    for fs in filter_sizes:
+        conv = mx.sym.Convolution(x, num_filter=num_filter,
+                                  kernel=(fs, EMBED),
+                                  name=f"conv{fs}")
+        act = mx.sym.Activation(conv, act_type="relu")
+        pooled.append(mx.sym.Pooling(
+            act, pool_type="max", kernel=(SEQ - fs + 1, 1),
+            name=f"pool{fs}"))
+    concat = mx.sym.Concat(*pooled, dim=1)
+    flat = mx.sym.Flatten(concat)
+    drop = mx.sym.Dropout(flat, p=0.3)
+    fc = mx.sym.FullyConnected(drop, num_hidden=2, name="fc")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=18)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=0.003)
+    ap.add_argument("--min-acc", type=float, default=0.9)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    np.random.seed(5)
+
+    X, y = make_dataset(512)
+    Xv, yv = make_dataset(128, seed=99)
+    it = mx.io.NDArrayIter(X, y, batch_size=args.batch_size,
+                           shuffle=True, label_name="softmax_label")
+    vit = mx.io.NDArrayIter(Xv, yv, batch_size=args.batch_size,
+                            label_name="softmax_label")
+    mod = mx.mod.Module(get_symbol(), context=mx.default_context())
+    mod.fit(it, eval_data=vit, num_epoch=args.epochs,
+            optimizer="adam",
+            optimizer_params={"learning_rate": args.lr},
+            eval_metric="acc",
+            batch_end_callback=mx.callback.Speedometer(
+                args.batch_size, 50))
+    score = dict(mod.score(vit, mx.metric.Accuracy()))
+    print(f"validation accuracy {score['accuracy']:.3f}")
+    assert score["accuracy"] >= args.min_acc, score
+    print("text cnn OK")
+
+
+if __name__ == "__main__":
+    main()

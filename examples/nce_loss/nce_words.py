@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Noise-contrastive estimation over a large output vocabulary
+(reference example/nce-loss/nce.py + wordvec.py): instead of a full
+softmax over VOCAB classes, each step scores the true class against a
+few sampled noise classes with logistic losses — the output Embedding
+IS the class-weight matrix, looked up only at the sampled rows.
+
+Task: learn word vectors such that center words predict their
+deterministic "context" partner (word w pairs with (w*3+1) % VOCAB).
+Evaluated by full-softmax argmax accuracy over all classes using the
+NCE-trained embeddings.
+
+  python examples/nce_loss/nce_words.py --epochs 12
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+)
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+VOCAB, EMBED, NOISE = 200, 24, 8
+
+
+def partner(w):
+    return (w * 3 + 1) % VOCAB
+
+
+def nce_symbol():
+    """score(center, candidate) = <in_embed[center], out_embed[cand]>
+    + bias[cand]; logistic loss, label 1 for the true class and 0 for
+    noise samples (reference nce-loss/nce.py NceOutput shape)."""
+    data = mx.sym.Variable("data")            # (B,) center word
+    cands = mx.sym.Variable("cands")          # (B, 1+NOISE) classes
+    labels = mx.sym.Variable("labels")        # (B, 1+NOISE) 1/0
+    in_vec = mx.sym.Embedding(data, input_dim=VOCAB,
+                              output_dim=EMBED, name="in_embed")
+    out_vec = mx.sym.Embedding(cands, input_dim=VOCAB,
+                               output_dim=EMBED, name="out_embed")
+    bias = mx.sym.Embedding(cands, input_dim=VOCAB, output_dim=1,
+                            name="out_bias")
+    # (B, 1, E) x (B, 1+NOISE, E) -> (B, 1+NOISE)
+    prod = mx.sym.broadcast_mul(
+        mx.sym.Reshape(in_vec, shape=(-1, 1, EMBED)), out_vec)
+    logits = mx.sym.sum(prod, axis=2) + mx.sym.Reshape(
+        bias, shape=(-1, 1 + NOISE))
+    return mx.sym.LogisticRegressionOutput(
+        logits, label=labels, name="nce")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=3.0)
+    ap.add_argument("--min-acc", type=float, default=0.8)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    np.random.seed(4)
+    rs = np.random.RandomState(1)
+
+    n = 4096
+    centers = rs.randint(0, VOCAB, (n,)).astype(np.float32)
+    true = partner(centers.astype(int)).astype(np.float32)
+    # candidates: true class first, then NOISE uniform samples
+    cands = np.concatenate(
+        [true[:, None],
+         rs.randint(0, VOCAB, (n, NOISE)).astype(np.float32)], axis=1)
+    labels = np.zeros((n, 1 + NOISE), np.float32)
+    labels[:, 0] = 1.0
+
+    it = mx.io.NDArrayIter(
+        {"data": centers, "cands": cands}, {"labels": labels},
+        batch_size=args.batch_size, shuffle=True)
+    mod = mx.mod.Module(nce_symbol(), data_names=("data", "cands"),
+                        label_names=("labels",),
+                        context=mx.default_context())
+    mod.fit(it, num_epoch=args.epochs, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr,
+                              "momentum": 0.9})
+
+    # evaluate with a FULL softmax over the NCE-trained tables
+    params, _ = mod.get_params()
+    w_in = params["in_embed_weight"].asnumpy()
+    w_out = params["out_embed_weight"].asnumpy()
+    b = params["out_bias_weight"].asnumpy().ravel()
+    scores = w_in @ w_out.T + b  # (VOCAB, VOCAB)
+    pred = scores.argmax(axis=1)
+    acc = float((pred == partner(np.arange(VOCAB))).mean())
+    print(f"full-vocab retrieval accuracy {acc:.3f}")
+    assert acc >= args.min_acc, acc
+    print("nce OK")
+
+
+if __name__ == "__main__":
+    main()

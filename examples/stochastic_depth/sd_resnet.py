@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Stochastic depth (reference example/stochastic-depth/sd_cifar10.py,
+Huang et al. 2016): residual blocks are randomly DROPPED during
+training — block i survives with probability following the linear
+decay schedule p_i = 1 - i/L * (1 - p_L) — and at test time every
+block runs, scaled by its survival probability.
+
+The random gate rides mx.sym.Dropout on a constant-1 input: Dropout's
+train/test semantics give exactly the bernoulli-gate-with-inverse-
+scaling the paper uses, with no custom op needed.
+
+  python examples/stochastic_depth/sd_resnet.py --epochs 6
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+)
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def residual_block(body, num_filter, death_rate, name):
+    """Pre-act residual block whose branch is gated by a bernoulli
+    survival variable (train: dropped with p=death_rate and scaled up
+    when kept — Dropout semantics; test: expectation, i.e. identity
+    scaling)."""
+    branch = mx.sym.Convolution(body, num_filter=num_filter,
+                                kernel=(3, 3), pad=(1, 1),
+                                name=f"{name}_conv1")
+    branch = mx.sym.BatchNorm(branch, name=f"{name}_bn1")
+    branch = mx.sym.Activation(branch, act_type="relu")
+    branch = mx.sym.Convolution(branch, num_filter=num_filter,
+                                kernel=(3, 3), pad=(1, 1),
+                                name=f"{name}_conv2")
+    branch = mx.sym.BatchNorm(branch, name=f"{name}_bn2")
+    if death_rate > 0:
+        # gate (B, 1, 1, 1): one bernoulli per SAMPLE per block
+        ones = mx.sym.mean(
+            mx.sym.ones_like(body), axis=(1, 2, 3), keepdims=True)
+        gate = mx.sym.Dropout(ones, p=death_rate,
+                              name=f"{name}_gate")
+        branch = mx.sym.broadcast_mul(branch, gate)
+    return mx.sym.Activation(body + branch, act_type="relu",
+                             name=f"{name}_out")
+
+
+def get_symbol(num_blocks=4, num_filter=16, final_death=0.5,
+               num_classes=8):
+    data = mx.sym.Variable("data")
+    body = mx.sym.Activation(
+        mx.sym.BatchNorm(
+            mx.sym.Convolution(data, num_filter=num_filter,
+                               kernel=(3, 3), pad=(1, 1),
+                               name="conv0"), name="bn0"),
+        act_type="relu")
+    for i in range(num_blocks):
+        death = final_death * (i + 1) / num_blocks  # linear decay
+        body = residual_block(body, num_filter, death, f"block{i}")
+    pooled = mx.sym.Pooling(body, global_pool=True, pool_type="avg",
+                            kernel=(1, 1))
+    fc = mx.sym.FullyConnected(mx.sym.Flatten(pooled),
+                               num_hidden=num_classes, name="fc")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def make_dataset(n, classes=8, size=16, seed=0):
+    """Class = quadrant+intensity pattern of a planted blob."""
+    rs = np.random.RandomState(seed)
+    X = rs.rand(n, 3, size, size).astype(np.float32) * 0.2
+    y = rs.randint(0, classes, (n,)).astype(np.float32)
+    half = size // 2
+    for i in range(n):
+        c = int(y[i])
+        qy, qx = divmod(c % 4, 2)
+        level = 0.6 if c < 4 else 1.0
+        X[i, :, qy * half: qy * half + half,
+          qx * half: qx * half + half] += level
+    return X, y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--death-rate", type=float, default=0.5)
+    ap.add_argument("--min-acc", type=float, default=0.85)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    np.random.seed(2)
+
+    X, y = make_dataset(512)
+    Xv, yv = make_dataset(128, seed=77)
+    it = mx.io.NDArrayIter(X, y, batch_size=args.batch_size,
+                           shuffle=True, label_name="softmax_label")
+    vit = mx.io.NDArrayIter(Xv, yv, batch_size=args.batch_size,
+                            label_name="softmax_label")
+    net = get_symbol(final_death=args.death_rate)
+    mod = mx.mod.Module(net, context=mx.default_context())
+    mod.fit(it, eval_data=vit, num_epoch=args.epochs,
+            initializer=mx.init.Xavier(rnd_type="gaussian",
+                                       factor_type="in", magnitude=2),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr,
+                              "momentum": 0.9},
+            eval_metric="acc")
+    score = dict(mod.score(vit, mx.metric.Accuracy()))
+    print(f"validation accuracy {score['accuracy']:.3f} "
+          f"(final death rate {args.death_rate})")
+    assert score["accuracy"] >= args.min_acc, score
+    print("stochastic depth OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -23,6 +23,8 @@ EXAMPLES = {
     "rnn/lstm_bucketing.py": [
         "--num-epochs", "1", "--batch-size", "8", "--num-hidden", "16",
         "--num-embed", "8", "--num-layers", "1"],
+    "rcnn/train_frcnn_toy.py": [
+        "--num-epochs", "6", "--min-acc", "0.6", "--min-iou", "0.45"],
     "ssd/train_ssd_toy.py": ["--num-epochs", "1", "--batch-size", "4"],
     "ssd/train_ssd_recordio.py": [
         "--num-epochs", "1", "--batch-size", "4"],
@@ -34,6 +36,11 @@ EXAMPLES = {
     "recommenders/matrix_fact.py": [],
     "adversary/fgsm_mnist.py": ["--epochs", "8"],
     "numpy_ops/custom_softmax.py": [],
+    "neural_style/neural_style.py": ["--steps", "40"],
+    "cnn_text/text_cnn.py": ["--epochs", "18", "--min-acc", "0.9"],
+    "nce_loss/nce_words.py": ["--epochs", "8", "--min-acc", "0.8"],
+    "stochastic_depth/sd_resnet.py": [
+        "--epochs", "6", "--min-acc", "0.85"],
     "bi_lstm_sort/sort_lstm.py": ["--epochs", "8"],
     "model_parallel/lstm_layers.py": ["--epochs", "6"],
     "autoencoder/ae_mnist.py": [],

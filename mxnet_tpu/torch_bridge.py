@@ -82,3 +82,121 @@ def torch_module(module):
         return [from_torch(o) for o in out]
 
     return call
+
+
+def register_torch_module(op_name, module_factory):
+    """Register a torch.nn.Module as a RUNTIME symbol op — the
+    reference's TorchModule plugin (plugin/torch/torch_module-inl.h:
+    lua modules as graph nodes, trainable by the mxnet optimizer).
+
+    The module's parameters surface as mxnet arguments (named
+    `<param>` with dots -> underscores), so the regular optimizer
+    updates them; forward runs the module, backward runs
+    torch.autograd. Use with mx.sym.Custom(data=..., op_type=op_name).
+
+    The custom-op contract is stateless, so backward REPLAYS the torch
+    forward under autograd. Stochastic modules (Dropout etc.) would
+    draw a fresh mask in the replay — gradients then correspond to a
+    different realization than the forward's output. Keep bridged
+    modules deterministic; eval/train mode is set from is_train.
+
+    Returns the ordered mxnet argument names for the module's params.
+    """
+    torch = _torch()
+
+    from . import ndarray as _nd
+    from . import operator as _op
+
+    # ONE shared module instance: every call overwrites the weights
+    # from in_data anyway, so per-callback reconstruction (full torch
+    # init each step) would be pure waste
+    shared = module_factory()
+    pnames = [n.replace(".", "_")
+              for n, _ in shared.named_parameters()]
+
+    class _TorchModuleOp(_op.CustomOp):
+        def __init__(self):
+            self._m = shared
+            self._params = [p for _, p in self._m.named_parameters()]
+
+        def _load_params(self, in_data):
+            with torch.no_grad():
+                for p, v in zip(self._params, in_data[1:]):
+                    p.copy_(torch.from_numpy(v.asnumpy()))
+
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self._load_params(in_data)
+            self._m.train(bool(is_train))
+            x = torch.from_numpy(in_data[0].asnumpy())
+            with torch.no_grad():
+                out = self._m(x)
+            self.assign(out_data[0], req[0],
+                        _nd.array(out.detach().numpy()))
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad,
+                     aux):
+            # stateless replay (see docstring): forward again under
+            # autograd, then grad wrt input + params
+            self._load_params(in_data)
+            self._m.train(True)
+            x = torch.from_numpy(in_data[0].asnumpy())
+            x.requires_grad_(True)
+            out = self._m(x)
+            go = torch.from_numpy(out_grad[0].asnumpy())
+            grads = torch.autograd.grad(
+                out, [x] + self._params, grad_outputs=go,
+                allow_unused=True)
+            for i, g in enumerate(grads):
+                val = (np.zeros(in_grad[i].shape, np.float32)
+                       if g is None else g.numpy())
+                self.assign(in_grad[i], req[i], _nd.array(val))
+
+    class _TorchModuleProp(_op.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def list_arguments(self):
+            return ["data"] + pnames
+
+        def list_outputs(self):
+            return ["output"]
+
+        def infer_shape(self, in_shape):
+            was_training = shared.training
+            shared.train(False)
+            with torch.no_grad():
+                out = shared(torch.zeros(*in_shape[0]))
+            shared.train(was_training)
+            pshapes = [tuple(p.shape)
+                       for _, p in shared.named_parameters()]
+            return ([tuple(in_shape[0])] + pshapes,
+                    [tuple(out.shape)], [])
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return _TorchModuleOp()
+
+    _op.register(op_name)(_TorchModuleProp)
+    return pnames
+
+
+def register_caffe_op(op_name, prototxt):
+    """The reference's CaffeOp plugin surface (plugin/caffe/
+    caffe_op-inl.h: run a caffe layer as a graph node). NOT implemented
+    in this build — runtime caffe is absent from the supported images —
+    so this always raises with guidance: offline model import is
+    covered by tools/caffe_converter.py."""
+    raise MXNetError(
+        "the runtime caffe op bridge is not implemented in this "
+        "build; for offline model import use tools/caffe_converter.py")
+
+
+def torch_module_init_params(module_factory, prefix=""):
+    """{mxnet arg name: NDArray} holding the torch module's OWN
+    initialization — feed to init_params(arg_params=...) so the graph
+    starts from torch's init, reference TorchModule behavior."""
+    m = module_factory()
+    return {
+        prefix + n.replace(".", "_"): array(
+            p.detach().numpy().astype(np.float32))
+        for n, p in m.named_parameters()
+    }

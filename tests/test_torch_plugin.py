@@ -1,0 +1,92 @@
+"""Runtime torch op plugin: a torch.nn.Module as a trainable symbol
+node (reference plugin/torch TorchModule — lua modules as graph ops,
+params updated by the mxnet optimizer)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import torch_bridge as tb
+
+torch = pytest.importorskip("torch")
+
+
+def _factory():
+    torch.manual_seed(0)
+    return torch.nn.Sequential(
+        torch.nn.Linear(6, 16), torch.nn.Tanh(),
+        torch.nn.Linear(16, 3))
+
+
+def test_torch_module_grads_match_torch():
+    """Gradients through the bridged op equal torch.autograd directly."""
+    tb.register_torch_module("tp_gradcheck", _factory)
+    net = mx.sym.Custom(data=mx.sym.Variable("data"),
+                        op_type="tp_gradcheck", name="tm")
+    ex = net.simple_bind(ctx=mx.cpu(), grad_req="write", data=(4, 6))
+    init = tb.torch_module_init_params(_factory)
+    for k, v in init.items():
+        ex.arg_dict[f"tm_{k}"][:] = v.asnumpy()
+    rs = np.random.RandomState(0)
+    x = rs.rand(4, 6).astype(np.float32)
+    out = ex.forward(is_train=True, data=x)[0].asnumpy()
+
+    m = _factory()
+    tx = torch.from_numpy(x).requires_grad_(True)
+    tout = m(tx)
+    np.testing.assert_allclose(out, tout.detach().numpy(), rtol=1e-5,
+                               atol=1e-6)
+    head = rs.rand(4, 3).astype(np.float32)
+    ex.backward([mx.nd.array(head)])
+    tout.backward(torch.from_numpy(head))
+    np.testing.assert_allclose(
+        ex.grad_dict["data"].asnumpy(), tx.grad.numpy(), rtol=1e-5,
+        atol=1e-6)
+    params = dict(m.named_parameters())
+    np.testing.assert_allclose(
+        ex.grad_dict["tm_0_weight"].asnumpy(),
+        params["0.weight"].grad.numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_torch_module_trains_with_mx_optimizer():
+    """End to end: the torch module's weights are mxnet args, trained
+    by the mxnet SGD to solve a separable problem."""
+    tb.register_torch_module("tp_mlp", _factory)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.Custom(data=mx.sym.Variable("data"),
+                      op_type="tp_mlp", name="tm"), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (16, 6))],
+             label_shapes=[("softmax_label", (16,))])
+    init = {f"tm_{k}": v
+            for k, v in tb.torch_module_init_params(_factory).items()}
+    mod.init_params(arg_params=init, allow_missing=True,
+                    initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5,
+                                         "momentum": 0.9})
+    rs = np.random.RandomState(0)
+    w = rs.randn(6, 3)
+    X = rs.rand(256, 6).astype(np.float32)
+    y = (X @ w).argmax(1).astype(np.float32)
+    for _ in range(20):
+        for i in range(0, 256, 16):
+            b = mx.io.DataBatch(data=[mx.nd.array(X[i:i + 16])],
+                                label=[mx.nd.array(y[i:i + 16])])
+            mod.forward(b, is_train=True)
+            mod.backward()
+            mod.update()
+    pred = []
+    for i in range(0, 256, 16):
+        mod.forward(mx.io.DataBatch(
+            data=[mx.nd.array(X[i:i + 16])],
+            label=[mx.nd.array(y[i:i + 16])]), is_train=False)
+        pred.append(mod.get_outputs()[0].asnumpy().argmax(1))
+    acc = float((np.concatenate(pred) == y).mean())
+    assert acc > 0.9, acc
+
+
+def test_caffe_op_gated():
+    """Without runtime caffe the bridge raises a pointer to the
+    offline converter instead of a bare ImportError."""
+    with pytest.raises(mx.base.MXNetError, match="caffe_converter"):
+        tb.register_caffe_op("c1", "layer {}")

@@ -64,6 +64,8 @@ from . import attribute
 from . import name
 from . import torch_bridge
 from .torch_bridge import th
+from . import checkpoint_sharded
+from .checkpoint_sharded import load_sharded, save_sharded
 from . import monitor as _monitor_mod
 from .monitor import Monitor
 from . import profiler

@@ -103,6 +103,32 @@ def main():
         assert out.shape[0] == local_b, out.shape
 
     params = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+    if _DIST:
+        # distributed sharded checkpoint: every rank writes ONLY its
+        # shards, a fresh module restores them (orbax collective IO)
+        ckpt = args.ref_out + ".ckpt"
+        mx.save_sharded(mod, ckpt)
+        mod2 = mx.mod.Module(
+            net, label_names=("label",), context=[mx.cpu()],
+            mesh_shape={"data": 2, "seq": 4},
+            data_shardings={"data": "data,seq", "label": "data,seq"},
+        )
+        mod2.bind(data_shapes=[("data", (local_b, T, D_MODEL))],
+                  label_shapes=[("label", (local_b, T, D_MODEL))])
+        np.random.seed(12)  # different init: restore must override it
+        mod2.init_params(mx.initializer.Xavier())
+        # fresh store: the first module's kv already holds these keys
+        mod2.init_optimizer(kvstore=mx.kv.create("tpu"),
+                            optimizer="sgd",
+                            optimizer_params=(("learning_rate", 0.1),))
+        meta = mx.load_sharded(mod2, ckpt)
+        assert meta["t"] == STEPS, meta
+        got = {k: v.asnumpy() for k, v in mod2.get_params()[0].items()}
+        for k in params:
+            np.testing.assert_allclose(got[k], params[k], rtol=1e-6,
+                                       atol=1e-7, err_msg=k)
+
     params.update(run_pipeline())
     if not _DIST:
         np.savez(args.ref_out, **params)

@@ -1,0 +1,98 @@
+"""Sharded (orbax) checkpointing of the fused train state: per-shard
+I/O, exact resume, and restore across a DIFFERENT mesh layout."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import get_transformer
+
+import jax
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices")
+
+D, HEADS, FF, B, T = 8, 2, 16, 4, 8
+
+
+def _build(mesh_shape, data_shardings=None, tp_axis="seq"):
+    net = get_transformer(d_model=D, num_heads=HEADS, d_ff=FF,
+                          num_layers=1, causal=True, tp_axis=tp_axis)
+    mod = mx.mod.Module(net, label_names=("label",),
+                        context=[mx.cpu()], mesh_shape=mesh_shape,
+                        data_shardings=data_shardings)
+    mod.bind(data_shapes=[("data", (B, T, D))],
+             label_shapes=[("label", (B, T, D))])
+    np.random.seed(0)
+    mod.init_params(mx.initializer.Xavier(rnd_type="gaussian",
+                                          magnitude=1.0))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),
+                                         ("momentum", 0.9)))
+    return mod
+
+
+def _steps(mod, n, seed):
+    rs = np.random.RandomState(seed)
+    for _ in range(n):
+        b = mx.io.DataBatch(
+            data=[mx.nd.array(rs.uniform(-1, 1, (B, T, D))
+                              .astype("float32"))],
+            label=[mx.nd.array(rs.uniform(-1, 1, (B, T, D))
+                               .astype("float32"))])
+        mod.forward_backward(b)
+        mod.update()
+
+
+SPEC = dict(mesh_shape={"data": 2, "seq": 4},
+            data_shardings={"data": "data,seq", "label": "data,seq"})
+
+
+def test_save_restore_resume_exact(tmp_path):
+    """Train 2 steps, checkpoint, train 3 more; a second module
+    restored from the checkpoint and trained on the same 3 batches
+    lands on identical parameters — optimizer momentum included."""
+    a = _build(**SPEC)
+    _steps(a, 2, seed=1)
+    path = str(tmp_path / "ck")
+    mx.save_sharded(a, path)
+    _steps(a, 3, seed=2)
+    ref = {k: v.asnumpy() for k, v in a.get_params()[0].items()}
+
+    b = _build(**SPEC)
+    meta = mx.load_sharded(b, path)
+    assert meta["t"] == 2
+    _steps(b, 3, seed=2)
+    got = {k: v.asnumpy() for k, v in b.get_params()[0].items()}
+    for k in ref:
+        np.testing.assert_allclose(got[k], ref[k], rtol=1e-5,
+                                   atol=1e-6, err_msg=k)
+
+
+def test_restore_across_mesh_layouts(tmp_path):
+    """A checkpoint saved under a (data, seq) TP layout restores into
+    a pure-DP module (orbax reshards on read); parameters match the
+    source exactly."""
+    a = _build(**SPEC)
+    _steps(a, 2, seed=3)
+    path = str(tmp_path / "ck2")
+    mx.save_sharded(a, path)
+    src = {k: v.asnumpy() for k, v in a.get_params()[0].items()}
+
+    b = _build(mesh_shape={"data": 8}, tp_axis=None)
+    mx.load_sharded(b, path)
+    got = {k: v.asnumpy() for k, v in b.get_params()[0].items()}
+    for k in src:
+        np.testing.assert_allclose(got[k], src[k], rtol=1e-6,
+                                   atol=1e-7, err_msg=k)
+
+
+def test_sharded_requires_fused(tmp_path):
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2),
+        name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.initializer.Xavier())
+    with pytest.raises(mx.base.MXNetError, match="fused"):
+        mx.save_sharded(mod, str(tmp_path / "nope"))

@@ -27,6 +27,8 @@ nightly:
 	    $(PY) tests/nightly/dist_fused_module.py
 	$(CPUENV) $(PY) tools/launch.py -n 2 --launcher local \
 	    $(PY) tests/nightly/dist_fault_detect.py
+	$(CPUENV) $(PY) tools/launch.py -n 2 --launcher local \
+	    $(PY) tests/nightly/dist_push_overlap.py
 	$(CPUENV) $(PY) tests/nightly/multi_kvstore_types.py
 
 examples:

@@ -82,6 +82,20 @@ def load_sharded(mod, path):
 
     fs = _fused(mod)
     path = os.path.abspath(path)
+    # validate the meta file BEFORE touching the fused state, so a
+    # missing/mismatched checkpoint fails without half-restoring
+    meta_path = os.path.join(path, "mxnet_tpu_meta.json")
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except (OSError, ValueError) as exc:
+        raise MXNetError(
+            f"not a save_sharded checkpoint (no readable "
+            f"mxnet_tpu_meta.json in {path}): {exc}") from exc
+    if meta.get("format") != _FORMAT:
+        raise MXNetError(f"unrecognized checkpoint format in {path}")
+    if "t" not in meta or "num_update" not in meta:
+        raise MXNetError(f"incomplete checkpoint meta in {meta_path}")
     target = jax.tree_util.tree_map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
                                        sharding=x.sharding)
@@ -93,10 +107,6 @@ def load_sharded(mod, path):
     fs.params = restored["params"]
     fs.auxs = restored["auxs"]
     fs.states = restored["states"]
-    with open(os.path.join(path, "mxnet_tpu_meta.json")) as f:
-        meta = json.load(f)
-    if meta.get("format") != _FORMAT:
-        raise MXNetError(f"unrecognized checkpoint format in {path}")
     fs._t = int(meta["t"])
     fs._opt.num_update = int(meta["num_update"])
     # the module's host-side params are now stale relative to the

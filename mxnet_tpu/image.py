@@ -479,7 +479,13 @@ class ImageIter(_io.DataIter):
         covered = {"resize", "rand_crop", "rand_mirror", "mean", "std",
                    "inter_method"}
         for k, v in kwargs.items():
-            if k not in covered and v:
+            if k in covered:
+                continue
+            try:
+                active = v is not None and bool(np.any(v))
+            except Exception:
+                active = True  # unknown kwarg shape: keep python path
+            if active:
                 return None
         if kwargs.get("inter_method", 2) != 2:
             return None

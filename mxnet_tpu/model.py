@@ -61,16 +61,21 @@ def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
 
 
 def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
-    """push(grad); pull(weight) per key (reference model.py:88-97).
-    Priority -index makes early layers sync first in the reference
-    engine; jax dispatch keeps issue order, which preserves the same
-    overlap behavior."""
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list[0] is None:
-            continue
-        kvstore.push(index, grad_list, priority=-index)
-        kvstore.pull(index, arg_list, priority=-index)
+    """push(grad) for ALL keys, then pull(weight) (reference
+    model.py:88-97). The single batched push lets the kvstore stage
+    every key's transfer before dispatching the cross-process
+    reductions in priority order (-index: early layers sync first, the
+    reference's engine-priority trick); pulls follow once all
+    reductions are in flight. Every dispatch is async, so reductions
+    overlap each other and any in-flight compute."""
+    indices = [i for i, g in enumerate(grad_arrays)
+               if g[0] is not None]
+    if not indices:
+        return
+    kvstore.push(indices, [grad_arrays[i] for i in indices],
+                 priority=[-i for i in indices])
+    for i in indices:
+        kvstore.pull(i, param_arrays[i], priority=-i)
 
 
 def _update_params(param_arrays, grad_arrays, updater, num_device,

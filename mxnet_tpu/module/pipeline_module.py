@@ -182,6 +182,20 @@ class PipelineModule(BaseModule):
             self._out_dtypes.append(np.dtype(str(o.dtype)))
             in_shape, in_dtype = tuple(o.shape), np.dtype(str(o.dtype))
         self._out_shape = self._out_shapes_h[-1]
+        # inter-stage activations ride a shared float32 ring buffer
+        # (parallel/pipeline.py pipeline_apply_hetero): integer/bool or
+        # float64 boundary dtypes would be silently corrupted by the
+        # f32 round-trip, so reject them here (stage-0 integer INPUTS
+        # are fine — they never enter the ring)
+        for s, d in enumerate(self._out_dtypes[:-1]):
+            ok = (d.kind == "f" and d.itemsize <= 4) or \
+                d == np.dtype("bfloat16")
+            if not ok:
+                raise MXNetError(
+                    f"stage {s} output dtype {d} cannot cross the "
+                    "pipeline boundary: inter-stage activations round-"
+                    "trip through a float32 ring buffer, so boundary "
+                    "dtypes must be float16/bfloat16/float32")
 
         # flat bucket layout: per stage, [(name, offset, size, shape)]
         def layout(names, shapes_of):

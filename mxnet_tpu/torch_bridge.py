@@ -84,7 +84,7 @@ def torch_module(module):
     return call
 
 
-def register_torch_module(op_name, module_factory):
+def register_torch_module(op_name, module_factory, probe_dtype=None):
     """Register a torch.nn.Module as a RUNTIME symbol op — the
     reference's TorchModule plugin (plugin/torch/torch_module-inl.h:
     lua modules as graph nodes, trainable by the mxnet optimizer).
@@ -99,6 +99,11 @@ def register_torch_module(op_name, module_factory):
     draw a fresh mask in the replay — gradients then correspond to a
     different realization than the forward's output. Keep bridged
     modules deterministic; eval/train mode is set from is_train.
+
+    `probe_dtype` sets the dtype of the zeros tensor used to probe the
+    module at shape inference (default torch float32); pass e.g.
+    torch.long for Embedding-style modules whose forward requires
+    integer inputs.
 
     Returns the ordered mxnet argument names for the module's params.
     """
@@ -164,9 +169,21 @@ def register_torch_module(op_name, module_factory):
         def infer_shape(self, in_shape):
             was_training = shared.training
             shared.train(False)
-            with torch.no_grad():
-                out = shared(torch.zeros(*in_shape[0]))
-            shared.train(was_training)
+            try:
+                with torch.no_grad():
+                    out = shared(torch.zeros(*in_shape[0],
+                                             dtype=probe_dtype))
+            except Exception as exc:
+                raise MXNetError(
+                    f"register_torch_module('{op_name}'): shape "
+                    f"inference probes the module with torch.zeros"
+                    f"{tuple(in_shape[0])} of dtype "
+                    f"{probe_dtype or 'float32'}; the module rejected "
+                    f"it ({exc}). If its forward needs integer inputs "
+                    f"(e.g. nn.Embedding), pass probe_dtype=torch.long"
+                ) from exc
+            finally:
+                shared.train(was_training)
             pshapes = [tuple(p.shape)
                        for _, p in shared.named_parameters()]
             return ([tuple(in_shape[0])] + pshapes,

@@ -101,6 +101,24 @@ def main():
     w0 = multihost_utils.broadcast_one_to_all(w)
     np.testing.assert_allclose(w, np.asarray(w0), rtol=1e-5, atol=1e-6)
 
+    # PARTIAL batch through the staged fused path (ADVICE r4 medium):
+    # half the bound batch still shards evenly over the mesh, so
+    # _stage_for_fused admits it; each worker's outputs after update()
+    # must be its LOCAL rows, not the global concatenation
+    pb = batch // 2
+    b = mx.io.DataBatch(data=[mx.nd.array(x[:pb])],
+                        label=[mx.nd.array(y[:pb])])
+    mod.forward(b, is_train=True)
+    mod.backward()
+    mod.update()
+    out = mod.get_outputs()[0]
+    assert out.shape[0] == pb, (
+        f"rank {rank}: partial-batch outputs have {out.shape[0]} rows, "
+        f"expected local {pb}")
+    mod.forward(b, is_train=False)
+    ref = mod.get_outputs()[0].asnumpy()
+    assert ref.shape[0] == pb
+
     print(f"dist_fused_module OK rank={rank} acc={acc:.3f}")
 
 

@@ -22,11 +22,25 @@ optimizer update in one XLA program. The loss is declared at
 construction: 'l2', 'softmax_ce' (integer class labels against last-dim
 logits), or a callable jax loss(out, label) -> scalar.
 
-Heterogeneous constraints (v2): every stage's single non-parameter
-input must be named like the module's data (data_names[0]); parameters
-and aux states must be float32 (they ride a shared flat fp32 bucket);
-the optimizer treats each stage's bucket as one parameter (uniform
-lr/wd across params — per-name lr_mult does not apply inside a stage).
+Heterogeneous tier (v3) capabilities and remaining constraints:
+
+  - boundary arity: a stage may emit MULTIPLE outputs (sym.Group) and
+    the next stage consumes them as inputs named `<data>`, `<data>1`,
+    `<data>2`, ... (i-th input <- i-th output): residual/skip/carry
+    connections cross stages. The last stage emits exactly one output.
+  - dtypes: stage params/auxs may be float32, bfloat16, or float16.
+    The flat bucket holds f32 MASTER weights; bf16/f16 params are cast
+    at use and updated in f32 (mixed-precision master-weight
+    convention). Boundary activations must be float (they ride an f32
+    ring buffer); stage-0 integer inputs (token ids) are fine.
+  - per-name lr_mult/wd_mult: honored by grouping segments with equal
+    multipliers and running one masked update per group (keys tried:
+    'stage{s}/{name}' then bare '{name}').
+  - tied parameters: `tied_params=[("stage0/w", "stageN/w")]` sums the
+    tied segments' gradients into both copies each step, keeping them
+    bit-identical — tied-embedding LMs pipeline correctly.
+  - loss is still fixed at construction ('l2' / 'softmax_ce' /
+    callable).
 """
 from __future__ import annotations
 
@@ -47,8 +61,15 @@ _FLAT = "pipeline_flat"
 class PipelineModule(BaseModule):
     def __init__(self, stage_symbol, num_stages=None, num_microbatches=1,
                  data_names=("data",), label_names=("label",),
-                 context=None, loss="l2", logger=logging):
+                 context=None, loss="l2", tied_params=None,
+                 logger=logging):
         super().__init__(logger=logger)
+        self._tied_pairs = [tuple(p) for p in (tied_params or [])]
+        if self._tied_pairs and not isinstance(
+                stage_symbol, (list, tuple)):
+            raise MXNetError(
+                "tied_params needs the heterogeneous tier (a list of "
+                "stage symbols); in a single graph share the Variable")
         if len(data_names) != 1 or len(label_names) != 1:
             raise MXNetError(
                 "PipelineModule takes exactly one data and one label")
@@ -156,76 +177,128 @@ class PipelineModule(BaseModule):
         }
         self._out_shape = out_shapes[0]
 
+    def _stage_input_names(self, sym):
+        """The symbol's boundary-input arguments, ordered: the module's
+        data name first, then `<data>1`, `<data>2`, ... — stage s+1's
+        i-th input receives stage s's i-th output (residual/carry
+        boundaries)."""
+        dname = self._data_names[0]
+        found = {}
+        for n in sym.list_arguments():
+            if n == dname:
+                idx = 0
+            elif n.startswith(dname) and n[len(dname):].isdigit():
+                idx = int(n[len(dname):])
+            else:
+                continue
+            if idx in found:
+                raise MXNetError(
+                    f"boundary inputs {found[idx]!r} and {n!r} both "
+                    f"map to position {idx}; name them {dname!r}, "
+                    f"{dname}1, {dname}2, ... without duplicates")
+            found[idx] = n
+        if 0 not in found:
+            raise MXNetError(
+                f"stage has no input named {dname!r}; boundary inputs "
+                f"must be named {dname!r}, {dname!r}+'1', ...")
+        idxs = sorted(found)
+        if idxs != list(range(len(idxs))):
+            raise MXNetError(
+                f"stage boundary inputs must be consecutively "
+                f"numbered; got {[found[i] for i in idxs]}")
+        return [found[i] for i in idxs]
+
+    _FLOATY = ("float16", "bfloat16", "float32")
+
     def _bind_hetero(self):
         """Chain-bind the stage symbols at microbatch shape (stage s's
-        input shape = stage s-1's output shape) and lay out the flat
-        per-stage parameter/aux buckets."""
+        input shapes = stage s-1's output shapes, any arity) and lay
+        out the flat per-stage parameter/aux buckets. Buckets are f32
+        MASTER weights: bf16/f16 stage params are cast at use and
+        updated in f32 (mixed-precision master-weight convention)."""
         dname = self._data_names[0]
         self._stage_execs = []
-        self._in_shapes, self._in_dtypes = [], []
-        self._out_shapes_h, self._out_dtypes = [], []
-        in_shape, in_dtype = self._mb_shape, self._data_dtype
+        self._stage_in_names = []
+        self._in_shapes, self._in_dtypes = [], []    # per stage: lists
+        self._out_shapes_h, self._out_dtypes = [], []  # per stage: lists
+        in_shapes = [self._mb_shape]
+        in_dtypes = [self._data_dtype]
         for s, sym in enumerate(self._stage_syms):
-            if dname not in sym.list_arguments():
+            in_names = self._stage_input_names(sym)
+            if s == 0 and len(in_names) != 1:
                 raise MXNetError(
-                    f"stage {s} has no input named {dname!r}; each "
-                    "stage's single non-parameter input must use the "
-                    "module's data name")
+                    "stage 0 takes exactly the module data input")
+            if len(in_names) != len(in_shapes):
+                raise MXNetError(
+                    f"stage {s} declares {len(in_names)} boundary "
+                    f"inputs but stage {s - 1} produces "
+                    f"{len(in_shapes)} outputs")
             ex = sym.simple_bind(
                 ctx=self._context, grad_req="null",
-                type_dict={dname: in_dtype}, **{dname: in_shape})
+                type_dict={n: d for n, d in zip(in_names, in_dtypes)},
+                **{n: sh for n, sh in zip(in_names, in_shapes)})
             self._stage_execs.append(ex)
-            self._in_shapes.append(tuple(in_shape))
-            self._in_dtypes.append(np.dtype(in_dtype))
-            o = ex.outputs[0]
-            self._out_shapes_h.append(tuple(o.shape))
-            self._out_dtypes.append(np.dtype(str(o.dtype)))
-            in_shape, in_dtype = tuple(o.shape), np.dtype(str(o.dtype))
-        self._out_shape = self._out_shapes_h[-1]
+            self._stage_in_names.append(in_names)
+            self._in_shapes.append([tuple(sh) for sh in in_shapes])
+            self._in_dtypes.append([np.dtype(d) for d in in_dtypes])
+            self._out_shapes_h.append(
+                [tuple(o.shape) for o in ex.outputs])
+            self._out_dtypes.append(
+                [np.dtype(str(o.dtype)) for o in ex.outputs])
+            in_shapes = self._out_shapes_h[-1]
+            in_dtypes = self._out_dtypes[-1]
+        if len(self._out_shapes_h[-1]) != 1:
+            raise MXNetError(
+                "the last pipeline stage must have exactly one output")
+        self._out_shape = self._out_shapes_h[-1][0]
         # inter-stage activations ride a shared float32 ring buffer
         # (parallel/pipeline.py pipeline_apply_hetero): integer/bool or
         # float64 boundary dtypes would be silently corrupted by the
         # f32 round-trip, so reject them here (stage-0 integer INPUTS
         # are fine — they never enter the ring)
-        for s, d in enumerate(self._out_dtypes[:-1]):
-            ok = (d.kind == "f" and d.itemsize <= 4) or \
-                d == np.dtype("bfloat16")
-            if not ok:
-                raise MXNetError(
-                    f"stage {s} output dtype {d} cannot cross the "
-                    "pipeline boundary: inter-stage activations round-"
-                    "trip through a float32 ring buffer, so boundary "
-                    "dtypes must be float16/bfloat16/float32")
+        for s, dts in enumerate(self._out_dtypes[:-1]):
+            for d in dts:
+                if str(d) not in self._FLOATY:
+                    raise MXNetError(
+                        f"stage {s} output dtype {d} cannot cross the "
+                        "pipeline boundary: inter-stage activations "
+                        "round-trip through a float32 ring buffer, so "
+                        "boundary dtypes must be "
+                        "float16/bfloat16/float32")
 
-        # flat bucket layout: per stage, [(name, offset, size, shape)]
-        def layout(names, shapes_of):
+        # flat bucket layout: per stage,
+        # [(name, offset, size, shape, bound dtype)]
+        def layout(names, arr_of):
             segs, off = [], 0
             for n in names:
-                shp = shapes_of(n)
+                arr = arr_of(n)
+                shp = tuple(arr.shape)
+                dt = np.dtype(str(arr._data.dtype))
                 sz = int(np.prod(shp)) if shp else 1
-                segs.append((n, off, sz, tuple(shp)))
+                segs.append((n, off, sz, shp, dt))
                 off += sz
             return segs, off
 
         self._param_segs, self._aux_segs = [], []
         psizes, asizes = [], []
         for s, ex in enumerate(self._stage_execs):
-            pnames = [n for n in ex._arg_names if n != dname]
+            innames = set(self._stage_in_names[s])
+            pnames = [n for n in ex._arg_names if n not in innames]
             for n in pnames + list(ex._aux_names):
                 arr = ex.arg_dict.get(n)
                 if arr is None:
                     arr = ex.aux_dict[n]
-                d = arr._data.dtype
-                if np.dtype(str(d)) != np.float32:
+                d = np.dtype(str(arr._data.dtype))
+                if str(d) not in self._FLOATY:
                     raise MXNetError(
-                        f"stage {s} param/aux {n!r} is {d}; the "
-                        "heterogeneous pipeline bucket is float32-only")
-            segs, L = layout(
-                pnames, lambda n: ex.arg_dict[n].shape)
+                        f"stage {s} param/aux {n!r} is {d}; pipeline "
+                        "params/auxs must be float (f32 master bucket "
+                        "with bf16/f16 cast-at-use)")
+            segs, L = layout(pnames, lambda n: ex.arg_dict[n])
             self._param_segs.append(segs)
             psizes.append(L)
             asegs, A = layout(
-                list(ex._aux_names), lambda n: ex.aux_dict[n].shape)
+                list(ex._aux_names), lambda n: ex.aux_dict[n])
             self._aux_segs.append(asegs)
             asizes.append(A)
         self._lmax = max(psizes) if psizes else 0
@@ -233,8 +306,51 @@ class PipelineModule(BaseModule):
         self._param_names = [
             f"stage{s}/{n}"
             for s, segs in enumerate(self._param_segs)
-            for (n, _, _, _) in segs
+            for (n, _, _, _, _) in segs
         ]
+        self._resolve_ties()
+
+    def _resolve_ties(self):
+        """Resolve tied_params pairs into bucket segments. Tied copies
+        live in different stages' buckets; the train step sums their
+        gradients and writes the sum into both, so (with equal init and
+        equal lr/wd multipliers) the copies stay bit-identical — the
+        pipeline analog of sharing one Variable in a single-device
+        graph (tied-embedding LMs)."""
+        self._ties = []
+        if not self._tied_pairs:
+            return
+        segmap = {}
+        for s, segs in enumerate(self._param_segs):
+            for (n, off, sz, shp, dt) in segs:
+                segmap[f"stage{s}/{n}"] = (s, off, sz, shp, dt)
+        seen = set()
+        for a, b in self._tied_pairs:
+            if a not in segmap or b not in segmap:
+                missing = a if a not in segmap else b
+                raise MXNetError(
+                    f"tied_params: {missing!r} is not a pipeline "
+                    f"parameter (known: {sorted(segmap)})")
+            if a == b:
+                raise MXNetError(
+                    f"tied_params: {a!r} tied to itself")
+            # pairs must be disjoint: chained ties (a,b),(b,c) would
+            # make the sequential grad sums unequal across copies,
+            # breaking the bit-identity guarantee
+            for name in (a, b):
+                if name in seen:
+                    raise MXNetError(
+                        f"tied_params: {name!r} appears in more than "
+                        "one pair; ties must be disjoint pairs (a "
+                        "3-way tie is not supported)")
+                seen.add(name)
+            sa, offa, sza, shpa, _ = segmap[a]
+            sb, offb, szb, shpb, _ = segmap[b]
+            if shpa != shpb:
+                raise MXNetError(
+                    f"tied_params: {a!r} {shpa} and {b!r} {shpb} "
+                    "must have identical shapes")
+            self._ties.append((sa, offa, sb, offb, sza, a, b))
 
     # ------------------------------------------------------- parameters
     def _sharding(self, leaf):
@@ -311,7 +427,7 @@ class PipelineModule(BaseModule):
         flat = np.zeros((self._num_stages, self._lmax), np.float32)
         for s, segs in enumerate(self._param_segs):
             attrs = self._stage_syms[s].attr_dict()
-            for (n, off, sz, shp) in segs:
+            for (n, off, sz, shp, _dt) in segs:
                 key = f"stage{s}/{n}"
                 if arg_params and key in arg_params:
                     v = arg_params[key].asnumpy()
@@ -326,13 +442,18 @@ class PipelineModule(BaseModule):
                     v = rs.uniform(-0.07, 0.07, shp).astype("float32")
                 else:
                     raise MXNetError(f"no value for parameter {key}")
-                flat[s, off:off + sz] = np.ravel(v)
+                flat[s, off:off + sz] = np.ravel(
+                    v.astype(np.float32))
+        # tied copies start from ONE value (the first name's); equal
+        # init + summed grads keeps them identical forever
+        for (sa, offa, sb, offb, sz, _a, _b) in self._ties:
+            flat[sb, offb:offb + sz] = flat[sa, offa:offa + sz]
         auxf = np.zeros((self._num_stages, self._amax), np.float32)
         init = initializer if initializer is not None \
             else Uniform(0.07)
         for s, segs in enumerate(self._aux_segs):
             attrs = self._stage_syms[s].attr_dict()
-            for (n, off, sz, shp) in segs:
+            for (n, off, sz, shp, _dt) in segs:
                 key = f"stage{s}/{n}"
                 if aux_params and key in aux_params:
                     v = aux_params[key].asnumpy()
@@ -343,7 +464,8 @@ class PipelineModule(BaseModule):
                     a = nd.zeros(shp, ctx=self._context)
                     init(InitDesc(n, attrs.get(n)), a)
                     v = a.asnumpy()
-                auxf[s, off:off + sz] = np.ravel(v)
+                auxf[s, off:off + sz] = np.ravel(
+                    v.astype(np.float32))
         flat, auxf = self._bcast((flat, auxf))
         self.params = self._place({_FLAT: jnp.asarray(flat)})
         self._flat_auxs = self._place(jnp.asarray(auxf))
@@ -362,10 +484,10 @@ class PipelineModule(BaseModule):
         auxf = full_host(self._flat_auxs)
         args, auxs = {}, {}
         for s in range(self._num_stages):
-            for (n, off, sz, shp) in self._param_segs[s]:
+            for (n, off, sz, shp, _dt) in self._param_segs[s]:
                 args[f"stage{s}/{n}"] = nd.array(
                     flat[s, off:off + sz].reshape(shp))
-            for (n, off, sz, shp) in self._aux_segs[s]:
+            for (n, off, sz, shp, _dt) in self._aux_segs[s]:
                 auxs[f"stage{s}/{n}"] = nd.array(
                     auxf[s, off:off + sz].reshape(shp))
         return args, auxs
@@ -389,7 +511,79 @@ class PipelineModule(BaseModule):
                 optimizer.create_state(i, nd.array(full_host(v))))
             for i, (n, v) in enumerate(self.params.items())
         })
+        if self._hetero:
+            self._build_mult_groups(optimizer)
         self.optimizer_initialized = True
+
+    def _build_mult_groups(self, optimizer):
+        """Group bucket segments by (lr_mult, wd_mult) so per-name
+        multipliers apply inside a stage: one masked apply_dense per
+        distinct multiplier pair (reference optimizer.py _get_lr/_get_wd
+        per-arg scaling). Lookup keys: 'stage{s}/{name}', then bare
+        '{name}'."""
+
+        attr_dicts = [sym.attr_dict() for sym in self._stage_syms]
+
+        def mults(s, n):
+            # symbol __lr_mult__/__wd_mult__ attrs participate, dict
+            # entries override (reference optimizer.set_lr_mult)
+            a = attr_dicts[s].get(n, {})
+            lm = float(a.get("__lr_mult__", 1.0))
+            wm = float(a.get("__wd_mult__", 1.0))
+            for key in (f"stage{s}/{n}", n):
+                if key in optimizer.lr_mult:
+                    lm = optimizer.lr_mult[key]
+                    break
+            for key in (f"stage{s}/{n}", n):
+                if key in optimizer.wd_mult:
+                    wm = optimizer.wd_mult[key]
+                    break
+            return (lm, wm)
+
+        masks = {}  # (lm, wm) -> np mask (S, Lmax)
+        covered = np.zeros((self._num_stages, self._lmax), bool)
+        tie_mults = {}
+        for s, segs in enumerate(self._param_segs):
+            for (n, off, sz, _shp, _dt) in segs:
+                pair = mults(s, n)
+                tie_mults[f"stage{s}/{n}"] = pair
+                mk = masks.setdefault(
+                    pair,
+                    np.zeros((self._num_stages, self._lmax),
+                             np.float32))
+                mk[s, off:off + sz] = 1.0
+                covered[s, off:off + sz] = True
+        # padding elements (grads are zero there) join the default
+        # group so every bucket element is updated by exactly one group
+        default = masks.setdefault(
+            (1.0, 1.0),
+            np.zeros((self._num_stages, self._lmax), np.float32))
+        default[~covered] = 1.0
+        for (a, b) in [(t[5], t[6]) for t in self._ties]:
+            if tie_mults.get(a) != tie_mults.get(b):
+                raise MXNetError(
+                    f"tied parameters {a!r}/{b!r} must share "
+                    "lr_mult/wd_mult (else the copies diverge)")
+        if list(masks) == [(1.0, 1.0)]:
+            self._mult_groups = None  # uniform: scalar fast path
+            return
+        self._mult_groups = []
+        for gi, ((lm, wm), mk) in enumerate(sorted(masks.items())):
+            gname = f"{_FLAT}::grp{gi}"
+            optimizer.wd_mult[gname] = wm
+            self._mult_groups.append((gname, lm, wm, mk))
+        # when only lr_mult varies (wd uniform), ONE apply_dense with a
+        # per-element lr vector covers every group: lr enters all
+        # registered optimizers elementwise, so an (S, Lmax) lr
+        # broadcasts into the same math at 1x update cost
+        if len({wm for (_g, _l, wm, _m) in self._mult_groups}) == 1:
+            lrvec = np.zeros((self._num_stages, self._lmax),
+                             np.float32)
+            for (_g, lm, _w, mk) in self._mult_groups:
+                lrvec += np.float32(lm) * mk
+            self._lr_vec = lrvec
+        else:
+            self._lr_vec = None
 
     # ------------------------------------------------------ computation
     def _loss_of(self, out, label):
@@ -412,39 +606,43 @@ class PipelineModule(BaseModule):
         import jax
         import jax.numpy as jnp
 
-        dname = self._data_names[0]
         fns = []
         for s, ex in enumerate(self._stage_execs):
             def make(s=s, ex=ex):
                 run = ex._run_graph
                 segs = self._param_segs[s]
                 asegs = self._aux_segs[s]
+                in_names = self._stage_in_names[s]
 
-                def fn(pvec, avec, x, mb_idx):
+                def fn(pvec, avec, xs, mb_idx):
+                    # f32 master bucket -> each param's BOUND dtype
+                    # (bf16/f16 mixed precision casts at use)
                     args = {
-                        n: pvec[off:off + sz].reshape(shp)
-                        for (n, off, sz, shp) in segs
+                        n: pvec[off:off + sz].reshape(shp).astype(dt)
+                        for (n, off, sz, shp, dt) in segs
                     }
                     auxs = {
-                        n: avec[off:off + sz].reshape(shp)
-                        for (n, off, sz, shp) in asegs
+                        n: avec[off:off + sz].reshape(shp).astype(dt)
+                        for (n, off, sz, shp, dt) in asegs
                     }
                     r = jax.random.fold_in(
                         jax.random.fold_in(rng, s), mb_idx)
                     outs, aux_upd = run(
-                        {**args, dname: x}, auxs, r, is_train)
+                        {**args,
+                         **{nm: x for nm, x in zip(in_names, xs)}},
+                        auxs, r, is_train)
                     a2 = avec
-                    for (n, off, sz, shp) in asegs:
+                    for (n, off, sz, shp, dt) in asegs:
                         if n in aux_upd:
                             a2 = a2.at[off:off + sz].set(
                                 jnp.ravel(aux_upd[n]).astype(
                                     jnp.float32))
-                    return outs[0], a2
+                    return tuple(outs), a2
 
-                fn.in_shape = self._in_shapes[s]
-                fn.in_dtype = self._in_dtypes[s]
-                fn.out_shape = self._out_shapes_h[s]
-                fn.out_dtype = self._out_dtypes[s]
+                fn.in_shapes = self._in_shapes[s]
+                fn.in_dtypes = self._in_dtypes[s]
+                fn.out_shapes = self._out_shapes_h[s]
+                fn.out_dtypes = self._out_dtypes[s]
                 return fn
 
             fns.append(make())
@@ -488,6 +686,11 @@ class PipelineModule(BaseModule):
                 out = out.reshape(data.shape)
                 return self._loss_of(out, label), (out, flat_auxs)
 
+        ties = getattr(self, "_ties", None) or []
+        groups = getattr(self, "_mult_groups", None)
+        lr_vec = getattr(self, "_lr_vec", None)
+        jtu_ = jax.tree_util
+
         def train_step(params, states, flat_auxs, data, label, lr, t,
                        rng):
             # rng is a traced argument — a closure capture would be
@@ -495,8 +698,45 @@ class PipelineModule(BaseModule):
             (lval, (out, new_auxs)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, flat_auxs, data, label,
                                        rng)
+            if ties:
+                # tied copies: both segments receive the SUMMED
+                # gradient, so equal-initialized copies stay
+                # bit-identical (shared-Variable semantics across
+                # stage buckets)
+                g = grads[_FLAT]
+                for (sa, offa, sb, offb, sz, _a, _b) in ties:
+                    tied = (g[sa, offa:offa + sz]
+                            + g[sb, offb:offb + sz])
+                    g = g.at[sa, offa:offa + sz].set(tied)
+                    g = g.at[sb, offb:offb + sz].set(tied)
+                grads = dict(grads)
+                grads[_FLAT] = g
             new_p, new_s = {}, {}
             for n in names:
+                if groups and n == _FLAT:
+                    w, g, st = params[n], grads[n], states[n]
+                    if lr_vec is not None:
+                        # wd uniform, only lr_mult varies: one update
+                        # with a per-element lr vector
+                        w2, s2 = opt_.apply_dense(
+                            groups[0][0], w, g, st,
+                            lr * jnp.asarray(lr_vec), t)
+                        new_p[n], new_s[n] = w2, s2
+                        continue
+                    # mixed wd: one masked update per distinct
+                    # (lr_mult, wd_mult) pair, combined with where()
+                    acc_w = jnp.zeros_like(w)
+                    acc_s = jtu_.tree_map(jnp.zeros_like, st)
+                    for (gname, lm, _wm, mk) in groups:
+                        w2, s2 = opt_.apply_dense(
+                            gname, w, g, st, lr * np.float32(lm), t)
+                        m = jnp.asarray(mk.astype(bool))
+                        acc_w = jnp.where(m, w2, acc_w)
+                        acc_s = jtu_.tree_map(
+                            lambda a, b, m=m: jnp.where(m, b, a),
+                            acc_s, s2)
+                    new_p[n], new_s[n] = acc_w, acc_s
+                    continue
                 w2, s2 = opt_.apply_dense(
                     n, params[n], grads[n], states[n],
                     lr * opt_._lr_mult_for(n), t)

@@ -120,13 +120,17 @@ def pipeline_apply_hetero(stage_fns, flat_params, flat_auxs,
         its true input shape and re-pads its output.
 
     stage_fns: list of S callables
-        fn_s(flat_param_vec, flat_aux_vec, x, mb_idx)
-          -> (y, new_flat_aux_vec)
-        where x is stage s's true-shaped input (for s=0 taken directly
-        from `microbatches`, so integer token inputs are fine) and y is
-        its true-shaped output. in/out shapes are declared by
-        `stage_fns[s].in_shape` / `.out_shape` / `.out_dtype`
-        attributes (set by the caller).
+        fn_s(flat_param_vec, flat_aux_vec, xs, mb_idx)
+          -> (ys, new_flat_aux_vec)
+        where xs is a TUPLE of stage s's true-shaped inputs (for s=0 a
+        1-tuple taken directly from `microbatches`, so integer token
+        inputs are fine) and ys is a tuple of its true-shaped outputs —
+        stage s+1's i-th input receives stage s's i-th output
+        (residual/carry boundaries ride the same ring payload).
+        Shapes are declared by `stage_fns[s].in_shapes` /
+        `.in_dtypes` / `.out_shapes` / `.out_dtypes` attributes
+        (lists, set by the caller). The LAST stage must declare exactly
+        one output (the pipeline's result).
     flat_params: (S, Lmax) stage-major padded parameter stack.
     flat_auxs:   (S, Amax) stage-major padded aux stack (Amax may be 0).
     microbatches: (M, ...) stage-0 inputs, replicated.
@@ -135,17 +139,22 @@ def pipeline_apply_hetero(stage_fns, flat_params, flat_auxs,
     s = mesh.shape[axis_name]
     m = microbatches.shape[0]
     assert len(stage_fns) == s
+    assert len(stage_fns[-1].out_shapes) == 1, \
+        "last pipeline stage must have exactly one output"
 
     import numpy as np
 
-    out_shapes = [tuple(f.out_shape) for f in stage_fns]
-    out_dtype = stage_fns[-1].out_dtype
-    # ring payload: the largest flattened boundary activation. The
-    # LAST stage's output never rides the ring (stage 0 ignores its
-    # incoming buf), so it is excluded — for an LM whose head emits
-    # vocab-sized logits this keeps the ppermute at d_model width.
-    emax = max((int(np.prod(sh)) for sh in out_shapes[:-1]),
-               default=1)
+    def _payload(f):
+        return sum(int(np.prod(sh)) for sh in f.out_shapes)
+
+    last_shape = tuple(stage_fns[-1].out_shapes[0])
+    out_dtype = stage_fns[-1].out_dtypes[0]
+    # ring payload: the largest flattened boundary activation SET
+    # (all of a stage's outputs concatenated). The LAST stage's output
+    # never rides the ring (stage 0 ignores its incoming buf), so it
+    # is excluded — for an LM whose head emits vocab-sized logits this
+    # keeps the ppermute at d_model width.
+    emax = max((_payload(f) for f in stage_fns[:-1]), default=1)
 
     def shard_fn(params, auxs, mb):
         idx = jax.lax.axis_index(axis_name)
@@ -154,7 +163,7 @@ def pipeline_apply_hetero(stage_fns, flat_params, flat_auxs,
         ticks = s + m - 1
         buf = jnp.zeros((emax,), jnp.float32)
         buf = jax.lax.pcast(buf, (axis_name,), to="varying")
-        outs = jnp.zeros((m,) + out_shapes[-1], out_dtype)
+        outs = jnp.zeros((m,) + last_shape, out_dtype)
         outs = jax.lax.pcast(outs, (axis_name,), to="varying")
         a_var = a_local  # sharded input: already axis-varying
 
@@ -163,20 +172,25 @@ def pipeline_apply_hetero(stage_fns, flat_params, flat_auxs,
 
             def branch(buf, a, mb_idx):
                 if si == 0:
-                    x = mb[mb_idx]
+                    xs = (mb[mb_idx],)
                 else:
-                    e = int(np.prod(fn.in_shape))
-                    x = buf[:e].reshape(fn.in_shape).astype(
-                        fn.in_dtype)
-                y, a2 = fn(p_local, a, x, mb_idx)
-                flat = jnp.ravel(y).astype(jnp.float32)
+                    xs, off = [], 0
+                    for sh, dt in zip(fn.in_shapes, fn.in_dtypes):
+                        e = int(np.prod(sh))
+                        xs.append(
+                            buf[off:off + e].reshape(sh).astype(dt))
+                        off += e
+                    xs = tuple(xs)
+                ys, a2 = fn(p_local, a, xs, mb_idx)
+                flat = jnp.concatenate(
+                    [jnp.ravel(y).astype(jnp.float32) for y in ys])
                 if flat.shape[0] > emax:  # last stage: ring discards it
                     flat = flat[:emax]
                 pad = emax - flat.shape[0]
                 if pad:
                     flat = jnp.concatenate(
                         [flat, jnp.zeros((pad,), jnp.float32)])
-                return flat, a2, y if si == s - 1 else None
+                return flat, a2, ys[0] if si == s - 1 else None
 
             return branch
 
@@ -192,7 +206,7 @@ def pipeline_apply_hetero(stage_fns, flat_params, flat_auxs,
                     flat, a2, y = b(buf, a, mb_idx)
                     if y is None:
                         y = jax.lax.pcast(
-                            jnp.zeros(out_shapes[-1], out_dtype),
+                            jnp.zeros(last_shape, out_dtype),
                             (axis_name,), to="varying")
                     return flat, a2, y
                 return f

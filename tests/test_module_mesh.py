@@ -338,7 +338,7 @@ def test_pipeline_hetero_matches_unpipelined():
         h = jnp.asarray(x)
         for s, ex in enumerate(execs):
             args = {n: params[f"stage{s}/{n}"]
-                    for (n, _, _, _) in segs[s]}
+                    for (n, _, _, _, _) in segs[s]}
             outs, _ = ex._run_graph(
                 {**args, "data": h}, {}, jax.random.PRNGKey(0), True)
             h = outs[0]

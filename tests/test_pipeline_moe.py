@@ -130,3 +130,227 @@ def test_moe_grads_flow():
     grads = jax.grad(loss)(params)
     for k, g in grads.items():
         assert np.abs(np.asarray(g)).sum() > 0, k
+
+
+# ---------------------------------------------------------------------------
+# heterogeneous pipeline v3 (VERDICT r4 #4): bf16 params, tied
+# embeddings, per-name lr/wd multipliers, multi-input boundaries
+# ---------------------------------------------------------------------------
+import mxnet_tpu as mx  # noqa: E402
+
+
+def _tied_lm_stages(vocab, d):
+    """embedding -> block -> tied-head transformer-style LM stages.
+    Block params bind as BFLOAT16 (f32 masters cast at use); the head
+    weight is tied to the embedding table across stage buckets."""
+    def stage0():
+        data = mx.sym.Variable("data")
+        emb = mx.sym.Embedding(data, input_dim=vocab, output_dim=d,
+                               name="emb")
+        return mx.sym.Cast(emb, dtype="bfloat16")
+
+    def block(name):
+        data = mx.sym.Variable("data")
+        w = mx.sym.Variable(f"{name}_weight", dtype="bfloat16")
+        fc = mx.sym.FullyConnected(data, weight=w, num_hidden=d,
+                                   flatten=False, no_bias=True,
+                                   name=name)
+        return mx.sym.Activation(fc, act_type="tanh")
+
+    def head():
+        data = mx.sym.Variable("data")
+        return mx.sym.FullyConnected(
+            data, num_hidden=vocab, flatten=False, no_bias=True,
+            name="head")
+
+    return [stage0(), block("b1"), head()]
+
+
+def _tied_lm_single(vocab, d):
+    """The same LM as ONE graph sharing a single embedding Variable
+    (the single-device tied-embedding reference)."""
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("emb_weight")
+    emb = mx.sym.Embedding(data, weight=w, input_dim=vocab,
+                           output_dim=d, name="emb")
+    h = mx.sym.Cast(emb, dtype="bfloat16")
+    wb = mx.sym.Variable("b1_weight", dtype="bfloat16")
+    fc = mx.sym.FullyConnected(h, weight=wb, num_hidden=d,
+                               flatten=False, no_bias=True, name="b1")
+    h = mx.sym.Activation(fc, act_type="tanh")
+    return mx.sym.FullyConnected(
+        h, weight=mx.sym.Cast(w, dtype="bfloat16"), num_hidden=vocab,
+        flatten=False, no_bias=True, name="head")
+
+
+def _train_pm(pm, vocab, B, t, steps, lr):
+    pm.bind(data_shapes=[("data", (B, t))])
+    np.random.seed(7)  # Xavier draws from the global RNG: identical
+    pm.init_params(mx.initializer.Xavier(rnd_type="gaussian",
+                                         magnitude=1.0))
+    pm.init_optimizer(optimizer="sgd",
+                      optimizer_params=(("learning_rate", lr),))
+    rs = np.random.RandomState(11)
+    losses = []
+    for i in range(steps):
+        x = rs.randint(0, vocab, (B, t)).astype("float32")
+        y = (x + 1) % vocab  # per-token mapping: learnable
+        pm.forward_backward(mx.io.DataBatch(
+            data=[mx.nd.array(x)], label=[mx.nd.array(y)]))
+        pm.update()
+        losses.append(pm.loss_value)
+    return losses
+
+
+def test_pipeline_bf16_tied_embedding_matches_single_device():
+    """A bf16 tied-embedding LM pipelined over 3 stages converges to
+    the single-device (1-stage, shared-Variable) loss trajectory, and
+    the tied copies stay bit-identical."""
+    vocab, d, B, t, steps, lr = 13, 8, 8, 4, 12, 0.5
+    pm = mx.mod.PipelineModule(
+        _tied_lm_stages(vocab, d), num_microbatches=4,
+        context=mx.cpu(), loss="softmax_ce",
+        tied_params=[("stage0/emb_weight", "stage2/head_weight")])
+    losses = _train_pm(pm, vocab, B, t, steps, lr)
+
+    ref = mx.mod.PipelineModule(
+        [_tied_lm_single(vocab, d)], num_microbatches=4,
+        context=mx.cpu(), loss="softmax_ce")
+    ref_losses = _train_pm(ref, vocab, B, t, steps, lr)
+
+    # same math, different schedule/reduction order + bf16 compute:
+    # trajectories must track closely and converge to the same loss
+    np.testing.assert_allclose(losses[0], ref_losses[0], rtol=5e-2)
+    np.testing.assert_allclose(losses[-1], ref_losses[-1], rtol=5e-2)
+    assert losses[-1] < 0.75 * losses[0], losses
+
+    # bf16 params really bound as bf16 (master f32 bucket cast at use)
+    seg_dtypes = {f"stage{s}/{n}": dt
+                  for s, segs in enumerate(pm._param_segs)
+                  for (n, _, _, _, dt) in segs}
+    assert str(seg_dtypes["stage1/b1_weight"]) == "bfloat16"
+    assert str(seg_dtypes["stage0/emb_weight"]) == "float32"
+
+    # tied copies identical after training
+    params, _ = pm.get_params()
+    np.testing.assert_array_equal(
+        params["stage0/emb_weight"].asnumpy(),
+        params["stage2/head_weight"].asnumpy())
+
+
+def test_pipeline_per_name_lr_mult():
+    """lr_mult=0 freezes one stage parameter while others train
+    (reference optimizer per-arg multipliers, optimizer.py _get_lr)."""
+    vocab, d, B, t = 13, 8, 8, 4
+    pm = mx.mod.PipelineModule(
+        _tied_lm_stages(vocab, d), num_microbatches=4,
+        context=mx.cpu(), loss="softmax_ce")
+    pm.bind(data_shapes=[("data", (B, t))])
+    pm.init_params(mx.initializer.Xavier())
+    o = mx.optimizer.create("sgd", learning_rate=0.5)
+    o.set_lr_mult({"stage1/b1_weight": 0.0})
+    pm.init_optimizer(optimizer=o)
+    before, _ = pm.get_params()
+    frozen0 = before["stage1/b1_weight"].asnumpy()
+    live0 = before["stage0/emb_weight"].asnumpy()
+    rs = np.random.RandomState(3)
+    for _ in range(3):
+        x = rs.randint(0, vocab, (B, t)).astype("float32")
+        pm.forward_backward(mx.io.DataBatch(
+            data=[mx.nd.array(x)],
+            label=[mx.nd.array(np.roll(x, -1, axis=1))]))
+        pm.update()
+    after, _ = pm.get_params()
+    np.testing.assert_array_equal(
+        after["stage1/b1_weight"].asnumpy(), frozen0)
+    assert np.abs(
+        after["stage0/emb_weight"].asnumpy() - live0).max() > 1e-6
+
+
+def test_pipeline_multi_input_boundary():
+    """A stage may emit multiple outputs consumed by the next stage as
+    data/data1/... (residual crossing a stage boundary): parity with
+    the same graph as ONE stage."""
+    d, B, t = 6, 8, 3
+
+    def stage0():
+        data = mx.sym.Variable("data")
+        fc = mx.sym.FullyConnected(data, num_hidden=d, flatten=False,
+                                   no_bias=True, name="s0fc")
+        h = mx.sym.Activation(fc, act_type="tanh")
+        return mx.sym.Group([h, data])  # carry the residual over
+
+    def stage1():
+        h = mx.sym.Variable("data")
+        res = mx.sym.Variable("data1")
+        fc = mx.sym.FullyConnected(h, num_hidden=d, flatten=False,
+                                   no_bias=True, name="s1fc")
+        return fc + res
+
+    def fused():
+        data = mx.sym.Variable("data")
+        fc = mx.sym.FullyConnected(data, num_hidden=d, flatten=False,
+                                   no_bias=True, name="s0fc")
+        h = mx.sym.Activation(fc, act_type="tanh")
+        fc2 = mx.sym.FullyConnected(h, num_hidden=d, flatten=False,
+                                    no_bias=True, name="s1fc")
+        return fc2 + data
+
+    def run(stages, steps=5):
+        pm = mx.mod.PipelineModule(
+            stages, num_microbatches=4, context=mx.cpu(), loss="l2")
+        pm.bind(data_shapes=[("data", (B, t, d))])
+        np.random.seed(9)  # identical draws across the two runs
+        pm.init_params(mx.initializer.Xavier())
+        pm.init_optimizer(optimizer="sgd",
+                          optimizer_params=(("learning_rate", 0.3),))
+        rs = np.random.RandomState(5)
+        losses = []
+        for _ in range(steps):
+            x = rs.standard_normal((B, t, d)).astype("float32")
+            y = np.tanh(x)
+            pm.forward_backward(mx.io.DataBatch(
+                data=[mx.nd.array(x)], label=[mx.nd.array(y)]))
+            pm.update()
+            losses.append(pm.loss_value)
+        return losses, pm.get_params()[0]
+
+    losses2, params2 = run([stage0(), stage1()])
+    losses1, params1 = run([fused()])
+    np.testing.assert_allclose(losses2, losses1, rtol=1e-4, atol=1e-6)
+    for k2, k1 in (("stage0/s0fc_weight", "stage0/s0fc_weight"),
+                   ("stage1/s1fc_weight", "stage0/s1fc_weight")):
+        np.testing.assert_allclose(
+            params2[k2].asnumpy(), params1[k1].asnumpy(),
+            rtol=1e-4, atol=1e-6)
+
+
+def test_pipeline_mixed_wd_mult():
+    """Distinct wd_mult values take the masked multi-group update
+    path: lr_mult-frozen param stays frozen even with weight decay on,
+    no-decay param follows pure SGD."""
+    vocab, d, B, t = 13, 8, 8, 4
+    pm = mx.mod.PipelineModule(
+        _tied_lm_stages(vocab, d), num_microbatches=4,
+        context=mx.cpu(), loss="softmax_ce")
+    pm.bind(data_shapes=[("data", (B, t))])
+    pm.init_params(mx.initializer.Xavier())
+    o = mx.optimizer.create("sgd", learning_rate=0.5, wd=0.05)
+    o.set_lr_mult({"stage1/b1_weight": 0.0})
+    o.set_wd_mult({"stage0/emb_weight": 0.0})
+    pm.init_optimizer(optimizer=o)
+    assert pm._lr_vec is None  # mixed wd -> masked branch
+    before, _ = pm.get_params()
+    frozen0 = before["stage1/b1_weight"].asnumpy()
+    rs = np.random.RandomState(3)
+    for _ in range(2):
+        x = rs.randint(0, vocab, (B, t)).astype("float32")
+        pm.forward_backward(mx.io.DataBatch(
+            data=[mx.nd.array(x)],
+            label=[mx.nd.array((x + 1) % vocab)]))
+        pm.update()
+    after, _ = pm.get_params()
+    np.testing.assert_array_equal(
+        after["stage1/b1_weight"].asnumpy(), frozen0)
+    assert np.abs(after["stage0/emb_weight"].asnumpy()
+                  - before["stage0/emb_weight"].asnumpy()).max() > 1e-6

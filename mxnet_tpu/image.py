@@ -471,13 +471,16 @@ class ImageIter(_io.DataIter):
         return header.label, img
 
     def _try_native_decoder(self, data_shape, kwargs):
-        """NativeImageDecoder covering this iterator's augment set, or
-        None when the set needs the python augmenters (color jitter,
-        PCA noise, random-sized crop, custom interpolation)."""
+        """NativeImageDecoder covering this iterator's augment set —
+        now including the standard ImageNet lighting recipe
+        (brightness/contrast/saturation jitter + PCA noise, reference
+        src/io/image_aug_default.cc) — or None when the set needs the
+        python augmenters (random-sized crop, custom interpolation)."""
         if data_shape[0] != 3:
             return None
         covered = {"resize", "rand_crop", "rand_mirror", "mean", "std",
-                   "inter_method"}
+                   "inter_method", "brightness", "contrast",
+                   "saturation", "pca_noise"}
         for k, v in kwargs.items():
             if k in covered:
                 continue
@@ -503,7 +506,11 @@ class ImageIter(_io.DataIter):
                 resize_short=int(kwargs.get("resize", 0) or 0),
                 rand_crop=bool(kwargs.get("rand_crop", False)),
                 rand_mirror=bool(kwargs.get("rand_mirror", False)),
-                mean=mean, std=std, layout=self.data_layout)
+                mean=mean, std=std, layout=self.data_layout,
+                brightness=float(kwargs.get("brightness", 0) or 0),
+                contrast=float(kwargs.get("contrast", 0) or 0),
+                saturation=float(kwargs.get("saturation", 0) or 0),
+                pca_noise=float(kwargs.get("pca_noise", 0) or 0))
         except Exception as exc:
             logging.debug("native image decoder unavailable: %s", exc)
             return None

@@ -151,6 +151,13 @@ def get_lib_imgdec():
             ctypes.POINTER(ctypes.c_float),   # out
             ctypes.POINTER(ctypes.c_uint8),   # ok flags
         ]
+        lib.imgdec_batch_aug.restype = None
+        lib.imgdec_batch_aug.argtypes = (
+            lib.imgdec_batch.argtypes[:-2]
+            + [ctypes.c_float] * 4            # brightness/contrast/
+                                              # saturation/pca_noise
+            + lib.imgdec_batch.argtypes[-2:]
+        )
         _imgdec_lib = lib
         return _imgdec_lib
 
@@ -162,7 +169,8 @@ class NativeImageDecoder(object):
 
     def __init__(self, nthreads=4, resize_short=0, rand_crop=False,
                  rand_mirror=False, mean=None, std=None,
-                 layout="NCHW"):
+                 layout="NCHW", brightness=0.0, contrast=0.0,
+                 saturation=0.0, pca_noise=0.0):
         import numpy as np
 
         self._lib = get_lib_imgdec()
@@ -170,6 +178,10 @@ class NativeImageDecoder(object):
         self.resize_short = int(resize_short)
         self.rand_crop = bool(rand_crop)
         self.rand_mirror = bool(rand_mirror)
+        self.brightness = float(brightness)
+        self.contrast = float(contrast)
+        self.saturation = float(saturation)
+        self.pca_noise = float(pca_noise)
         self.layout = layout.upper()
         def three(v, what):
             # C++ reads exactly [0..2]: broadcast scalars, reject odd
@@ -206,7 +218,7 @@ class NativeImageDecoder(object):
         np.cumsum(lens[:-1], out=offs[1:])
         ok = np.zeros(n, np.uint8)
         fptr = ctypes.POINTER(ctypes.c_float)
-        self._lib.imgdec_batch(
+        common = [
             self._h,
             blob.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
             offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
@@ -220,9 +232,18 @@ class NativeImageDecoder(object):
             if self._mean is not None else None,
             self._std.ctypes.data_as(fptr)
             if self._std is not None else None,
+        ]
+        tail = [
             out.ctypes.data_as(fptr),
             ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-        )
+        ]
+        if self.brightness or self.contrast or self.saturation \
+                or self.pca_noise:
+            self._lib.imgdec_batch_aug(
+                *common, self.brightness, self.contrast,
+                self.saturation, self.pca_noise, *tail)
+        else:
+            self._lib.imgdec_batch(*common, *tail)
         return ok
 
     def close(self):

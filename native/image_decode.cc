@@ -17,6 +17,7 @@
 #include <jpeglib.h>
 
 #include <atomic>
+#include <cmath>
 #include <condition_variable>
 #include <csetjmp>
 #include <cstdint>
@@ -148,9 +149,102 @@ struct Job {
   uint64_t seed = 0;
   const float* mean = nullptr;  // len 3 or null
   const float* stdv = nullptr;  // len 3 or null
+  // color jitter + PCA lighting (reference image_aug_default.cc and
+  // python ColorJitterAug/LightingAug): 0 disables each
+  float brightness = 0.f;
+  float contrast = 0.f;
+  float saturation = 0.f;
+  float pca_noise = 0.f;
   float* out = nullptr;         // (n, 3, out_h, out_w) or (n,H,W,3)
   uint8_t* ok = nullptr;        // per-image success
 };
+
+// uniform [0,1) from one splitmix draw
+inline double u01(uint64_t r) {
+  return static_cast<double>(r >> 11) / 9007199254740992.0;
+}
+
+// ImageNet PCA lighting basis (python CreateAugmenter image.py:270)
+const float kEigval[3] = {55.46f, 4.794f, 1.148f};
+const float kEigvec[3][3] = {{-0.5675f, 0.7192f, 0.4009f},
+                             {-0.5808f, -0.0045f, -0.8140f},
+                             {-0.5836f, -0.6948f, 0.4203f}};
+
+// Apply color jitter (random order, matching RandomOrderAug) and PCA
+// lighting to a float RGB buffer in [0,255]. `r` advances the
+// per-image RNG chain; returns the advanced state.
+uint64_t color_augment(const Job& j, float* px, int npx, uint64_t r) {
+  // which jitter ops are on: 0=brightness 1=contrast 2=saturation
+  int ops[3], nops = 0;
+  if (j.brightness > 0.f) ops[nops++] = 0;
+  if (j.contrast > 0.f) ops[nops++] = 1;
+  if (j.saturation > 0.f) ops[nops++] = 2;
+  // Fisher-Yates shuffle of the enabled ops (RandomOrderAug)
+  for (int k = nops - 1; k > 0; --k) {
+    const int m = static_cast<int>(r % (k + 1));
+    r = splitmix(r);
+    const int tmp = ops[k];
+    ops[k] = ops[m];
+    ops[m] = tmp;
+  }
+  for (int oi = 0; oi < nops; ++oi) {
+    float range = ops[oi] == 0 ? j.brightness
+                  : ops[oi] == 1 ? j.contrast
+                                 : j.saturation;
+    const float alpha =
+        1.f + static_cast<float>(u01(r) * 2.0 - 1.0) * range;
+    r = splitmix(r);
+    if (ops[oi] == 0) {  // brightness: arr *= alpha, clip
+      for (int p = 0; p < npx * 3; ++p) {
+        float v = px[p] * alpha;
+        px[p] = v < 0.f ? 0.f : (v > 255.f ? 255.f : v);
+      }
+    } else if (ops[oi] == 1) {  // contrast: toward mean gray
+      double gsum = 0.0;
+      for (int p = 0; p < npx; ++p)
+        gsum += 0.299f * px[3 * p] + 0.587f * px[3 * p + 1] +
+                0.114f * px[3 * p + 2];
+      const float gmean =
+          static_cast<float>(gsum / npx) * (1.f - alpha);
+      for (int p = 0; p < npx * 3; ++p) {
+        float v = px[p] * alpha + gmean;
+        px[p] = v < 0.f ? 0.f : (v > 255.f ? 255.f : v);
+      }
+    } else {  // saturation: toward per-pixel gray
+      for (int p = 0; p < npx; ++p) {
+        const float gray =
+            (0.299f * px[3 * p] + 0.587f * px[3 * p + 1] +
+             0.114f * px[3 * p + 2]) *
+            (1.f - alpha);
+        for (int c = 0; c < 3; ++c) {
+          float v = px[3 * p + c] * alpha + gray;
+          px[3 * p + c] = v < 0.f ? 0.f : (v > 255.f ? 255.f : v);
+        }
+      }
+    }
+  }
+  if (j.pca_noise > 0.f) {
+    // alpha ~ N(0, pca_noise)^3 via Box-Muller; rgb = (eigvec*alpha)@eigval
+    float alpha[3];
+    for (int k = 0; k < 3; ++k) {
+      const double uu = u01(r) + 1e-12;
+      r = splitmix(r);
+      const double vv = u01(r);
+      r = splitmix(r);
+      alpha[k] = static_cast<float>(
+          std::sqrt(-2.0 * std::log(uu)) *
+          std::cos(2.0 * 3.14159265358979323846 * vv) * j.pca_noise);
+    }
+    float rgb[3];
+    for (int c = 0; c < 3; ++c)
+      rgb[c] = kEigvec[c][0] * alpha[0] * kEigval[0] +
+               kEigvec[c][1] * alpha[1] * kEigval[1] +
+               kEigvec[c][2] * alpha[2] * kEigval[2];
+    for (int p = 0; p < npx; ++p)
+      for (int c = 0; c < 3; ++c) px[3 * p + c] += rgb[c];  // no clip
+  }
+  return r;
+}
 
 void scale_down(int sw, int sh, int* cw, int* ch) {
   // reference image.py:33 — shrink the crop to fit the source while
@@ -244,25 +338,68 @@ void process_one(const Job& j, int i, std::vector<uint8_t>* scratch,
               s2 = j.stdv ? 1.f / j.stdv[2] : 1.f;
   float* dst = j.out + static_cast<size_t>(i) * 3 * fh * fw;
   const size_t plane = static_cast<size_t>(fh) * fw;
-  for (int y = 0; y < fh; ++y) {
-    for (int x = 0; x < fw; ++x) {
-      const int sx = mirror ? fw - 1 - x : x;
-      const uint8_t* p =
-          final_px
-              ? final_px + (static_cast<size_t>(y) * fw + sx) * 3
-              : crop_src +
-                    ((static_cast<size_t>(y0) + y) * w + x0 + sx) * 3;
-      const size_t o = static_cast<size_t>(y) * fw + x;
-      if (j.chw) {
-        dst[o] = (p[0] - m0) * s0;
-        dst[plane + o] = (p[1] - m1) * s1;
-        dst[2 * plane + o] = (p[2] - m2) * s2;
-      } else {
-        dst[3 * o] = (p[0] - m0) * s0;
-        dst[3 * o + 1] = (p[1] - m1) * s1;
-        dst[3 * o + 2] = (p[2] - m2) * s2;
+  // ONE copy of the mirrored-crop source addressing, shared by the
+  // plain and color-augmented paths
+  const auto src_px = [&](int y, int x) -> const uint8_t* {
+    const int sx = mirror ? fw - 1 - x : x;
+    return final_px
+               ? final_px + (static_cast<size_t>(y) * fw + sx) * 3
+               : crop_src +
+                     ((static_cast<size_t>(y0) + y) * w + x0 + sx) * 3;
+  };
+  // ONE copy of the normalize + CHW/NHWC write, over any float3 getter
+  const auto write_norm = [&](auto get3) {
+    for (int y = 0; y < fh; ++y)
+      for (int x = 0; x < fw; ++x) {
+        float f0, f1, f2;
+        get3(y, x, &f0, &f1, &f2);
+        const size_t o = static_cast<size_t>(y) * fw + x;
+        if (j.chw) {
+          dst[o] = (f0 - m0) * s0;
+          dst[plane + o] = (f1 - m1) * s1;
+          dst[2 * plane + o] = (f2 - m2) * s2;
+        } else {
+          dst[3 * o] = (f0 - m0) * s0;
+          dst[3 * o + 1] = (f1 - m1) * s1;
+          dst[3 * o + 2] = (f2 - m2) * s2;
+        }
       }
-    }
+  };
+  const bool coloraug = j.brightness > 0.f || j.contrast > 0.f ||
+                        j.saturation > 0.f || j.pca_noise > 0.f;
+  if (coloraug) {
+    // python augmenter order (CreateAugmenter): crop -> mirror ->
+    // color jitter (random order) -> PCA lighting -> normalize; the
+    // color passes need float pixels, so gather the mirrored crop
+    // into a per-thread float buffer first
+    static thread_local std::vector<float> fbuf;
+    fbuf.resize(static_cast<size_t>(fh) * fw * 3);
+    for (int y = 0; y < fh; ++y)
+      for (int x = 0; x < fw; ++x) {
+        const uint8_t* p = src_px(y, x);
+        float* f = fbuf.data() + (static_cast<size_t>(y) * fw + x) * 3;
+        f[0] = p[0];
+        f[1] = p[1];
+        f[2] = p[2];
+      }
+    // salt so the chain decorrelates from the mirror draw (which
+    // consumed splitmix(r) without advancing r)
+    color_augment(j, fbuf.data(), fh * fw,
+                  splitmix(r ^ 0xa5a5a5a5a5a5a5a5ULL));
+    write_norm([&](int y, int x, float* f0, float* f1, float* f2) {
+      const float* f =
+          fbuf.data() + (static_cast<size_t>(y) * fw + x) * 3;
+      *f0 = f[0];
+      *f1 = f[1];
+      *f2 = f[2];
+    });
+  } else {
+    write_norm([&](int y, int x, float* f0, float* f1, float* f2) {
+      const uint8_t* p = src_px(y, x);
+      *f0 = p[0];
+      *f1 = p[1];
+      *f2 = p[2];
+    });
   }
   j.ok[i] = 1;
 }
@@ -352,11 +489,17 @@ void imgdec_destroy(void* h) { delete static_cast<Pool*>(h); }
 
 // Decode+augment a batch of JPEG blobs into (n,3,out_h,out_w) float32.
 // ok[i]=1 per successfully decoded image (0 => caller falls back).
-void imgdec_batch(void* h, const uint8_t* blob, const int64_t* offs,
-                  const int64_t* lens, int n, int out_h, int out_w,
-                  int resize_short, int rand_crop, int rand_mirror,
-                  int chw, uint64_t seed, const float* mean,
-                  const float* stdv, float* out, uint8_t* ok) {
+// Full-recipe entry: decode + geometry augs + color jitter + PCA
+// lighting (the reference's standard ImageNet recipe,
+// image_aug_default.cc / python CreateAugmenter).
+void imgdec_batch_aug(void* h, const uint8_t* blob,
+                      const int64_t* offs, const int64_t* lens, int n,
+                      int out_h, int out_w, int resize_short,
+                      int rand_crop, int rand_mirror, int chw,
+                      uint64_t seed, const float* mean,
+                      const float* stdv, float brightness,
+                      float contrast, float saturation,
+                      float pca_noise, float* out, uint8_t* ok) {
   Job j;
   j.blob = blob;
   j.offs = offs;
@@ -371,9 +514,25 @@ void imgdec_batch(void* h, const uint8_t* blob, const int64_t* offs,
   j.seed = seed;
   j.mean = mean;
   j.stdv = stdv;
+  j.brightness = brightness;
+  j.contrast = contrast;
+  j.saturation = saturation;
+  j.pca_noise = pca_noise;
   j.out = out;
   j.ok = ok;
   static_cast<Pool*>(h)->run(j);
+}
+
+// Plain entry (no color augs): forwards with zero aug params so the
+// Job fill exists exactly once.
+void imgdec_batch(void* h, const uint8_t* blob, const int64_t* offs,
+                  const int64_t* lens, int n, int out_h, int out_w,
+                  int resize_short, int rand_crop, int rand_mirror,
+                  int chw, uint64_t seed, const float* mean,
+                  const float* stdv, float* out, uint8_t* ok) {
+  imgdec_batch_aug(h, blob, offs, lens, n, out_h, out_w, resize_short,
+                   rand_crop, rand_mirror, chw, seed, mean, stdv, 0.f,
+                   0.f, 0.f, 0.f, out, ok);
 }
 
 }  // extern "C"

@@ -43,6 +43,10 @@ def main():
     ap.add_argument("--side", type=int, default=224)
     ap.add_argument("--batch-size", type=int, default=64)
     ap.add_argument("--threads", default="1,4,8")
+    ap.add_argument("--full-aug", action="store_true",
+                    help="standard ImageNet lighting recipe "
+                         "(jitter + PCA + normalize) on top of "
+                         "crop/mirror")
     ap.add_argument("--rec", default=None,
                     help="existing .rec (default: synthesize)")
     args = ap.parse_args()
@@ -57,12 +61,17 @@ def main():
         rec = args.rec
 
     shape = (3, args.side, args.side)
+    aug = {}
+    if args.full_aug:
+        # the reference's standard lighting recipe (image_aug_default)
+        aug = dict(brightness=0.4, contrast=0.4, saturation=0.4,
+                   pca_noise=0.1, mean=True, std=True)
     for nthread in (int(t) for t in args.threads.split(",")):
         it = ImageIter(
             batch_size=args.batch_size, data_shape=shape,
             path_imgrec=rec, shuffle=False,
             preprocess_threads=nthread, rand_crop=True,
-            rand_mirror=True)
+            rand_mirror=True, **aug)
         # warm epoch (open files, allocate pools)
         for _ in it:
             pass

@@ -64,6 +64,7 @@ from . import attribute
 from . import name
 from . import torch_bridge
 from .torch_bridge import th
+from . import caffe_bridge
 from . import checkpoint_sharded
 from .checkpoint_sharded import load_sharded, save_sharded
 from . import monitor as _monitor_mod

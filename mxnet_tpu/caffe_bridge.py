@@ -1,0 +1,345 @@
+"""Runtime caffe-layer op plugin (VERDICT r4 #6).
+
+The reference runs caffe layers as graph nodes with trainable
+parameters (plugin/caffe/caffe_op-inl.h: CaffeOp wraps a
+caffe::Layer, forwards its blobs, and backpropagates through
+caffe::Layer::Backward). This is the tpu-native analog, built exactly
+like the torch runtime plugin (torch_bridge.register_torch_module):
+the layer's parameters surface as regular mxnet arguments, so the
+ordinary optimizer trains them, and the layer body executes as a
+CustomOp callback.
+
+Layer resolution, in order:
+
+1. an explicit ``layer=`` object implementing the minimal caffe layer
+   protocol below (what pycaffe's ``caffe.Layer`` exposes);
+2. pycaffe, when importable: the prototxt is instantiated as a
+   single-layer ``caffe.Net`` (same path the reference plugin takes);
+   NOT available in the supported images — code kept for parity, the
+   import gate documents the dependency;
+3. a built-in numpy implementation of the common trainable caffe
+   layers (InnerProduct, ReLU, TanH, Sigmoid), constructed from the
+   prototxt via tools/caffe_converter.parse_prototxt — so the plugin
+   is real and testable without caffe itself.
+
+Minimal layer protocol (pycaffe-shaped)::
+
+    class MyLayer:
+        def setup(self, bottom_shape) -> list[param_shapes]
+        def infer_top(self, bottom_shape) -> top_shape
+        def forward(self, bottom, params) -> top          # numpy
+        def backward(self, top_diff, bottom, params)
+            -> (bottom_diff, [param_diffs])
+
+Usage::
+
+    pnames = register_caffe_op("caffe_ip", prototxt=PROTO)
+    sym = mx.sym.Custom(data=x, op_type="caffe_ip")
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+
+def _parse_layer(prototxt):
+    """First layer message of a prototxt snippet, via the converter's
+    parser (tools/caffe_converter.py parse_prototxt), loaded by file
+    path so library code never mutates sys.path."""
+    import importlib.util
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools", "caffe_converter.py")
+    spec = importlib.util.spec_from_file_location(
+        "mxnet_tpu._caffe_converter", path)
+    mod = importlib.util.module_from_spec(spec)
+    import sys
+
+    saved = list(sys.path)
+    try:
+        # the converter script self-inserts the repo root for CLI use;
+        # undo any mutation so library imports stay side-effect-free
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path[:] = saved
+    msg = mod.parse_prototxt(prototxt)
+    layers = msg.get("layer", [])
+    if not isinstance(layers, list):
+        layers = [layers]
+    if not layers:
+        raise MXNetError("prototxt has no `layer { ... }` message")
+    return layers[0]
+
+
+# ---------------------------------------------------------- numpy tier
+class _InnerProduct(object):
+    """caffe InnerProduct (src/caffe/layers/inner_product_layer.cpp
+    semantics: flatten trailing axes, y = x W^T + b)."""
+
+    def __init__(self, num_output, bias_term=True):
+        self.num_output = int(num_output)
+        self.bias_term = bool(bias_term)
+
+    def param_count(self):
+        return 2 if self.bias_term else 1
+
+    def setup(self, bottom_shape):
+        k = int(np.prod(bottom_shape[1:]))
+        shapes = [(self.num_output, k)]
+        if self.bias_term:
+            shapes.append((self.num_output,))
+        return shapes
+
+    def infer_top(self, bottom_shape):
+        return (bottom_shape[0], self.num_output)
+
+    def forward(self, bottom, params):
+        x = bottom.reshape(bottom.shape[0], -1)
+        y = x @ params[0].T
+        if self.bias_term:
+            y = y + params[1]
+        return y
+
+    def backward(self, top_diff, bottom, params):
+        x = bottom.reshape(bottom.shape[0], -1)
+        dW = top_diff.T @ x
+        db = top_diff.sum(axis=0) if self.bias_term else None
+        dx = (top_diff @ params[0]).reshape(bottom.shape)
+        grads = [dW] + ([db] if self.bias_term else [])
+        return dx, grads
+
+
+class _Elementwise(object):
+    def param_count(self):
+        return 0
+
+    def setup(self, bottom_shape):
+        return []
+
+    def infer_top(self, bottom_shape):
+        return tuple(bottom_shape)
+
+
+class _ReLU(_Elementwise):
+    def forward(self, bottom, params):
+        return np.maximum(bottom, 0)
+
+    def backward(self, top_diff, bottom, params):
+        return top_diff * (bottom > 0), []
+
+
+class _TanH(_Elementwise):
+    def forward(self, bottom, params):
+        return np.tanh(bottom)
+
+    def backward(self, top_diff, bottom, params):
+        t = np.tanh(bottom)
+        return top_diff * (1 - t * t), []
+
+
+class _Sigmoid(_Elementwise):
+    def forward(self, bottom, params):
+        return 1.0 / (1.0 + np.exp(-bottom))
+
+    def backward(self, top_diff, bottom, params):
+        s = 1.0 / (1.0 + np.exp(-bottom))
+        return top_diff * s * (1 - s), []
+
+
+def _make_inner_product(p):
+    ipp = p.get("inner_product_param", {})
+    if "num_output" not in ipp:
+        # caffe treats num_output as required; a silent default would
+        # build a wrong 1-output layer
+        raise MXNetError(
+            "InnerProduct prototxt needs "
+            "inner_product_param { num_output: N }")
+    return _InnerProduct(ipp["num_output"], ipp.get("bias_term", True))
+
+
+_NUMPY_LAYERS = {
+    "InnerProduct": _make_inner_product,
+    "ReLU": lambda p: _ReLU(),
+    "TanH": lambda p: _TanH(),
+    "Sigmoid": lambda p: _Sigmoid(),
+}
+
+
+class _PyCaffeLayer(object):
+    """Adapter running the layer through a real single-layer caffe.Net
+    (the reference plugin's path, plugin/caffe/caffe_op-inl.h). Only
+    constructed when `import caffe` succeeds."""
+
+    def __init__(self, prototxt):
+        import caffe  # noqa: F401  (absent in the supported images)
+
+        self._prototxt = prototxt
+        self._net = None
+
+    def _build(self, bottom_shape):
+        import tempfile
+
+        import caffe
+
+        net_txt = (
+            # force_backward: Net::Backward only fills input-blob
+            # diffs when forced, else the bridged op returns zero
+            # data gradients and upstream layers stop training
+            "force_backward: true\n"
+            'input: "data"\n'
+            + "input_dim: " + "\ninput_dim: ".join(
+                str(int(d)) for d in bottom_shape)
+            + "\n" + self._prototxt)
+        with tempfile.NamedTemporaryFile(
+                "w", suffix=".prototxt", delete=False) as f:
+            f.write(net_txt)
+            path = f.name
+        self._net = caffe.Net(path, caffe.TRAIN)
+
+    def setup(self, bottom_shape):
+        self._build(bottom_shape)
+        layer = self._net.layers[-1]
+        return [tuple(b.data.shape) for b in layer.blobs]
+
+    def infer_top(self, bottom_shape):
+        if self._net is None:
+            self._build(bottom_shape)
+        top = list(self._net.blobs)[-1]
+        return tuple(self._net.blobs[top].data.shape)
+
+    def forward(self, bottom, params):
+        net = self._net
+        layer = net.layers[-1]
+        for b, v in zip(layer.blobs, params):
+            b.data[...] = v
+        net.blobs["data"].data[...] = bottom
+        net.forward()
+        return net.blobs[list(net.blobs)[-1]].data.copy()
+
+    def backward(self, top_diff, bottom, params):
+        net = self._net
+        layer = net.layers[-1]
+        for b in layer.blobs:
+            b.diff[...] = 0
+        top = list(net.blobs)[-1]
+        self.forward(bottom, params)
+        net.blobs[top].diff[...] = top_diff
+        net.backward()
+        return (net.blobs["data"].diff.copy(),
+                [b.diff.copy() for b in layer.blobs])
+
+
+def _resolve_layer(prototxt, layer):
+    if layer is not None:
+        return layer, None
+    if prototxt is None:
+        raise MXNetError(
+            "register_caffe_op needs `prototxt` or a `layer` object")
+    try:
+        import caffe  # noqa: F401
+
+        return _PyCaffeLayer(prototxt), None
+    except ImportError:
+        pass
+    msg = _parse_layer(prototxt)
+    ltype = msg.get("type")
+    if ltype not in _NUMPY_LAYERS:
+        raise MXNetError(
+            f"caffe layer type {ltype!r} has no built-in numpy "
+            f"implementation (available: {sorted(_NUMPY_LAYERS)}) and "
+            "pycaffe is not importable; pass `layer=` implementing "
+            "the protocol in mxnet_tpu/caffe_bridge.py")
+    return _NUMPY_LAYERS[ltype](msg), msg
+
+
+def register_caffe_op(op_name, prototxt=None, layer=None,
+                      num_params=None):
+    """Register a caffe layer as a RUNTIME symbol op — the reference's
+    CaffeOp plugin (plugin/caffe/caffe_op-inl.h). The layer's blobs
+    surface as mxnet arguments named `<op_name>_weight` /
+    `<op_name>_bias` (the caffe blob convention, spelled so default
+    initializers dispatch), trained by the regular optimizer; use with
+    ``mx.sym.Custom(data=..., op_type=op_name)``.
+
+    The parameter COUNT must be static (symbol composition needs the
+    argument list before any shape is known — the reference solves
+    this the same way with CaffeOpParam.num_weight): built-in numpy
+    layers and protocol layers report it via ``param_count()``;
+    otherwise pass ``num_params``.
+
+    Returns the ordered mxnet argument names for the layer's params.
+    """
+    from . import ndarray as _nd
+    from . import operator as _op
+
+    impl, _msg = _resolve_layer(prototxt, layer)
+    if num_params is None:
+        if not hasattr(impl, "param_count"):
+            raise MXNetError(
+                "layer does not report param_count(); pass "
+                "num_params= (the reference's num_weight)")
+        num_params = int(impl.param_count())
+
+    def _pname(i):
+        # caffe blob convention (blob0 weight, blob1 bias) spelled so
+        # the initializer's *weight/*bias name dispatch applies
+        if i == 0:
+            return f"{op_name}_weight"
+        if i == 1:
+            return f"{op_name}_bias"
+        return f"{op_name}_blob{i}_weight"
+
+    pnames = [_pname(i) for i in range(num_params)]
+    # param shapes per bottom shape: re-binding at a new input shape
+    # must re-run setup, not reuse stale weight shapes
+    shape_cache = {}
+
+    def _pshapes(bottom):
+        if bottom not in shape_cache:
+            shapes = [tuple(s) for s in impl.setup(bottom)]
+            if len(shapes) != num_params:
+                raise MXNetError(
+                    f"layer setup produced {len(shapes)} params, "
+                    f"declared {num_params}")
+            shape_cache[bottom] = shapes
+        return shape_cache[bottom]
+
+    class _CaffeOp(_op.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            bottom = in_data[0].asnumpy()
+            params = [a.asnumpy() for a in in_data[1:]]
+            self.assign(out_data[0], req[0],
+                        _nd.array(np.asarray(
+                            impl.forward(bottom, params), np.float32)))
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad,
+                     aux):
+            bottom = in_data[0].asnumpy()
+            params = [a.asnumpy() for a in in_data[1:]]
+            dx, dps = impl.backward(
+                out_grad[0].asnumpy(), bottom, params)
+            grads = [dx] + list(dps)
+            for i, g in enumerate(grads):
+                val = (np.zeros(in_grad[i].shape, np.float32)
+                       if g is None else np.asarray(g, np.float32))
+                self.assign(in_grad[i], req[i], _nd.array(val))
+
+    class _CaffeOpProp(_op.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def list_arguments(self):
+            return ["data"] + pnames
+
+        def infer_shape(self, in_shape):
+            bottom = tuple(in_shape[0])
+            top = tuple(impl.infer_top(bottom))
+            return ([bottom] + _pshapes(bottom), [top], [])
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return _CaffeOp()
+
+    _op.register(op_name)(_CaffeOpProp)
+    return pnames

@@ -196,15 +196,17 @@ def register_torch_module(op_name, module_factory, probe_dtype=None):
     return pnames
 
 
-def register_caffe_op(op_name, prototxt):
-    """The reference's CaffeOp plugin surface (plugin/caffe/
-    caffe_op-inl.h: run a caffe layer as a graph node). NOT implemented
-    in this build — runtime caffe is absent from the supported images —
-    so this always raises with guidance: offline model import is
-    covered by tools/caffe_converter.py."""
-    raise MXNetError(
-        "the runtime caffe op bridge is not implemented in this "
-        "build; for offline model import use tools/caffe_converter.py")
+def register_caffe_op(op_name, prototxt=None, layer=None,
+                      num_params=None):
+    """The reference's CaffeOp plugin (plugin/caffe/caffe_op-inl.h):
+    run a caffe layer as a trainable graph node. Implemented in
+    mxnet_tpu/caffe_bridge.py (pycaffe when importable, built-in numpy
+    layers otherwise); offline model import stays with
+    tools/caffe_converter.py."""
+    from .caffe_bridge import register_caffe_op as _impl
+
+    return _impl(op_name, prototxt=prototxt, layer=layer,
+                 num_params=num_params)
 
 
 def torch_module_init_params(module_factory, prefix=""):

@@ -277,6 +277,32 @@ int MXTpuNDListGet(void* handle, int index, const char** out_key,
                    unsigned* out_ndim);
 void MXTpuNDListFree(void* handle);
 
+/* ------------------------------------------------------------------
+ * Deliberately-dropped reference ABI tail (so completeness is
+ * auditable by diffing names against include/mxnet/c_api.h):
+ *
+ *   MXListFunctions / MXGetFunction / MXFuncGetInfo / MXFuncDescribe /
+ *   MXFuncInvoke / MXFuncInvokeEx (c_api.h:383-497)
+ *     The deprecated pre-NNVM "Function" registry tier. The reference
+ *     itself superseded it with the atomic-symbol/imperative-invoke
+ *     path; this build has ONE op registry surfaced through
+ *     MXTpuListAllOpNames/MXTpuImperativeInvoke, so a second legacy
+ *     enumeration of the same ops would be dead weight.
+ *
+ *   MXKVStoreSendCommmandToServers (c_api.h:1383)  [sic]
+ *     Ships a pickled optimizer to parameter-server processes. There
+ *     are NO server processes in the TPU design — the optimizer runs
+ *     in the fused step on every worker (sync) or in the co-hosted
+ *     async server thread (kvstore_async.py), both configured
+ *     in-process; a cross-process command channel has nothing to
+ *     command.
+ *
+ *   MXRecordIOWriterTell / MXRecordIOReaderSeek  and the cython
+ *     MXNDArray* duplicates of ctypes entry points are subsumed by
+ *     the Python recordio/ndarray layers (native/recordio_core.cc
+ *     carries the IO hot path).
+ * ------------------------------------------------------------------ */
+
 #ifdef __cplusplus
 }
 #endif

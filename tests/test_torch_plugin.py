@@ -85,8 +85,10 @@ def test_torch_module_trains_with_mx_optimizer():
     assert acc > 0.9, acc
 
 
-def test_caffe_op_gated():
-    """Without runtime caffe the bridge raises a pointer to the
-    offline converter instead of a bare ImportError."""
-    with pytest.raises(mx.base.MXNetError, match="caffe_converter"):
-        tb.register_caffe_op("c1", "layer {}")
+def test_caffe_op_unsupported_type_gated():
+    """A caffe layer type with no numpy implementation (and no
+    pycaffe) raises with protocol guidance, not a bare ImportError.
+    The real runtime bridge lives in tests/test_caffe_plugin.py."""
+    with pytest.raises(mx.base.MXNetError, match="protocol"):
+        tb.register_caffe_op(
+            "c1", 'layer { name: "l" type: "LRN" }')

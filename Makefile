@@ -9,7 +9,7 @@ PY      ?= python
 CPUENV  := JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS=
 XLA8    := XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all test nightly examples lint libs predict docs dryrun clean
+.PHONY: all test nightly examples lint libs predict perl docs dryrun clean
 
 all: libs test
 
@@ -49,6 +49,10 @@ libs:
 # amalgamated single-file predict bundle -> build/
 predict:
 	$(CPUENV) $(PY) tools/amalgamation.py --out build
+
+# perl XS binding over the predict C ABI (compiled-and-run smoke)
+perl:
+	$(CPUENV) $(PY) -m pytest tests/test_perl_binding.py -q
 
 docs:
 	$(CPUENV) $(PY) tools/gen_env_docs.py

@@ -18,6 +18,8 @@
 
 #include <Python.h>
 
+#include <dlfcn.h>
+
 #include <cstring>
 #include <mutex>
 #include <string>
@@ -31,6 +33,20 @@ std::string g_last_error;
 void EnsurePython() {
   std::call_once(g_init_once, [] {
     if (!Py_IsInitialized()) {
+      // When this library is itself dlopen'd RTLD_LOCAL (perl XS,
+      // lua/ruby FFI, dlopen-based C hosts), libpython's symbols are
+      // not in the global namespace — and every python C-extension
+      // (math, numpy, ...) expects them there. Re-open libpython
+      // RTLD_GLOBAL|RTLD_NOLOAD to promote the already-mapped
+      // library; a no-op when the host linked python normally.
+      char pylib[64];
+      snprintf(pylib, sizeof(pylib), "libpython%d.%d.so.1.0",
+               PY_MAJOR_VERSION, PY_MINOR_VERSION);
+      if (!dlopen(pylib, RTLD_GLOBAL | RTLD_NOW | RTLD_NOLOAD)) {
+        snprintf(pylib, sizeof(pylib), "libpython%d.%d.so",
+                 PY_MAJOR_VERSION, PY_MINOR_VERSION);
+        dlopen(pylib, RTLD_GLOBAL | RTLD_NOW);
+      }
       Py_InitializeEx(0);
       // release the GIL acquired by initialization so callers on any
       // thread can take it with PyGILState_Ensure

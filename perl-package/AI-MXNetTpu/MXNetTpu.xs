@@ -1,0 +1,229 @@
+/* XS glue for AI::MXNetTpu — wraps the predict-only slice of
+ * native/mxnet_tpu_c_api.h (the reference's c_predict_api.h surface
+ * that AI::MXNet's perl bindings consumed). Pure marshalling: perl
+ * arrays <-> C buffers; all compute stays behind the C ABI. */
+#define PERL_NO_GET_CONTEXT
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include <stdlib.h>
+#include <string.h>
+
+/* single source of truth for the ABI — signature drift becomes a
+ * compile error (Makefile.PL passes INC => -I<native>) */
+#include "mxnet_tpu_c_api.h"
+
+static void croak_last(pTHX_ const char* what) {
+  const char* err = MXTpuGetLastError();
+  croak("%s: %s", what, err ? err : "(no error message)");
+}
+
+MODULE = AI::MXNetTpu  PACKAGE = AI::MXNetTpu
+
+PROTOTYPES: DISABLE
+
+SV*
+_last_error()
+  CODE:
+    RETVAL = newSVpv(MXTpuGetLastError(), 0);
+  OUTPUT:
+    RETVAL
+
+IV
+_create(sym_sv, params_sv, keys_av, shapes_av)
+    SV* sym_sv
+    SV* params_sv
+    AV* keys_av
+    AV* shapes_av
+  PREINIT:
+    STRLEN sym_len, par_len;
+    const char* sym;
+    const char* par;
+    int n, i;
+    const char** keys;
+    unsigned* shape_ind;
+    unsigned* shape_data;
+    int total, pos;
+    void* handle;
+  CODE:
+    sym = SvPV(sym_sv, sym_len);
+    par = SvPV(params_sv, par_len);
+    n = (int)(av_len(keys_av) + 1);
+    if ((int)(av_len(shapes_av) + 1) != n)
+      croak("keys and shapes must have equal length");
+    keys = (const char**)malloc(n * sizeof(char*));
+    shape_ind = (unsigned*)malloc((n + 1) * sizeof(unsigned));
+    total = 0;
+    for (i = 0; i < n; ++i) {
+      SV** s = av_fetch(shapes_av, i, 0);
+      AV* shp;
+      if (s == NULL || !SvROK(*s)
+          || SvTYPE(SvRV(*s)) != SVt_PVAV) {
+        free(keys); free(shape_ind);
+        croak("shape %d must be an ARRAY ref of dims", i);
+      }
+      shp = (AV*)SvRV(*s);
+      total += (int)(av_len(shp) + 1);
+    }
+    shape_data = (unsigned*)malloc(
+        (total > 0 ? total : 1) * sizeof(unsigned));
+    pos = 0;
+    for (i = 0; i < n; ++i) {
+      SV** k = av_fetch(keys_av, i, 0);
+      SV** s = av_fetch(shapes_av, i, 0);
+      AV* shp = (AV*)SvRV(*s);
+      int nd = (int)(av_len(shp) + 1), d;
+      if (k == NULL) {
+        free(keys); free(shape_ind); free(shape_data);
+        croak("key %d is missing", i);
+      }
+      keys[i] = SvPV_nolen(*k);
+      shape_ind[i] = (unsigned)pos;
+      for (d = 0; d < nd; ++d)
+        shape_data[pos++] = (unsigned)SvUV(*av_fetch(shp, d, 0));
+    }
+    shape_ind[n] = (unsigned)pos;
+    handle = NULL;
+    if (MXTpuPredCreate(sym, par, (int)par_len, n, keys, shape_ind,
+                        shape_data, &handle) != 0) {
+      free(keys); free(shape_ind); free(shape_data);
+      croak_last(aTHX_ "MXTpuPredCreate");
+    }
+    free(keys); free(shape_ind); free(shape_data);
+    RETVAL = PTR2IV(handle);
+  OUTPUT:
+    RETVAL
+
+void
+_set_input(h, key, data_av)
+    IV h
+    const char* key
+    AV* data_av
+  PREINIT:
+    int n, i;
+    float* buf;
+  CODE:
+    n = (int)(av_len(data_av) + 1);
+    buf = (float*)malloc((n > 0 ? n : 1) * sizeof(float));
+    for (i = 0; i < n; ++i)
+      buf[i] = (float)SvNV(*av_fetch(data_av, i, 0));
+    if (MXTpuPredSetInput(INT2PTR(void*, h), key, buf, n) != 0) {
+      free(buf);
+      croak_last(aTHX_ "MXTpuPredSetInput");
+    }
+    free(buf);
+
+void
+_forward(h)
+    IV h
+  CODE:
+    if (MXTpuPredForward(INT2PTR(void*, h)) != 0)
+      croak_last(aTHX_ "MXTpuPredForward");
+
+SV*
+_get_output_shape(h, index)
+    IV h
+    int index
+  PREINIT:
+    unsigned dims[16];
+    int nd, d;
+    AV* av;
+  CODE:
+    nd = MXTpuPredGetOutputShape(INT2PTR(void*, h), index, dims, 16);
+    if (nd < 0)
+      croak_last(aTHX_ "MXTpuPredGetOutputShape");
+    if (nd > 16)  /* full ndim is returned even when it exceeds cap */
+      croak("output ndim %d exceeds binding limit 16", nd);
+    av = newAV();
+    for (d = 0; d < nd; ++d)
+      av_push(av, newSVuv(dims[d]));
+    RETVAL = newRV_noinc((SV*)av);
+  OUTPUT:
+    RETVAL
+
+SV*
+_get_output(h, index)
+    IV h
+    int index
+  PREINIT:
+    unsigned dims[16];
+    int nd, d, total, i, n;
+    float* buf;
+    AV* av;
+  CODE:
+    nd = MXTpuPredGetOutputShape(INT2PTR(void*, h), index, dims, 16);
+    if (nd < 0)
+      croak_last(aTHX_ "MXTpuPredGetOutputShape");
+    if (nd > 16)
+      croak("output ndim %d exceeds binding limit 16", nd);
+    total = 1;
+    for (d = 0; d < nd; ++d) total *= (int)dims[d];
+    buf = (float*)malloc((total > 0 ? total : 1) * sizeof(float));
+    n = MXTpuPredGetOutput(INT2PTR(void*, h), index, buf, total);
+    if (n < 0) {
+      free(buf);
+      croak_last(aTHX_ "MXTpuPredGetOutput");
+    }
+    av = newAV();
+    for (i = 0; i < n; ++i)
+      av_push(av, newSVnv((NV)buf[i]));
+    free(buf);
+    RETVAL = newRV_noinc((SV*)av);
+  OUTPUT:
+    RETVAL
+
+void
+_free(h)
+    IV h
+  CODE:
+    MXTpuPredFree(INT2PTR(void*, h));
+
+SV*
+_ndlist(params_sv)
+    SV* params_sv
+  PREINIT:
+    STRLEN par_len;
+    const char* par;
+    void* handle;
+    int len, i;
+    HV* hv;
+  CODE:
+    par = SvPV(params_sv, par_len);
+    handle = NULL;
+    len = 0;
+    if (MXTpuNDListCreate(par, (int)par_len, &handle, &len) != 0)
+      croak_last(aTHX_ "MXTpuNDListCreate");
+    hv = newHV();
+    for (i = 0; i < len; ++i) {
+      const char* key = NULL;
+      const float* data = NULL;
+      const unsigned* shape = NULL;
+      unsigned ndim = 0, d;
+      int total = 1, p;
+      AV* shp;
+      AV* dat;
+      HV* ent;
+      if (MXTpuNDListGet(handle, i, &key, &data, &shape, &ndim)
+          != 0) {
+        MXTpuNDListFree(handle);
+        croak_last(aTHX_ "MXTpuNDListGet");
+      }
+      shp = newAV();
+      for (d = 0; d < ndim; ++d) {
+        av_push(shp, newSVuv(shape[d]));
+        total *= (int)shape[d];
+      }
+      dat = newAV();
+      for (p = 0; p < total; ++p)
+        av_push(dat, newSVnv((NV)data[p]));
+      ent = newHV();
+      (void)hv_store(ent, "shape", 5, newRV_noinc((SV*)shp), 0);
+      (void)hv_store(ent, "data", 4, newRV_noinc((SV*)dat), 0);
+      (void)hv_store(hv, key, (I32)strlen(key),
+                     newRV_noinc((SV*)ent), 0);
+    }
+    MXTpuNDListFree(handle);
+    RETVAL = newRV_noinc((SV*)hv);
+  OUTPUT:
+    RETVAL

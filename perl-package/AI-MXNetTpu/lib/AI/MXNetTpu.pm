@@ -1,0 +1,84 @@
+package AI::MXNetTpu;
+# Predict-only perl binding over the mxnet_tpu C ABI — the smallest
+# honest slice of the reference's AI::MXNet perl-package (95 files
+# over the same C API): load a trained checkpoint, run inference, and
+# read parameter blobs, from perl. Training stays in python.
+#
+#   use AI::MXNetTpu;
+#   my $pred = AI::MXNetTpu::Predictor->new(
+#       symbol => $symbol_json, params => $param_blob,
+#       shapes => { data => [4, 6] });
+#   $pred->set_input(data => \@values);
+#   $pred->forward;
+#   my $out   = $pred->get_output(0);        # flat arrayref of floats
+#   my $shape = $pred->get_output_shape(0);  # arrayref of dims
+#
+#   my $nd = AI::MXNetTpu::ndlist($param_blob);
+#   # { 'arg:fc_weight' => { shape => [...], data => [...] }, ... }
+use strict;
+use warnings;
+
+our $VERSION = '0.01';
+
+# load the XS module RTLD_GLOBAL so the embedded python interpreter
+# inside libmxtpu_predict.so can satisfy C-extension imports
+sub dl_load_flags { 0x01 }
+
+require DynaLoader;
+our @ISA = ('DynaLoader');
+__PACKAGE__->bootstrap($VERSION);
+
+sub ndlist {
+    my ($blob) = @_;
+    return _ndlist($blob);
+}
+
+package AI::MXNetTpu::Predictor;
+use strict;
+use warnings;
+use Carp qw(croak);
+
+sub new {
+    my ($class, %args) = @_;
+    for my $req (qw(symbol params shapes)) {
+        croak "Predictor->new needs '$req'" unless defined $args{$req};
+    }
+    my (@keys, @shapes);
+    for my $k (sort keys %{ $args{shapes} }) {
+        push @keys, $k;
+        push @shapes, $args{shapes}{$k};
+    }
+    my $h = AI::MXNetTpu::_create(
+        $args{symbol}, $args{params}, \@keys, \@shapes);
+    return bless { h => $h }, $class;
+}
+
+sub set_input {
+    my ($self, $key, $data) = @_;
+    AI::MXNetTpu::_set_input($self->{h}, $key, $data);
+    return $self;
+}
+
+sub forward {
+    my ($self) = @_;
+    AI::MXNetTpu::_forward($self->{h});
+    return $self;
+}
+
+sub get_output {
+    my ($self, $index) = @_;
+    return AI::MXNetTpu::_get_output($self->{h}, $index // 0);
+}
+
+sub get_output_shape {
+    my ($self, $index) = @_;
+    return AI::MXNetTpu::_get_output_shape($self->{h}, $index // 0);
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    AI::MXNetTpu::_free($self->{h}) if $self->{h};
+    $self->{h} = 0;
+}
+
+1;

@@ -1,0 +1,38 @@
+#!/usr/bin/perl
+# Smoke: load a checkpoint (paths from env), predict, print outputs.
+# Driven by tests/test_perl_binding.py, which compares against the
+# python predictor; standalone it just checks the plumbing.
+use strict;
+use warnings;
+use Test::More;
+
+use_ok('AI::MXNetTpu');
+
+my ($symf, $parf) = ($ENV{MXTPU_SYMBOL}, $ENV{MXTPU_PARAMS});
+if (!$symf || !$parf) {
+    done_testing();
+    exit 0;
+}
+
+local $/;  # slurp
+open my $sf, '<', $symf or die "open $symf: $!";
+my $symbol = <$sf>;
+open my $pf, '<:raw', $parf or die "open $parf: $!";
+my $params = <$pf>;
+
+my $nd = AI::MXNetTpu::ndlist($params);
+ok(scalar(keys %$nd) > 0, 'ndlist reads parameter blob');
+
+my $pred = AI::MXNetTpu::Predictor->new(
+    symbol => $symbol, params => $params,
+    shapes => { data => [4, 6] });
+my @x = map { $_ / 24.0 } 0 .. 23;
+$pred->set_input(data => \@x);
+$pred->forward;
+my $shape = $pred->get_output_shape(0);
+my $out = $pred->get_output(0);
+is_deeply($shape, [4, 2], 'output shape');
+is(scalar(@$out), 8, 'output size');
+print "PERL_OUT " . join(",", map { sprintf("%.6f", $_) } @$out)
+    . "\n";
+done_testing();

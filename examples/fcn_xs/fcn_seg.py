@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""FCN-xs semantic segmentation (reference example/fcn-xs/
+symbol_fcnxs.py, Long et al. 2015): a fully-convolutional net whose
+decoder is learned Deconvolution upsampling fused with a skip
+connection from a shallower stride — the FCN-16s pattern at toy
+scale. Exercises the deconv/upsampling + Crop path the classifier
+examples never touch.
+
+Synthetic task: --side sized images (default 32x32) with a bright
+square and a dark disk on a noisy background; per-pixel 3-class
+labels (background / square / disk). Gates: pixel accuracy ABOVE the
+majority-class baseline, and per-class recall (the background class
+alone cannot pass).
+
+  python examples/fcn_xs/fcn_seg.py --epochs 8
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+)
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def make_data(n, side, rs):
+    """Images (n,3,side,side) + per-pixel labels (n,side,side)."""
+    x = rs.normal(0.0, 0.15, (n, 3, side, side)).astype(np.float32)
+    y = np.zeros((n, side, side), np.int32)
+    yy, xx = np.mgrid[0:side, 0:side]
+    for i in range(n):
+        # square (class 1)
+        s = rs.randint(side // 5, side // 3)
+        x0 = rs.randint(0, side - s)
+        y0 = rs.randint(0, side - s)
+        x[i, :, y0:y0 + s, x0:x0 + s] += 1.0
+        y[i, y0:y0 + s, x0:x0 + s] = 1
+        # disk (class 2) — may overlap; disk wins
+        r = rs.randint(side // 8, side // 5)
+        cx = rs.randint(r, side - r)
+        cy = rs.randint(r, side - r)
+        mask = (yy - cy) ** 2 + (xx - cx) ** 2 <= r * r
+        for c in range(3):
+            x[i, c][mask] -= 1.0
+        y[i][mask] = 2
+    return x, y.astype(np.float32)  # (n, side, side)
+
+
+def fcn_symbol(num_classes=3):
+    """conv(s2) -> conv(s2) -> 1x1 score  ==deconv x2==> fuse with the
+    stride-2 skip score ==deconv x2==> full-res pixel softmax (the
+    reference's fcnxs score + bigscore + crop arrangement)."""
+    data = mx.sym.Variable("data")
+    c1 = mx.sym.Activation(mx.sym.Convolution(
+        data, num_filter=16, kernel=(5, 5), stride=(2, 2),
+        pad=(2, 2), name="conv1"), act_type="relu")
+    c2 = mx.sym.Activation(mx.sym.Convolution(
+        c1, num_filter=32, kernel=(3, 3), stride=(2, 2),
+        pad=(1, 1), name="conv2"), act_type="relu")
+    score4 = mx.sym.Convolution(
+        c2, num_filter=num_classes, kernel=(1, 1), name="score4")
+    up2 = mx.sym.Deconvolution(
+        score4, num_filter=num_classes, kernel=(4, 4), stride=(2, 2),
+        pad=(1, 1), name="up2")  # /4 -> /2
+    skip2 = mx.sym.Convolution(
+        c1, num_filter=num_classes, kernel=(1, 1), name="score2")
+    fused = mx.sym.Crop(up2, skip2, name="crop2") + skip2
+    up1 = mx.sym.Deconvolution(
+        fused, num_filter=num_classes, kernel=(4, 4), stride=(2, 2),
+        pad=(1, 1), name="up1")  # /2 -> full
+    up1 = mx.sym.Crop(up1, data, name="crop1")
+    return mx.sym.SoftmaxOutput(
+        up1, multi_output=True, use_ignore=False, name="softmax")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=20)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--side", type=int, default=32)
+    ap.add_argument("--num-images", type=int, default=64)
+    ap.add_argument("--min-acc", type=float, default=0.95)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rs = np.random.RandomState(0)
+    X, Y = make_data(args.num_images, args.side, rs)
+    it = mx.io.NDArrayIter(X, Y, batch_size=args.batch_size,
+                           shuffle=True, label_name="softmax_label")
+
+    np.random.seed(1)
+    mod = mx.mod.Module(fcn_symbol(), context=mx.cpu())
+    # softmax grads SUM over pixels: normalize per pixel, not per
+    # image, or the effective step is H*W times too large and the
+    # model collapses to the background class
+    npix = args.side * args.side
+    mod.fit(it, num_epoch=args.epochs, optimizer="sgd",
+            optimizer_params={
+                "learning_rate": 0.3, "momentum": 0.9,
+                "rescale_grad": 1.0 / (args.batch_size * npix)})
+
+    # pixel accuracy + per-class recall over the training set
+    it.reset()
+    preds, labs = [], []
+    for batch in it:
+        mod.forward(batch, is_train=False)
+        prob = mod.get_outputs()[0].asnumpy()  # (B, C, H, W)
+        n = prob.shape[0] - batch.pad
+        preds.append(prob.argmax(axis=1)[:n])
+        labs.append(batch.label[0].asnumpy().astype(np.int64)[:n])
+    pred = np.concatenate(preds)
+    lab = np.concatenate(labs)
+    acc = (pred == lab).mean()
+    recall = [(pred[lab == c] == c).mean() for c in range(3)]
+    baseline = max((lab == c).mean() for c in range(3))
+    print(f"pixel accuracy {acc:.3f} (majority baseline "
+          f"{baseline:.3f}); per-class recall "
+          f"{[round(float(r), 3) for r in recall]}")
+    assert acc > args.min_acc, f"pixel acc {acc:.3f} <= {args.min_acc}"
+    assert acc > baseline, "did not beat the majority-class baseline"
+    for c, r in enumerate(recall):
+        assert r > 0.6, f"class {c} recall {r:.3f} <= 0.6"
+    print("fcn_seg OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""REINFORCE policy gradient (reference example/reinforcement-learning/
+parallel_actor_critic/ family): a softmax policy trained with the
+IMPERATIVE NDArray + autograd path — no Symbol, no Module — the
+contrib.autograd workflow (mark_variables / train_section /
+compute_gradient, reference python/mxnet/contrib/autograd.py).
+
+Environment: self-contained CartPole (the classic Barto-Sutton
+dynamics in numpy, no gym dependency). Rollouts run in numpy with the
+current weights; the policy-gradient step replays the visited states
+through mx.nd ops under autograd and ascends
+E[log pi(a|s) * advantage].
+
+Gate: mean episode length over the last batches must clear
+--min-length (random policy scores ~20).
+
+  python examples/reinforcement_learning/reinforce_cartpole.py
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+)
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+
+
+class CartPole(object):
+    """Classic cart-pole balancing dynamics (Barto et al. 1983)."""
+
+    GRAV, MCART, MPOLE, LEN, DT = 9.8, 1.0, 0.1, 0.5, 0.02
+    XLIM, THLIM = 2.4, 12 * np.pi / 180
+
+    def __init__(self, rs):
+        self.rs = rs
+        self.reset()
+
+    def reset(self):
+        self.s = self.rs.uniform(-0.05, 0.05, 4)
+        return self.s.copy()
+
+    def step(self, action):
+        x, xd, th, thd = self.s
+        force = 10.0 if action == 1 else -10.0
+        mtot = self.MCART + self.MPOLE
+        mpl = self.MPOLE * self.LEN
+        cth, sth = np.cos(th), np.sin(th)
+        tmp = (force + mpl * thd ** 2 * sth) / mtot
+        thacc = (self.GRAV * sth - cth * tmp) / (
+            self.LEN * (4.0 / 3.0 - self.MPOLE * cth ** 2 / mtot))
+        xacc = tmp - mpl * thacc * cth / mtot
+        self.s = np.array([x + self.DT * xd, xd + self.DT * xacc,
+                           th + self.DT * thd, thd + self.DT * thacc])
+        done = (abs(self.s[0]) > self.XLIM
+                or abs(self.s[2]) > self.THLIM)
+        return self.s.copy(), 1.0, done
+
+
+def rollout(env, w, max_steps, rs):
+    """One episode with numpy forward of the current policy."""
+    states, actions = [], []
+    s = env.reset()
+    for _ in range(max_steps):
+        h = np.tanh(s @ w["w1"] + w["b1"])
+        logits = h @ w["w2"] + w["b2"]
+        z = logits - logits.max()
+        p = np.exp(z) / np.exp(z).sum()
+        a = int(rs.random() < p[1])
+        states.append(s)
+        actions.append(a)
+        s, _, done = env.step(a)
+        if done:
+            break
+    return np.asarray(states, np.float32), \
+        np.asarray(actions, np.int64)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batches", type=int, default=120)
+    ap.add_argument("--episodes-per-batch", type=int, default=16)
+    ap.add_argument("--max-steps", type=int, default=200)
+    ap.add_argument("--lr", type=float, default=0.5)
+    ap.add_argument("--gamma", type=float, default=1.0)
+    ap.add_argument("--min-length", type=float, default=80.0)
+    args = ap.parse_args()
+
+    rs = np.random.RandomState(0)
+    mx.random.seed(0)
+    nh = 16
+    params = {
+        "w1": mx.nd.array(rs.normal(0, 0.1, (4, nh))),
+        "b1": mx.nd.zeros((nh,)),
+        "w2": mx.nd.array(rs.normal(0, 0.1, (nh, 2))),
+        "b2": mx.nd.zeros((2,)),
+    }
+    grads = {k: mx.nd.zeros(v.shape) for k, v in params.items()}
+    ag.mark_variables(list(params.values()), list(grads.values()))
+    env = CartPole(rs)
+    history = []
+
+    for it in range(args.batches):
+        # numpy rollouts under the current weights
+        w = {k: v.asnumpy() for k, v in params.items()}
+        batch_s, batch_a, batch_adv, lens = [], [], [], []
+        for _ in range(args.episodes_per_batch):
+            S, A = rollout(env, w, args.max_steps, rs)
+            T = len(A)
+            G = np.zeros(T, np.float32)
+            run = 0.0
+            for t in reversed(range(T)):
+                run = 1.0 + args.gamma * run
+                G[t] = run
+            batch_s.append(S)
+            batch_a.append(A)
+            batch_adv.append(G)
+            lens.append(T)
+        S = np.concatenate(batch_s)
+        A = np.concatenate(batch_a)
+        adv = np.concatenate(batch_adv)
+        adv = (adv - adv.mean()) / (adv.std() + 1e-6)
+        history.append(np.mean(lens))
+
+        # policy-gradient step: replay through nd ops on the tape
+        sa = mx.nd.array(S)
+        with ag.train_section():
+            h = mx.nd.tanh(
+                mx.nd.dot(sa, params["w1"]) + params["b1"])
+            logits = mx.nd.dot(h, params["w2"]) + params["b2"]
+            logp = mx.nd.log_softmax(logits, axis=-1)
+            chosen = mx.nd.pick(logp, mx.nd.array(A), axis=-1)
+            loss = -mx.nd.mean(chosen * mx.nd.array(adv))
+        ag.compute_gradient([loss])
+        for k in params:
+            params[k] -= args.lr * grads[k]
+
+    tail = float(np.mean(history[-3:]))
+    print(f"mean episode length: first 3 batches "
+          f"{np.mean(history[:3]):.1f} -> last 3 {tail:.1f}")
+    assert tail > args.min_length, (
+        f"policy did not learn: tail mean {tail:.1f} <= "
+        f"{args.min_length}")
+    print("reinforce_cartpole OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -44,6 +44,10 @@ EXAMPLES = {
     "bi_lstm_sort/sort_lstm.py": ["--epochs", "8"],
     "model_parallel/lstm_layers.py": ["--epochs", "6"],
     "autoencoder/ae_mnist.py": [],
+    "fcn_xs/fcn_seg.py": ["--epochs", "20", "--min-acc", "0.95"],
+    "bayesian_methods/sgld_regression.py": [],
+    "reinforcement_learning/reinforce_cartpole.py": [
+        "--batches", "60", "--min-length", "40"],
 }
 
 

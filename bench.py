@@ -202,11 +202,19 @@ def main():
             n=max(2048, batch), classes=classes)
         from mxnet_tpu.image import ImageRecordIter
 
+        # BENCH_U8=1: uint8 raw-pixel batches (reference
+        # ImageRecordIter2's uint8 registration) — 1/4 the
+        # host->device bytes; the graph's bn_data BatchNorm
+        # normalizes on device and the fused step promotes the u8
+        # input to the compute dtype there.
+        u8 = os.environ.get("BENCH_U8", "0") == "1"
+        norm = {} if u8 else dict(
+            mean_r=123.68, mean_g=116.28, mean_b=103.53,
+            std_r=58.395, std_g=57.12, std_b=57.375)
         rec_it = ImageRecordIter(
             path_imgrec=rec_path, batch_size=batch, data_shape=image,
             rand_crop=True, rand_mirror=True,
-            mean_r=123.68, mean_g=116.28, mean_b=103.53,
-            std_r=58.395, std_g=57.12, std_b=57.375,
+            dtype="uint8" if u8 else "float32", **norm,
             preprocess_threads=int(
                 os.environ.get("BENCH_DATA_THREADS", "8")),
             data_layout=layout)

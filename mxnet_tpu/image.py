@@ -328,8 +328,22 @@ class ImageIter(_io.DataIter):
                  path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
                  aug_list=None, imglist=None, data_name="data",
                  label_name="softmax_label", preprocess_threads=4,
-                 data_layout="NCHW", **kwargs):
+                 data_layout="NCHW", dtype="float32", **kwargs):
         super().__init__(batch_size)
+        # uint8 batches carry RAW pixels (reference ImageRecordIter2's
+        # uint8 registration, iter_image_recordio_2.cc:579): 1/4 the
+        # host->device bytes; normalization then runs on device (the
+        # fused step promotes unsigned data to the compute dtype)
+        self.dtype = np.dtype(dtype)
+        if self.dtype not in (np.dtype(np.float32), np.dtype(np.uint8)):
+            raise MXNetError(
+                f"dtype must be float32 or uint8, got {dtype!r}")
+        if self.dtype == np.uint8 and (
+                kwargs.get("mean") is not None
+                or kwargs.get("std") is not None):
+            raise MXNetError(
+                "dtype='uint8' carries raw pixels; drop mean/std and "
+                "normalize on device")
         # NHWC emits channel-last batches directly (TPU-native layout;
         # the native decoder writes either layout at identical cost)
         self.data_layout = data_layout.upper()
@@ -399,8 +413,8 @@ class ImageIter(_io.DataIter):
         c_, h_, w_ = data_shape
         out_shape = (c_, h_, w_) if self.data_layout == "NCHW" \
             else (h_, w_, c_)
-        self.provide_data = [_io.DataDesc(data_name,
-                                          (batch_size,) + out_shape)]
+        self.provide_data = [_io.DataDesc(
+            data_name, (batch_size,) + out_shape, dtype=self.dtype)]
         if label_width > 1:
             self.provide_label = [
                 _io.DataDesc(label_name, (batch_size, label_width))]
@@ -538,7 +552,16 @@ class ImageIter(_io.DataIter):
         else:
             batch_label[i] = lab.reshape(-1)[: self.label_width]
 
+    def _coerce_pixels(self, img):
+        """Augmented float pixels -> the batch dtype. uint8 batches
+        need explicit round+clip: a bare cast truncates and WRAPS
+        out-of-range values (LightingAug output is unclipped)."""
+        if self.dtype == np.uint8 and img.dtype != np.uint8:
+            return np.clip(np.round(img), 0, 255)
+        return img
+
     def _write_sample(self, batch_data, batch_label, i, img, label):
+        img = self._coerce_pixels(img)
         batch_data[i] = img.transpose(2, 0, 1) \
             if self.data_layout == "NCHW" else img
         self._write_label(batch_label, i, label)
@@ -551,7 +574,7 @@ class ImageIter(_io.DataIter):
         Non-JPEG/corrupt records fall back to the python decoder
         per-image."""
         batch_size = self.batch_size
-        batch_data = np.zeros(self._batch_shape(), dtype=np.float32)
+        batch_data = np.zeros(self._batch_shape(), dtype=self.dtype)
         batch_label = np.zeros(
             (batch_size,) if self.label_width == 1
             else (batch_size, self.label_width), dtype=np.float32)
@@ -578,8 +601,9 @@ class ImageIter(_io.DataIter):
                     if not imgs:
                         logging.debug("Invalid image, skipping.")
                         continue
-                    out_view[j] = imgs[0].transpose(2, 0, 1) \
-                        if self.data_layout == "NCHW" else imgs[0]
+                    img0 = self._coerce_pixels(imgs[0])
+                    out_view[j] = img0.transpose(2, 0, 1) \
+                        if self.data_layout == "NCHW" else img0
                 valid.append(j)
             for dst, j in enumerate(valid):
                 if dst != j:
@@ -602,7 +626,7 @@ class ImageIter(_io.DataIter):
         if self._native_dec is not None:
             return self._next_native()
         batch_size = self.batch_size
-        batch_data = np.zeros(self._batch_shape(), dtype=np.float32)
+        batch_data = np.zeros(self._batch_shape(), dtype=self.dtype)
         batch_label = np.zeros(
             (batch_size,) if self.label_width == 1
             else (batch_size, self.label_width), dtype=np.float32)
@@ -671,7 +695,7 @@ def ImageRecordIter(path_imgrec=None, data_shape=(3, 224, 224),
                     rand_crop=False, rand_mirror=False, path_imgidx=None,
                     preprocess_threads=4, prefetch_buffer=4,
                     part_index=0, num_parts=1, label_width=1,
-                    data_layout="NCHW", **kwargs):
+                    data_layout="NCHW", dtype="float32", **kwargs):
     """Compatibility constructor matching the C++ ImageRecordIter params
     (src/io/iter_image_recordio_2.cc:559 registration), returning an
     ImageIter wrapped in a PrefetchingIter (the analog of the fused
@@ -688,6 +712,6 @@ def ImageRecordIter(path_imgrec=None, data_shape=(3, 224, 224),
         rand_crop=rand_crop, rand_mirror=rand_mirror, mean=mean, std=std,
         part_index=part_index, num_parts=num_parts,
         label_width=label_width, preprocess_threads=preprocess_threads,
-        data_layout=data_layout,
+        data_layout=data_layout, dtype=dtype,
     )
     return _io.PrefetchingIter(it)

@@ -158,6 +158,14 @@ def get_lib_imgdec():
                                               # saturation/pca_noise
             + lib.imgdec_batch.argtypes[-2:]
         )
+        lib.imgdec_batch_u8.restype = None
+        # same as the aug entry minus mean/std, uint8 output
+        lib.imgdec_batch_u8.argtypes = (
+            lib.imgdec_batch.argtypes[:-4]
+            + [ctypes.c_float] * 4
+            + [ctypes.POINTER(ctypes.c_uint8),
+               ctypes.POINTER(ctypes.c_uint8)]
+        )
         _imgdec_lib = lib
         return _imgdec_lib
 
@@ -211,7 +219,12 @@ class NativeImageDecoder(object):
             h, w, c = out.shape[1], out.shape[2], out.shape[3]
         else:
             c, h, w = out.shape[1], out.shape[2], out.shape[3]
-        assert c == 3 and out.dtype == np.float32
+        assert c == 3 and out.dtype in (np.float32, np.uint8)
+        if out.dtype == np.uint8 and (
+                self._mean is not None or self._std is not None):
+            raise ValueError(
+                "uint8 output carries raw pixels; normalize on device "
+                "(drop mean/std or use a float32 output)")
         blob = np.frombuffer(b"".join(blobs), dtype=np.uint8)
         lens = np.asarray([len(b) for b in blobs], np.int64)
         offs = np.zeros(n, np.int64)
@@ -233,9 +246,17 @@ class NativeImageDecoder(object):
             self._std.ctypes.data_as(fptr)
             if self._std is not None else None,
         ]
+        u8ptr = ctypes.POINTER(ctypes.c_uint8)
+        if out.dtype == np.uint8:
+            # common minus the mean/std pointers (u8 never normalizes)
+            self._lib.imgdec_batch_u8(
+                *common[:-2], self.brightness, self.contrast,
+                self.saturation, self.pca_noise,
+                out.ctypes.data_as(u8ptr), ok.ctypes.data_as(u8ptr))
+            return ok
         tail = [
             out.ctypes.data_as(fptr),
-            ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            ok.ctypes.data_as(u8ptr),
         ]
         if self.brightness or self.contrast or self.saturation \
                 or self.pca_noise:

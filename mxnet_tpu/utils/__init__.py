@@ -77,6 +77,13 @@ register_env(
     "graph_executor.cc:678); kept for CLI compat",
 )
 register_env(
+    "MXNET_TPU_OPT_STATE_DTYPE", str, "",
+    "dtype for optimizer state (momentum/moments) in the fused train "
+    "step, e.g. 'bfloat16': halves optimizer-update HBM traffic; "
+    "update math still runs in f32 and rounds back on store "
+    "(parallel/dp_step.py). Empty = weight dtype.",
+)
+register_env(
     "MXNET_ENABLE_GPU_P2P", bool, True,
     "unused on TPU (ICI is always peer-to-peer); kept for CLI compat",
 )

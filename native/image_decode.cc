@@ -156,6 +156,12 @@ struct Job {
   float saturation = 0.f;
   float pca_noise = 0.f;
   float* out = nullptr;         // (n, 3, out_h, out_w) or (n,H,W,3)
+  uint8_t* out_u8 = nullptr;    // uint8 variant (reference
+                                // ImageRecordIter2 uint8 registration,
+                                // iter_image_recordio_2.cc:579): raw
+                                // pixels, no normalize — host->device
+                                // transfer is 4x smaller, normalize
+                                // runs on device
   uint8_t* ok = nullptr;        // per-image success
 };
 
@@ -336,7 +342,12 @@ void process_one(const Job& j, int i, std::vector<uint8_t>* scratch,
   const float s0 = j.stdv ? 1.f / j.stdv[0] : 1.f,
               s1 = j.stdv ? 1.f / j.stdv[1] : 1.f,
               s2 = j.stdv ? 1.f / j.stdv[2] : 1.f;
-  float* dst = j.out + static_cast<size_t>(i) * 3 * fh * fw;
+  float* dst = j.out
+                   ? j.out + static_cast<size_t>(i) * 3 * fh * fw
+                   : nullptr;
+  uint8_t* dst8 = j.out_u8
+                      ? j.out_u8 + static_cast<size_t>(i) * 3 * fh * fw
+                      : nullptr;
   const size_t plane = static_cast<size_t>(fh) * fw;
   // ONE copy of the mirrored-crop source addressing, shared by the
   // plain and color-augmented paths
@@ -347,13 +358,31 @@ void process_one(const Job& j, int i, std::vector<uint8_t>* scratch,
                : crop_src +
                      ((static_cast<size_t>(y0) + y) * w + x0 + sx) * 3;
   };
-  // ONE copy of the normalize + CHW/NHWC write, over any float3 getter
+  // ONE copy of the normalize + CHW/NHWC write, over any float3
+  // getter; uint8 mode writes raw pixels (mean/std forbidden by the
+  // python wrapper)
   const auto write_norm = [&](auto get3) {
     for (int y = 0; y < fh; ++y)
       for (int x = 0; x < fw; ++x) {
         float f0, f1, f2;
         get3(y, x, &f0, &f1, &f2);
         const size_t o = static_cast<size_t>(y) * fw + x;
+        if (dst8) {
+          const auto q = [](float v) -> uint8_t {
+            v = v < 0.f ? 0.f : (v > 255.f ? 255.f : v);
+            return static_cast<uint8_t>(v + 0.5f);
+          };
+          if (j.chw) {
+            dst8[o] = q(f0);
+            dst8[plane + o] = q(f1);
+            dst8[2 * plane + o] = q(f2);
+          } else {
+            dst8[3 * o] = q(f0);
+            dst8[3 * o + 1] = q(f1);
+            dst8[3 * o + 2] = q(f2);
+          }
+          continue;
+        }
         if (j.chw) {
           dst[o] = (f0 - m0) * s0;
           dst[plane + o] = (f1 - m1) * s1;
@@ -477,29 +506,14 @@ struct Pool {
   }
 };
 
-}  // namespace
-
-extern "C" {
-
-void* imgdec_create(int nthreads) {
-  return new Pool(nthreads > 0 ? nthreads : 0);
-}
-
-void imgdec_destroy(void* h) { delete static_cast<Pool*>(h); }
-
-// Decode+augment a batch of JPEG blobs into (n,3,out_h,out_w) float32.
-// ok[i]=1 per successfully decoded image (0 => caller falls back).
-// Full-recipe entry: decode + geometry augs + color jitter + PCA
-// lighting (the reference's standard ImageNet recipe,
-// image_aug_default.cc / python CreateAugmenter).
-void imgdec_batch_aug(void* h, const uint8_t* blob,
-                      const int64_t* offs, const int64_t* lens, int n,
-                      int out_h, int out_w, int resize_short,
-                      int rand_crop, int rand_mirror, int chw,
-                      uint64_t seed, const float* mean,
-                      const float* stdv, float brightness,
-                      float contrast, float saturation,
-                      float pca_noise, float* out, uint8_t* ok) {
+// shared Job fill for the three batch entries (exists exactly once)
+void run_job(void* h, const uint8_t* blob, const int64_t* offs,
+             const int64_t* lens, int n, int out_h, int out_w,
+             int resize_short, int rand_crop, int rand_mirror, int chw,
+             uint64_t seed, const float* mean, const float* stdv,
+             float brightness, float contrast, float saturation,
+             float pca_noise, float* out_f, uint8_t* out_u8,
+             uint8_t* ok) {
   Job j;
   j.blob = blob;
   j.offs = offs;
@@ -518,21 +532,65 @@ void imgdec_batch_aug(void* h, const uint8_t* blob,
   j.contrast = contrast;
   j.saturation = saturation;
   j.pca_noise = pca_noise;
-  j.out = out;
+  j.out = out_f;
+  j.out_u8 = out_u8;
   j.ok = ok;
   static_cast<Pool*>(h)->run(j);
 }
 
-// Plain entry (no color augs): forwards with zero aug params so the
-// Job fill exists exactly once.
+}  // namespace
+
+extern "C" {
+
+void* imgdec_create(int nthreads) {
+  return new Pool(nthreads > 0 ? nthreads : 0);
+}
+
+void imgdec_destroy(void* h) { delete static_cast<Pool*>(h); }
+
+// Full-recipe float32 entry: decode + geometry augs + color jitter +
+// PCA lighting + normalize (the reference's standard ImageNet recipe,
+// image_aug_default.cc / python CreateAugmenter). ok[i]=1 per decoded
+// image (0 => caller falls back).
+void imgdec_batch_aug(void* h, const uint8_t* blob,
+                      const int64_t* offs, const int64_t* lens, int n,
+                      int out_h, int out_w, int resize_short,
+                      int rand_crop, int rand_mirror, int chw,
+                      uint64_t seed, const float* mean,
+                      const float* stdv, float brightness,
+                      float contrast, float saturation,
+                      float pca_noise, float* out, uint8_t* ok) {
+  run_job(h, blob, offs, lens, n, out_h, out_w, resize_short,
+          rand_crop, rand_mirror, chw, seed, mean, stdv, brightness,
+          contrast, saturation, pca_noise, out, nullptr, ok);
+}
+
+// uint8 entry: raw pixels after decode + geometry/color augs (the
+// reference ImageRecordIter2 uint8 registration,
+// iter_image_recordio_2.cc:579): no normalize, 1/4 the host->device
+// bytes — normalization runs on device.
+void imgdec_batch_u8(void* h, const uint8_t* blob,
+                     const int64_t* offs, const int64_t* lens, int n,
+                     int out_h, int out_w, int resize_short,
+                     int rand_crop, int rand_mirror, int chw,
+                     uint64_t seed, float brightness, float contrast,
+                     float saturation, float pca_noise,
+                     unsigned char* out, uint8_t* ok) {
+  run_job(h, blob, offs, lens, n, out_h, out_w, resize_short,
+          rand_crop, rand_mirror, chw, seed, nullptr, nullptr,
+          brightness, contrast, saturation, pca_noise, nullptr, out,
+          ok);
+}
+
+// Plain float32 entry (no color augs).
 void imgdec_batch(void* h, const uint8_t* blob, const int64_t* offs,
                   const int64_t* lens, int n, int out_h, int out_w,
                   int resize_short, int rand_crop, int rand_mirror,
                   int chw, uint64_t seed, const float* mean,
                   const float* stdv, float* out, uint8_t* ok) {
-  imgdec_batch_aug(h, blob, offs, lens, n, out_h, out_w, resize_short,
-                   rand_crop, rand_mirror, chw, seed, mean, stdv, 0.f,
-                   0.f, 0.f, 0.f, out, ok);
+  run_job(h, blob, offs, lens, n, out_h, out_w, resize_short,
+          rand_crop, rand_mirror, chw, seed, mean, stdv, 0.f, 0.f,
+          0.f, 0.f, out, nullptr, ok);
 }
 
 }  // extern "C"

@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""SVM output layer (reference example/svm_mnist/svm_mnist.py): an MLP
+trained with the L2-SVM objective via mx.sym.SVMOutput instead of
+softmax cross-entropy — the margin-based head the reference
+demonstrates on MNIST.
+
+Synthetic MNIST-shaped task (4 gaussian digit prototypes + noise);
+gate: classification accuracy with BOTH the default L2-SVM and the
+use_linear=True L1-SVM variants.
+
+  python examples/svm_mnist/svm_mnist.py --epochs 10
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+)
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def make_data(rs, n=512, dim=64, classes=4):
+    protos = rs.normal(0, 1.0, (classes, dim))
+    y = rs.randint(0, classes, n)
+    x = protos[y] + rs.normal(0, 0.7, (n, dim))
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def net(classes, use_linear=False):
+    data = mx.sym.Variable("data")
+    h = mx.sym.Activation(mx.sym.FullyConnected(
+        data, num_hidden=64, name="fc1"), act_type="relu")
+    out = mx.sym.FullyConnected(h, num_hidden=classes, name="fc2")
+    return mx.sym.SVMOutput(out, margin=1.0, regularization_coefficient=0.01,
+                            use_linear=use_linear, name="svm")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--min-acc", type=float, default=0.9)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.WARNING)
+
+    rs = np.random.RandomState(0)
+    X, y = make_data(rs)
+    for use_linear in (False, True):
+        it = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True,
+                               label_name="svm_label")
+        mod = mx.mod.Module(net(4, use_linear),
+                            label_names=("svm_label",))
+        np.random.seed(1)
+        mod.fit(it, num_epoch=args.epochs, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1,
+                                  "momentum": 0.9})
+        m = mx.metric.Accuracy()
+        it.reset()
+        mod.score(it, m)
+        acc = m.get()[1]
+        kind = "L1-SVM" if use_linear else "L2-SVM"
+        print(f"{kind} accuracy {acc:.3f}")
+        assert acc > args.min_acc, f"{kind} acc {acc:.3f}"
+    print("svm_mnist OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import profiler as _profiler
 from ..base import MXNetError
 from ..ndarray import NDArray
 
@@ -354,7 +355,8 @@ class FusedTrainStep:
             self._place_data(data_vals),
             np.float32(lr), np.int32(self._t),
         )
-        with self._ambient():
+        with self._ambient(), _profiler.scope(
+                "fused_train_step", "executor"):
             if self._compiled is None:
                 try:
                     self._compiled = self._jitted.lower(*args).compile()

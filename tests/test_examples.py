@@ -48,6 +48,9 @@ EXAMPLES = {
     "bayesian_methods/sgld_regression.py": [],
     "reinforcement_learning/reinforce_cartpole.py": [
         "--batches", "60", "--min-length", "40"],
+    "svm_mnist/svm_mnist.py": ["--epochs", "10", "--min-acc", "0.9"],
+    "profiler/profile_lenet.py": [],
+    "memcost/memcost.py": [],
 }
 
 

@@ -51,6 +51,7 @@ EXAMPLES = {
     "svm_mnist/svm_mnist.py": ["--epochs", "10", "--min-acc", "0.9"],
     "profiler/profile_lenet.py": [],
     "memcost/memcost.py": [],
+    "plugins/torch_caffe_ops.py": ["--epochs", "10"],
 }
 
 
@@ -86,4 +87,6 @@ def test_every_example_is_listed():
 
 @pytest.mark.parametrize("rel", sorted(EXAMPLES))
 def test_example_runs(rel):
+    if rel.startswith("plugins/"):
+        pytest.importorskip("torch")  # repo convention for torch deps
     _run(rel, EXAMPLES[rel])

@@ -52,6 +52,7 @@ EXAMPLES = {
     "profiler/profile_lenet.py": [],
     "memcost/memcost.py": [],
     "plugins/torch_caffe_ops.py": ["--epochs", "10"],
+    "dec/dec_cluster.py": [],
 }
 
 

@@ -405,92 +405,87 @@ class DataParallelExecutorGroup(object):
                     labels_slice.append(label)
             eval_metric.update(labels_slice, texec.outputs)
 
+    def _infer_ith(self, data_shapes, label_shapes):
+        """Name-keyed shape/dtype maps for one executor's bind (the
+        reference worked in index-parallel lists; dicts keep every
+        later lookup by name)."""
+        input_shapes = dict(data_shapes)
+        input_types = {x.name: x.dtype for x in data_shapes}
+        if label_shapes is not None:
+            input_shapes.update(dict(label_shapes))
+            input_types.update({x.name: x.dtype for x in label_shapes})
+        arg_shapes, _, aux_shapes = self.symbol.infer_shape(
+            **input_shapes)
+        assert arg_shapes is not None, "shape inference failed"
+        arg_types, _, aux_types = self.symbol.infer_type(**input_types)
+        assert arg_types is not None, "type inference failed"
+        return (
+            dict(zip(self.arg_names, zip(arg_shapes, arg_types))),
+            dict(zip(self.aux_names, zip(aux_shapes, aux_types))),
+        )
+
+    def _pool_array(self, pool, name, shape, dtype, context):
+        """An input/grad buffer from executor i's shared pool — the
+        bucketing memory-sharing contract: a pool entry big enough is
+        VIEWED at the requested shape; a too-small one is reallocated
+        with a warning (reference executor_group.py bucketing pool)."""
+        arr = pool.get(name)
+        if arr is None:
+            arr = pool[name] = nd.zeros(shape, context, dtype=dtype)
+            return arr
+        if np.prod(arr.shape) >= np.prod(shape):
+            assert arr.dtype == dtype
+            return nd.NDArray(
+                arr._data.ravel()[: int(np.prod(shape))].reshape(shape),
+                ctx=context)
+        self.logger.warning(
+            "bucketing: data %s has a shape %s, which is larger than "
+            "already allocated shape %s. Need to re-allocate. Consider "
+            "putting default_bucket_key to be the bucket taking the "
+            "largest input for better memory sharing.",
+            name, shape, arr.shape)
+        arr = pool[name] = nd.zeros(shape, context, dtype=dtype)
+        return arr
+
     def _bind_ith_exec(self, i, data_shapes, label_shapes, shared_group):
         """Bind executor i, sharing memory with shared_group's executor i
         (reference executor_group.py:537-620). XLA owns buffer placement,
         so "sharing the memory pool" reduces to sharing parameter
-        NDArrays (shape-equal args) with the shared executor."""
+        NDArrays (shape-equal args) with the shared executor; non-param
+        inputs and their grads draw from the per-executor pool."""
         shared_exec = None if shared_group is None else shared_group.execs[i]
         context = self.contexts[i]
-        shared_data_arrays = self.shared_data_arrays[i]
+        pool = self.shared_data_arrays[i]
+        arg_specs, aux_specs = self._infer_ith(data_shapes, label_shapes)
 
-        input_shapes = dict(data_shapes)
-        if label_shapes is not None:
-            input_shapes.update(dict(label_shapes))
+        args = {}
+        grads = {} if self.for_training else None
 
-        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**input_shapes)
-        assert arg_shapes is not None, "shape inference failed"
+        def param_array(name, shape, dtype):
+            if shared_exec is None:
+                return nd.zeros(shape, context, dtype=dtype)
+            arr = shared_exec.arg_dict[name]
+            assert arr.shape == shape and arr.dtype == dtype
+            return arr
 
-        input_types = {x.name: x.dtype for x in data_shapes}
-        if label_shapes is not None:
-            input_types.update({x.name: x.dtype for x in label_shapes})
-        arg_types, _, aux_types = self.symbol.infer_type(**input_types)
-        assert arg_types is not None, "type inference failed"
+        for name, (shape, dtype) in arg_specs.items():
+            is_param = name in self.param_names
+            args[name] = (
+                param_array(name, shape, dtype) if is_param
+                else self._pool_array(pool, name, shape, dtype, context))
+            if self.grad_req[name] != "null":
+                grads[name] = (
+                    nd.zeros(shape, context, dtype=dtype) if is_param
+                    else self._pool_array(pool, "grad of " + name,
+                                          shape, dtype, context))
 
-        arg_arrays = []
-        grad_arrays = {} if self.for_training else None
-
-        def _get_or_reshape(name, shared_data_arrays, arg_shape, arg_type,
-                            context, logger):
-            if name in shared_data_arrays:
-                arg_arr = shared_data_arrays[name]
-                if np.prod(arg_arr.shape) >= np.prod(arg_shape):
-                    assert arg_arr.dtype == arg_type
-                    arg_arr = nd.NDArray(
-                        arg_arr._data.ravel()[: int(np.prod(arg_shape))]
-                        .reshape(arg_shape),
-                        ctx=context,
-                    )
-                else:
-                    logger.warning(
-                        "bucketing: data %s has a shape %s, which is larger "
-                        "than already allocated shape %s. Need to re-allocate."
-                        " Consider putting default_bucket_key to be the "
-                        "bucket taking the largest input for better memory "
-                        "sharing.", name, arg_shape, arg_arr.shape)
-                    arg_arr = nd.zeros(arg_shape, context, dtype=arg_type)
-                    shared_data_arrays[name] = arg_arr
-            else:
-                arg_arr = nd.zeros(arg_shape, context, dtype=arg_type)
-                shared_data_arrays[name] = arg_arr
-            return arg_arr
-
-        for j in range(len(self.arg_names)):
-            name = self.arg_names[j]
-            if name in self.param_names:
-                if shared_exec is None:
-                    arg_arr = nd.zeros(arg_shapes[j], context,
-                                       dtype=arg_types[j])
-                else:
-                    arg_arr = shared_exec.arg_dict[name]
-                    assert arg_arr.shape == arg_shapes[j]
-                    assert arg_arr.dtype == arg_types[j]
-                if self.grad_req[name] != "null":
-                    grad_arrays[name] = nd.zeros(arg_shapes[j], context,
-                                                 dtype=arg_types[j])
-            else:
-                arg_arr = _get_or_reshape(name, shared_data_arrays,
-                                          arg_shapes[j], arg_types[j],
-                                          context, self.logger)
-                if self.grad_req[name] != "null":
-                    grad_arrays[name] = _get_or_reshape(
-                        "grad of " + name, shared_data_arrays,
-                        arg_shapes[j], arg_types[j], context, self.logger)
-            arg_arrays.append(arg_arr)
-
-        if shared_exec is None:
-            aux_arrays = [
-                nd.zeros(s, context, dtype=t)
-                for s, t in zip(aux_shapes, aux_types)
-            ]
-        else:
-            aux_arrays = shared_exec.aux_arrays
-
-        args = dict(zip(self.arg_names, arg_arrays))
-        aux = dict(zip(self.aux_names, aux_arrays))
-        executor = self.symbol.bind(
-            ctx=context, args=args, args_grad=grad_arrays,
-            aux_states=aux, grad_req=self.grad_req,
-            shared_exec=shared_exec,
+        aux = (
+            dict(zip(self.aux_names, shared_exec.aux_arrays))
+            if shared_exec is not None else
+            {n: nd.zeros(s, context, dtype=t)
+             for n, (s, t) in aux_specs.items()}
         )
-        return executor
+        return self.symbol.bind(
+            ctx=context, args=args, args_grad=grads, aux_states=aux,
+            grad_req=self.grad_req, shared_exec=shared_exec,
+        )

@@ -219,12 +219,48 @@ class FusedTrainStep:
         return jax.tree_util.tree_map(self._put, state, sh)
 
     # ------------------------------------------------------------ build
+    def _bucket_plan(self):
+        """Static plan for the flat-bucket optimizer update
+        (MXNET_TPU_OPT_BUCKET=1), or None when ineligible. Eligible
+        when every trainable parameter shares one dtype, one state
+        structure, one wd multiplier, and a replicated (or meshless)
+        layout — concatenation then changes nothing about the
+        elementwise update math."""
+        if os.environ.get("MXNET_TPU_OPT_BUCKET", "0") != "1":
+            return None
+        tr = self._trainable
+        if not tr:
+            return None
+        from jax.sharding import PartitionSpec as P
+
+        if self._mesh is not None and any(
+                self._param_specs.get(n, P()) != P() for n in tr):
+            self._logger.info(
+                "opt bucket disabled: sharded parameters present")
+            return None
+        dtypes = {self.params[n].dtype for n in tr}
+        structs = {jax.tree_util.tree_structure(self.states[n])
+                   for n in tr}
+        if len(dtypes) > 1 or len(structs) > 1:
+            self._logger.info(
+                "opt bucket disabled: mixed dtype/state structure "
+                "across parameters")
+            return None
+        segs, off = [], 0
+        for n in tr:
+            sz = int(np.prod(self.params[n].shape))
+            segs.append((n, off, sz))
+            off += sz
+        return {"segs": segs}
+
     def _build(self):
         run = self._ex._run_graph
         opt = self._opt
         trainable = list(self._trainable)
         cdt = self._compute_dtype
         labels = self._label_names
+        bucket = self._bucket_plan()
+        self._bucket_active = bucket is not None
 
         def cast_c(x):
             """master -> compute dtype (params, auxs, float data).
@@ -263,20 +299,79 @@ class FusedTrainStep:
 
             new_params = dict(params)
             new_states = dict(states)
-            for name in trainable:
-                w = params[name]
-                g = grads[name].astype(w.dtype)
-                lr_p = lr * opt._lr_mult_for(name)
-                w2, s2 = opt.apply_dense(
-                    name, w, g, states[name], lr_p, t
-                )
-                new_params[name] = w2
-                # preserve the stored state dtype (bf16 opt-state mode
-                # computes in promoted f32, rounds back on store) so
-                # donated buffers stay type-stable across steps
-                new_states[name] = jax.tree_util.tree_map(
-                    lambda old, new: new.astype(old.dtype),
-                    states[name], s2)
+            keep_dtype = jax.tree_util.tree_map
+            if bucket is not None:
+                # MXNET_TPU_OPT_BUCKET: ONE apply_dense over every
+                # trainable parameter concatenated flat (multi-tensor
+                # apply) — identical elementwise math, ~1 fused update
+                # kernel instead of one per parameter. lr/wd
+                # multipliers are read HERE (trace time, same moment
+                # the per-param path reads them) and become
+                # per-element vectors when non-uniform — lr and wd
+                # enter every registered optimizer elementwise, so a
+                # vector broadcasts into the same math.
+                segs = bucket["segs"]
+                wflat = jnp.concatenate(
+                    [params[n].ravel() for n in trainable])
+                gflat = jnp.concatenate(
+                    [grads[n].astype(params[n].dtype).ravel()
+                     for n in trainable])
+                sflat = jax.tree_util.tree_map(
+                    lambda *leaves: jnp.concatenate(
+                        [l.ravel() for l in leaves]),
+                    *[states[n] for n in trainable]) \
+                    if states[trainable[0]] is not None else None
+                lms = [opt._lr_mult_for(n) for n in trainable]
+                lr_b = lr
+                if any(lm != lms[0] for lm in lms):
+                    lr_b = lr * jnp.concatenate([
+                        jnp.full((sz,), np.float32(lm))
+                        for (_n, _o, sz), lm in zip(segs, lms)])
+                elif lms[0] != 1.0:
+                    lr_b = lr * np.float32(lms[0])
+                wds = [opt._wd_for(n) for n in trainable]
+                if opt.wd and any(w != wds[0] for w in wds):
+                    wd_mult_vec = jnp.concatenate([
+                        jnp.full((sz,), np.float32(w / opt.wd))
+                        for (_n, _o, sz), w in zip(segs, wds)])
+                else:
+                    wd_mult_vec = (wds[0] / opt.wd) if opt.wd else 1.0
+                # apply_dense reads wd via _wd_for(name) during THIS
+                # trace; the synthetic entry is removed right after so
+                # no tracer/stale value survives in the dict
+                opt.wd_mult["__bucket__"] = wd_mult_vec
+                try:
+                    w2, s2 = opt.apply_dense(
+                        "__bucket__", wflat, gflat, sflat, lr_b, t)
+                finally:
+                    opt.wd_mult.pop("__bucket__", None)
+                for n, off, sz in bucket["segs"]:
+                    shape = params[n].shape
+                    new_params[n] = w2[off:off + sz].reshape(shape)
+                    if s2 is None:
+                        new_states[n] = None
+                    else:
+                        piece = jax.tree_util.tree_map(
+                            lambda leaf, sh=shape, o=off, z=sz:
+                            leaf[o:o + z].reshape(sh), s2)
+                        new_states[n] = keep_dtype(
+                            lambda old, new: new.astype(old.dtype),
+                            states[n], piece)
+            else:
+                for name in trainable:
+                    w = params[name]
+                    g = grads[name].astype(w.dtype)
+                    lr_p = lr * opt._lr_mult_for(name)
+                    w2, s2 = opt.apply_dense(
+                        name, w, g, states[name], lr_p, t
+                    )
+                    new_params[name] = w2
+                    # preserve the stored state dtype (bf16 opt-state
+                    # mode computes in promoted f32, rounds back on
+                    # store) so donated buffers stay type-stable
+                    new_states[name] = keep_dtype(
+                        lambda old, new: new.astype(old.dtype),
+                        states[name], s2)
             new_auxs = {
                 **auxs,
                 **{

@@ -84,6 +84,13 @@ register_env(
     "(parallel/dp_step.py). Empty = weight dtype.",
 )
 register_env(
+    "MXNET_TPU_OPT_BUCKET", bool, False,
+    "flat-bucket optimizer update in the fused train step: ONE "
+    "apply_dense over all trainable params concatenated (multi-tensor "
+    "apply) instead of one per parameter; auto-disabled for sharded/"
+    "mixed-dtype params (parallel/dp_step.py _bucket_plan).",
+)
+register_env(
     "MXNET_ENABLE_GPU_P2P", bool, True,
     "unused on TPU (ICI is always peer-to-peer); kept for CLI compat",
 )

@@ -33,9 +33,10 @@ Heterogeneous tier (v3) capabilities and remaining constraints:
     at use and updated in f32 (mixed-precision master-weight
     convention). Boundary activations must be float (they ride an f32
     ring buffer); stage-0 integer inputs (token ids) are fine.
-  - per-name lr_mult/wd_mult: honored by grouping segments with equal
-    multipliers and running one masked update per group (keys tried:
-    'stage{s}/{name}' then bare '{name}').
+  - per-name lr_mult/wd_mult: honored as per-element lr/wd vectors
+    over the bucket — ONE update regardless of how many distinct
+    multipliers (keys tried: 'stage{s}/{name}' then bare '{name}';
+    same policy as the fused step's flat bucket).
   - tied parameters: `tied_params=[("stage0/w", "stageN/w")]` sums the
     tied segments' gradients into both copies each step, keeping them
     bit-identical — tied-embedding LMs pipeline correctly.
@@ -512,15 +513,14 @@ class PipelineModule(BaseModule):
             for i, (n, v) in enumerate(self.params.items())
         })
         if self._hetero:
-            self._build_mult_groups(optimizer)
+            self._build_mult_vectors(optimizer)
         self.optimizer_initialized = True
 
-    def _build_mult_groups(self, optimizer):
-        """Group bucket segments by (lr_mult, wd_mult) so per-name
-        multipliers apply inside a stage: one masked apply_dense per
-        distinct multiplier pair (reference optimizer.py _get_lr/_get_wd
-        per-arg scaling). Lookup keys: 'stage{s}/{name}', then bare
-        '{name}'."""
+    def _build_mult_vectors(self, optimizer):
+        """Per-element lr/wd multiplier vectors over the stage bucket
+        so per-name multipliers apply inside a stage (reference
+        optimizer.py _get_lr/_get_wd per-arg scaling). Lookup keys:
+        'stage{s}/{name}', then bare '{name}'."""
 
         attr_dicts = [sym.attr_dict() for sym in self._stage_syms]
 
@@ -540,50 +540,29 @@ class PipelineModule(BaseModule):
                     break
             return (lm, wm)
 
-        masks = {}  # (lm, wm) -> np mask (S, Lmax)
-        covered = np.zeros((self._num_stages, self._lmax), bool)
+        # per-element multiplier vectors over the (S, Lmax) bucket:
+        # lr and wd enter every registered optimizer ELEMENTWISE, so
+        # one apply_dense with vector lr (and a vector wd multiplier
+        # via the synthetic name) computes exactly the per-name math —
+        # same policy as the fused step's flat bucket
+        # (parallel/dp_step.py). Padding elements keep multiplier 1
+        # (their grads are zero).
+        lrv = np.ones((self._num_stages, self._lmax), np.float32)
+        wdv = np.ones((self._num_stages, self._lmax), np.float32)
         tie_mults = {}
         for s, segs in enumerate(self._param_segs):
             for (n, off, sz, _shp, _dt) in segs:
-                pair = mults(s, n)
-                tie_mults[f"stage{s}/{n}"] = pair
-                mk = masks.setdefault(
-                    pair,
-                    np.zeros((self._num_stages, self._lmax),
-                             np.float32))
-                mk[s, off:off + sz] = 1.0
-                covered[s, off:off + sz] = True
-        # padding elements (grads are zero there) join the default
-        # group so every bucket element is updated by exactly one group
-        default = masks.setdefault(
-            (1.0, 1.0),
-            np.zeros((self._num_stages, self._lmax), np.float32))
-        default[~covered] = 1.0
+                lm, wm = mults(s, n)
+                tie_mults[f"stage{s}/{n}"] = (lm, wm)
+                lrv[s, off:off + sz] = lm
+                wdv[s, off:off + sz] = wm
         for (a, b) in [(t[5], t[6]) for t in self._ties]:
             if tie_mults.get(a) != tie_mults.get(b):
                 raise MXNetError(
                     f"tied parameters {a!r}/{b!r} must share "
                     "lr_mult/wd_mult (else the copies diverge)")
-        if list(masks) == [(1.0, 1.0)]:
-            self._mult_groups = None  # uniform: scalar fast path
-            return
-        self._mult_groups = []
-        for gi, ((lm, wm), mk) in enumerate(sorted(masks.items())):
-            gname = f"{_FLAT}::grp{gi}"
-            optimizer.wd_mult[gname] = wm
-            self._mult_groups.append((gname, lm, wm, mk))
-        # when only lr_mult varies (wd uniform), ONE apply_dense with a
-        # per-element lr vector covers every group: lr enters all
-        # registered optimizers elementwise, so an (S, Lmax) lr
-        # broadcasts into the same math at 1x update cost
-        if len({wm for (_g, _l, wm, _m) in self._mult_groups}) == 1:
-            lrvec = np.zeros((self._num_stages, self._lmax),
-                             np.float32)
-            for (_g, lm, _w, mk) in self._mult_groups:
-                lrvec += np.float32(lm) * mk
-            self._lr_vec = lrvec
-        else:
-            self._lr_vec = None
+        self._lr_vec = lrv if (lrv != 1.0).any() else None
+        self._wd_vec = wdv if (wdv != 1.0).any() else None
 
     # ------------------------------------------------------ computation
     def _loss_of(self, out, label):
@@ -687,9 +666,8 @@ class PipelineModule(BaseModule):
                 return self._loss_of(out, label), (out, flat_auxs)
 
         ties = getattr(self, "_ties", None) or []
-        groups = getattr(self, "_mult_groups", None)
         lr_vec = getattr(self, "_lr_vec", None)
-        jtu_ = jax.tree_util
+        wd_vec = getattr(self, "_wd_vec", None)
 
         def train_step(params, states, flat_auxs, data, label, lr, t,
                        rng):
@@ -713,29 +691,22 @@ class PipelineModule(BaseModule):
                 grads[_FLAT] = g
             new_p, new_s = {}, {}
             for n in names:
-                if groups and n == _FLAT:
+                if n == _FLAT and (lr_vec is not None
+                                   or wd_vec is not None):
+                    # per-name multipliers as elementwise vectors:
+                    # ONE update covers every (lr_mult, wd_mult)
                     w, g, st = params[n], grads[n], states[n]
-                    if lr_vec is not None:
-                        # wd uniform, only lr_mult varies: one update
-                        # with a per-element lr vector
+                    lr_b = lr if lr_vec is None \
+                        else lr * jnp.asarray(lr_vec)
+                    if wd_vec is not None and opt_.wd:
+                        with opt_.temp_wd_mult(_FLAT + "::vec",
+                                               jnp.asarray(wd_vec)):
+                            w2, s2 = opt_.apply_dense(
+                                _FLAT + "::vec", w, g, st, lr_b, t)
+                    else:
                         w2, s2 = opt_.apply_dense(
-                            groups[0][0], w, g, st,
-                            lr * jnp.asarray(lr_vec), t)
-                        new_p[n], new_s[n] = w2, s2
-                        continue
-                    # mixed wd: one masked update per distinct
-                    # (lr_mult, wd_mult) pair, combined with where()
-                    acc_w = jnp.zeros_like(w)
-                    acc_s = jtu_.tree_map(jnp.zeros_like, st)
-                    for (gname, lm, _wm, mk) in groups:
-                        w2, s2 = opt_.apply_dense(
-                            gname, w, g, st, lr * np.float32(lm), t)
-                        m = jnp.asarray(mk.astype(bool))
-                        acc_w = jnp.where(m, w2, acc_w)
-                        acc_s = jtu_.tree_map(
-                            lambda a, b, m=m: jnp.where(m, b, a),
-                            acc_s, s2)
-                    new_p[n], new_s[n] = acc_w, acc_s
+                            n, w, g, st, lr_b, t)
+                    new_p[n], new_s[n] = w2, s2
                     continue
                 w2, s2 = opt_.apply_dense(
                     n, params[n], grads[n], states[n],

@@ -15,6 +15,7 @@ over unchanged to the KVStore('tpu') facade.
 """
 from __future__ import annotations
 
+import contextlib
 import math
 import pickle
 
@@ -139,6 +140,19 @@ class Optimizer:
     def _lr_mult_for(self, name):
         """Static per-parameter lr multiplier for the fused train step."""
         return self.lr_mult.get(name, 1.0)
+
+    @contextlib.contextmanager
+    def temp_wd_mult(self, name, value):
+        """Install a TEMPORARY wd multiplier (scalar or per-element
+        vector) under a synthetic name for one traced apply_dense call
+        — removed on exit so no tracer or stale value survives in the
+        dict. Used by the flat-bucket update paths (parallel/dp_step,
+        module/pipeline_module)."""
+        self.wd_mult[name] = value
+        try:
+            yield name
+        finally:
+            self.wd_mult.pop(name, None)
 
     def apply_dense(self, name, weight, grad, state, lr, t):
         """Pure-jax update of one parameter inside the fused train step.

@@ -336,15 +336,9 @@ class FusedTrainStep:
                         for (_n, _o, sz), w in zip(segs, wds)])
                 else:
                     wd_mult_vec = (wds[0] / opt.wd) if opt.wd else 1.0
-                # apply_dense reads wd via _wd_for(name) during THIS
-                # trace; the synthetic entry is removed right after so
-                # no tracer/stale value survives in the dict
-                opt.wd_mult["__bucket__"] = wd_mult_vec
-                try:
+                with opt.temp_wd_mult("__bucket__", wd_mult_vec):
                     w2, s2 = opt.apply_dense(
                         "__bucket__", wflat, gflat, sflat, lr_b, t)
-                finally:
-                    opt.wd_mult.pop("__bucket__", None)
                 for n, off, sz in bucket["segs"]:
                     shape = params[n].shape
                     new_params[n] = w2[off:off + sz].reshape(shape)

@@ -326,9 +326,9 @@ def test_pipeline_multi_input_boundary():
 
 
 def test_pipeline_mixed_wd_mult():
-    """Distinct wd_mult values take the masked multi-group update
-    path: lr_mult-frozen param stays frozen even with weight decay on,
-    no-decay param follows pure SGD."""
+    """Distinct wd_mult values ride the per-element wd VECTOR (one
+    update): lr_mult-frozen param stays frozen even with weight decay
+    on, no-decay param follows pure SGD."""
     vocab, d, B, t = 13, 8, 8, 4
     pm = mx.mod.PipelineModule(
         _tied_lm_stages(vocab, d), num_microbatches=4,
@@ -339,7 +339,7 @@ def test_pipeline_mixed_wd_mult():
     o.set_lr_mult({"stage1/b1_weight": 0.0})
     o.set_wd_mult({"stage0/emb_weight": 0.0})
     pm.init_optimizer(optimizer=o)
-    assert pm._lr_vec is None  # mixed wd -> masked branch
+    assert pm._wd_vec is not None  # mixed wd -> wd vector
     before, _ = pm.get_params()
     frozen0 = before["stage1/b1_weight"].asnumpy()
     rs = np.random.RandomState(3)

@@ -1,0 +1,10 @@
+#!/bin/sh
+# Build the amalgamated predict library (one .so, flat C symbols,
+# runtime embedded). Requires g++ and a python3 with embed support
+# plus the mxnet_tpu package importable at runtime (PYTHONPATH).
+set -e
+cd "$(dirname "$0")"
+g++ -O2 -std=c++17 -shared -fPIC mxnet_tpu_predict-all.cc \
+    $(python3-config --includes --ldflags --embed) \
+    -o libmxtpu_predict.so
+echo built: $(pwd)/libmxtpu_predict.so

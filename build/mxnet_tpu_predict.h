@@ -1,0 +1,30 @@
+/* mxnet_tpu predict-only C ABI (amalgamated bundle).
+ *
+ * Mirrors the reference include/mxnet/c_predict_api.h role: create a
+ * predictor from (symbol JSON, parameter blob), set inputs, forward,
+ * read outputs. All functions return 0 on success; on failure
+ * MXTpuGetLastError() describes the problem.
+ */
+#ifndef MXNET_TPU_PREDICT_H_
+#define MXNET_TPU_PREDICT_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+const char* MXTpuGetLastError(void);
+int MXTpuPredCreate(const char* symbol_json, const void* param_bytes,
+                    int param_size, int num_input,
+                    const char** input_keys, const unsigned* shape_ind,
+                    const unsigned* shape_data, void** out);
+int MXTpuPredSetInput(void* handle, const char* key, const float* data,
+                      int size);
+int MXTpuPredForward(void* handle);
+int MXTpuPredGetOutput(void* handle, int index, float* buf, int cap);
+void MXTpuPredFree(void* handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXNET_TPU_PREDICT_H_ */

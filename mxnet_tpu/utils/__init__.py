@@ -91,6 +91,13 @@ register_env(
     "mixed-dtype params (parallel/dp_step.py _bucket_plan).",
 )
 register_env(
+    "MXNET_TPU_BUCKET_FUSED", bool, False,
+    "fused train steps for BucketingModule: each bucket compiles its "
+    "own donated step and the canonical training state hands over on "
+    "bucket switch (module/bucketing_module.py _ensure_owner); "
+    "default keeps the reference's shared-NDArray eager updates.",
+)
+register_env(
     "MXNET_ENABLE_GPU_P2P", bool, True,
     "unused on TPU (ICI is always peer-to-peer); kept for CLI compat",
 )

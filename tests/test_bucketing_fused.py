@@ -1,0 +1,145 @@
+"""Fused bucketed training (MXNET_TPU_BUCKET_FUSED=1): every bucket
+runs its own compiled fused step and the canonical training state
+(params, optimizer state, step count) hands over on bucket switch.
+Gated against the default eager-bucketing path on an interleaved
+bucket schedule."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _gen(key, vocab=17, d=8, classes=3):
+    data = mx.sym.Variable("data")
+    emb = mx.sym.Embedding(data, input_dim=vocab, output_dim=d,
+                           name="emb")
+    pooled = mx.sym.mean(emb, axis=1)  # (B, d): length-independent
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(pooled, num_hidden=classes, name="fc"),
+        name="softmax")
+    return net, ("data",), ("softmax_label",)
+
+
+def _batches(vocab=17, classes=3, B=8, steps=12):
+    rs = np.random.RandomState(0)
+    out = []
+    for i in range(steps):
+        T = (4, 6, 9)[i % 3]  # interleave three buckets
+        # class-conditional token distribution: tokens = c (mod 3)
+        # with prob ~0.7, so the mean embedding separates classes
+        y = rs.randint(0, classes, B)
+        x = np.where(rs.rand(B, T) < 0.7,
+                     y[:, None] + classes * rs.randint(
+                         0, vocab // classes, (B, T)),
+                     rs.randint(0, vocab, (B, T))).astype("float32")
+        x = np.clip(x, 0, vocab - 1)
+        y = y.astype("float32")
+        out.append(mx.io.DataBatch(
+            data=[mx.nd.array(x)], label=[mx.nd.array(y)],
+            bucket_key=T, provide_data=[("data", (B, T))],
+            provide_label=[("softmax_label", (B,))]))
+    return out
+
+
+def _train(monkeypatch, fused):
+    monkeypatch.setenv("MXNET_TPU_BUCKET_FUSED",
+                       "1" if fused else "0")
+    bm = mx.mod.BucketingModule(_gen, default_bucket_key=9)
+    bm.bind(data_shapes=[("data", (8, 9))],
+            label_shapes=[("softmax_label", (8,))])
+    np.random.seed(5)
+    bm.init_params(mx.initializer.Xavier())
+    bm.init_optimizer(
+        optimizer="sgd",
+        optimizer_params=(("learning_rate", 0.2), ("momentum", 0.9)))
+    for b in _batches():
+        bm.forward(b)
+        bm.backward()
+        bm.update()
+    params, _ = bm.get_params()
+    return bm, {k: v.asnumpy() for k, v in params.items()}
+
+
+def test_fused_bucketing_matches_eager(monkeypatch):
+    bm_e, eager = _train(monkeypatch, fused=False)
+    bm_f, fused = _train(monkeypatch, fused=True)
+    # the eager path must really have been eager, the fused one fused
+    assert all(m._fused_step is None
+               for m in bm_e._buckets.values())
+    ran = {k: m._fused_step._t for k, m in bm_f._buckets.items()
+           if m._fused_step is not None}
+    assert len(ran) == 3 and all(t > 0 for t in ran.values()), ran
+    # one canonical state: total fused steps == batches is NOT
+    # expected per module (each carries the shared counter forward);
+    # the OWNER's count equals the total number of updates
+    owner = bm_f._buckets[bm_f._state_owner]
+    assert owner._fused_step._t == 12
+    # identical math within fp tolerance (eager updater vs fused
+    # apply_dense share the optimizer ops)
+    assert eager.keys() == fused.keys()
+    for k in eager:
+        np.testing.assert_allclose(eager[k], fused[k], rtol=1e-4,
+                                   atol=1e-6), k
+
+
+def test_fused_bucketing_converges(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_BUCKET_FUSED", "1")
+    bm = mx.mod.BucketingModule(_gen, default_bucket_key=9)
+    bm.bind(data_shapes=[("data", (8, 9))],
+            label_shapes=[("softmax_label", (8,))])
+    np.random.seed(5)
+    bm.init_params(mx.initializer.Xavier())
+    bm.init_optimizer(
+        optimizer="sgd",
+        optimizer_params=(("learning_rate", 0.3), ("momentum", 0.9)))
+    batches = _batches(steps=60)
+    for b in batches:
+        bm.forward(b)
+        bm.backward()
+        bm.update()
+    m = mx.metric.Accuracy()
+    for b in batches[-12:]:
+        bm.forward(b, is_train=False)
+        m.update([b.label[0]], bm.get_outputs())
+    assert m.get()[1] > 0.9, m.get()
+
+
+def test_mixed_fused_eager_demotes_coherently(monkeypatch):
+    """If any bucket cannot build a fused step, ALL buckets demote to
+    the shared eager path (forked lineages are worse than slow):
+    training still matches the pure-eager trajectory."""
+    from mxnet_tpu.module import module as module_mod
+
+    monkeypatch.setenv("MXNET_TPU_BUCKET_FUSED", "1")
+    orig = module_mod.Module._build_fused_step
+
+    def crippled(self, carry_from=None):
+        orig(self, carry_from=carry_from)
+        shapes = getattr(self, "_data_shapes", None)
+        if shapes and shapes[0].shape[1] == 6:  # the T=6 bucket
+            self._fused_step = None
+
+    monkeypatch.setattr(module_mod.Module, "_build_fused_step",
+                        crippled)
+    bm = mx.mod.BucketingModule(_gen, default_bucket_key=9)
+    bm.bind(data_shapes=[("data", (8, 9))],
+            label_shapes=[("softmax_label", (8,))])
+    np.random.seed(5)
+    bm.init_params(mx.initializer.Xavier())
+    bm.init_optimizer(
+        optimizer="sgd",
+        optimizer_params=(("learning_rate", 0.2), ("momentum", 0.9)))
+    for b in _batches():
+        bm.forward(b)
+        bm.backward()
+        bm.update()
+    got, _ = bm.get_params()
+    got = {k: v.asnumpy() for k, v in got.items()}
+    # after demotion every bucket is eager
+    assert all(m._fused_step is None for m in bm._buckets.values())
+
+    monkeypatch.setattr(module_mod.Module, "_build_fused_step", orig)
+    _bm, eager = _train(monkeypatch, fused=False)
+    for k in eager:
+        np.testing.assert_allclose(eager[k], got[k], rtol=1e-4,
+                                   atol=1e-6), k

@@ -14,6 +14,15 @@ import pytest
 import mxnet_tpu as mx
 
 
+@pytest.fixture(autouse=True)
+def _default_opt_state_dtype(monkeypatch):
+    """These tests assert fused == eager to tight tolerances; an
+    ambient MXNET_TPU_OPT_STATE_DTYPE=bfloat16 rounds the FUSED path's
+    optimizer state (by design) while the eager path stays f32, so the
+    parity bar only holds under the default state dtype."""
+    monkeypatch.delenv("MXNET_TPU_OPT_STATE_DTYPE", raising=False)
+
+
 def _mlp(hidden=32, classes=10):
     d = mx.sym.Variable("data")
     f1 = mx.sym.FullyConnected(d, name="fc1", num_hidden=hidden)

@@ -39,6 +39,7 @@ import pickle
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import profiler as _profiler
 from ..base import MXNetError
@@ -192,6 +193,8 @@ class FusedTrainStep:
             self._param_sh = None
             self._data_sh = None
 
+        self._multi_cache = {}     # (k, stacked) -> jitted k-step loop
+        self._multi_compiled = {}  # (k, stacked) -> AOT executable
         self._jitted = self._build()
         self._compiled = None  # AOT executable, built on first run
 
@@ -376,6 +379,7 @@ class FusedTrainStep:
             }
             return outs, new_params, new_states, new_auxs
 
+        self._step_fn = step  # raw traceable body (multi-step loop)
         kwargs = {"donate_argnums": (0, 1, 2)}
         if self._mesh is not None:
             state_sh = {
@@ -461,6 +465,147 @@ class FusedTrainStep:
                     self._jitted(*args)
         return outs
 
+    # ------------------------------------------------- multi-step loop
+    def _multi_fn(self, k, stacked):
+        """jit of a device-side k-step training loop (lax.scan over the
+        fused step body). One host dispatch advances k optimizer steps;
+        over a remote-dispatch backend (the axon TPU tunnel) the
+        per-dispatch round-trip amortizes k-fold. The reference gets
+        the same effect from its async dependency engine queueing many
+        ops ahead of the host (SURVEY §2.2); the XLA-native equivalent
+        is a compiled step loop."""
+        key = (int(k), bool(stacked))
+        fn = self._multi_cache.get(key)
+        if fn is not None:
+            return fn
+        step_fn = self._step_fn
+
+        def multi(params, states, auxs, data, lrs, ts):
+            carry = (params, states, auxs)
+            if k > 1:
+                if stacked:
+                    xs = ({n: v[:-1] for n, v in data.items()},
+                          lrs[:-1], ts[:-1])
+
+                    def body(c, x):
+                        data_i, lr_i, t_i = x
+                        p, s, a = c
+                        _o, p2, s2, a2 = step_fn(
+                            p, s, a, data_i, lr_i, t_i)
+                        return (p2, s2, a2), None
+                else:
+                    xs = (lrs[:-1], ts[:-1])
+
+                    def body(c, x):
+                        lr_i, t_i = x
+                        p, s, a = c
+                        _o, p2, s2, a2 = step_fn(
+                            p, s, a, data, lr_i, t_i)
+                        return (p2, s2, a2), None
+                carry, _ = jax.lax.scan(body, carry, xs)
+            params, states, auxs = carry
+            last = {n: v[-1] for n, v in data.items()} if stacked \
+                else data
+            return step_fn(params, states, auxs, last, lrs[-1], ts[-1])
+
+        kwargs = {"donate_argnums": (0, 1, 2)}
+        if self._mesh is not None:
+            state_sh = {
+                n: self._state_sharding(self.states[n], n)
+                for n in self.states
+            }
+            aux_sh = {n: self._repl for n in self.auxs}
+            base_sh = {
+                n: (self._data_sh.get(n) or self._batch_sh)
+                for n in self._data_names
+            }
+            data_sh = base_sh if not stacked else {
+                n: NamedSharding(self._mesh, P(None, *sh.spec))
+                for n, sh in base_sh.items()
+            }
+            kwargs["in_shardings"] = (
+                self._param_sh, state_sh, aux_sh, data_sh, None, None,
+            )
+            kwargs["out_shardings"] = (
+                self._repl if self._nproc > 1 else None,
+                self._param_sh, state_sh, aux_sh,
+            )
+        fn = jax.jit(multi, **kwargs)
+        self._multi_cache[key] = fn
+        return fn
+
+    def run_steps(self, data_vals, k, stacked=False):
+        """Advance k train steps in ONE dispatch. Semantically identical
+        to k ``step()`` calls: per-step lr follows the scheduler, t (and
+        therefore the dropout rng chain) advances per inner step, state
+        dtypes are preserved by the body itself.
+
+        stacked=False reuses one resident batch for every inner step
+        (synthetic benchmarking); stacked=True expects every data value
+        with a leading (k,) axis of per-step batches and scans over it.
+
+        Multi-process meshes fall back to k sequential steps: the
+        per-process assembly of a global stacked array is not wired up
+        (the cross-process gradient sum inside the body already
+        overlaps; dispatch amortization matters on the single-host
+        tunnel path)."""
+        if k < 1:
+            raise ValueError("run_steps needs k >= 1")
+        opt = self._opt
+        lrs, ts = [], []
+        for _ in range(k):
+            self._t += 1
+            opt.num_update += 1
+            lrs.append(float(
+                opt.lr_scheduler(opt.num_update)
+                if opt.lr_scheduler is not None else opt.lr))
+            ts.append(self._t)
+        if self._nproc > 1:
+            outs = None
+            for i in range(k):
+                d = {n: v[i] for n, v in data_vals.items()} if stacked \
+                    else data_vals
+                args = (self.params, self.states, self.auxs,
+                        self._place_data(d),
+                        np.float32(lrs[i]), np.int32(ts[i]))
+                with self._ambient():
+                    outs, self.params, self.states, self.auxs = \
+                        self._jitted(*args)
+            return outs
+        lrs_v = jnp.asarray(np.asarray(lrs, np.float32))
+        ts_v = jnp.asarray(np.asarray(ts, np.int32))
+        if stacked and self._mesh is not None:
+            data = {
+                n: jax.device_put(v, NamedSharding(
+                    self._mesh,
+                    P(None, *(self._data_sh.get(n)
+                              or self._batch_sh).spec)))
+                for n, v in data_vals.items()
+            }
+        elif stacked:
+            data = data_vals
+        else:
+            data = self._place_data(data_vals)
+        fn = self._multi_fn(k, stacked)
+        key = (int(k), bool(stacked))
+        with self._ambient(), _profiler.scope(
+                "fused_train_steps", "executor"):
+            args = (self.params, self.states, self.auxs,
+                    data, lrs_v, ts_v)
+            ex = self._multi_compiled.get(key)
+            if ex is None:
+                try:  # AOT, like the single-step path
+                    ex = fn.lower(*args).compile()
+                except Exception:
+                    ex = False
+                self._multi_compiled[key] = ex
+            call = ex if ex else fn
+            try:
+                outs, self.params, self.states, self.auxs = call(*args)
+            except (TypeError, ValueError):
+                outs, self.params, self.states, self.auxs = fn(*args)
+        return outs
+
     def sync(self):
         """Fence: wait until all queued steps have executed.
 
@@ -519,16 +664,31 @@ class FusedTrainStep:
 
     # ------------------------------------------------------ diagnostics
     def flops(self):
-        """FLOPs of one compiled train step, from XLA cost analysis."""
-        if not self._compiled:
-            return 0.0
-        try:
-            cost = self._compiled.cost_analysis()
+        """FLOPs of one compiled train step, from XLA cost analysis.
+
+        When only a multi-step loop was compiled (run_steps-only use,
+        e.g. BENCH_MULTISTEP), per-step work is estimated from the
+        k-loop program. XLA cost analysis counts a while/scan body ONCE
+        regardless of trip count, so the k-loop program's reported cost
+        is (scan body) + (the one peeled final step) ~= 2x one step for
+        any k > 1 — hence the /2 below (exactly 1x for k == 1, where
+        there is no scan). The residual error is the non-step scan
+        plumbing, which is negligible against a train step."""
+        def _cost(ex):
+            cost = ex.cost_analysis()
             if isinstance(cost, list):
                 cost = cost[0]
             return float(cost.get("flops", 0.0))
+
+        try:
+            if self._compiled:
+                return _cost(self._compiled)
+            for (k, _st), ex in self._multi_compiled.items():
+                if ex:
+                    return _cost(ex) / (2.0 if k > 1 else 1.0)
         except Exception:
             return 0.0
+        return 0.0
 
     # ------------------------------------------ optimizer state save/load
     STATE_FORMAT = "mxnet_tpu/fused_v1"

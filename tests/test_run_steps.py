@@ -1,0 +1,249 @@
+"""Device-side multi-step training loop (Module.run_steps /
+FusedTrainStep.run_steps): k optimizer steps compiled into ONE
+dispatch via lax.scan over the fused step body.
+
+Correctness bar: bit-for-bit the same SEMANTICS as k sequential
+forward_backward()+update() calls — per-step lr from the scheduler,
+per-step rng (dropout) from fold_in(t), optimizer-state dtype
+preserved. The reference achieves dispatch amortization through its
+async dependency engine running ahead of the host
+(src/engine/threaded_engine.cc); the XLA-native equivalent is the
+compiled step loop, so parity with the sequential path is the gate.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+@pytest.fixture(autouse=True)
+def _default_opt_state_dtype(monkeypatch):
+    monkeypatch.delenv("MXNET_TPU_OPT_STATE_DTYPE", raising=False)
+
+
+def _mlp(classes=10):
+    d = mx.sym.Variable("data")
+    f1 = mx.sym.FullyConnected(d, name="fc1", num_hidden=32)
+    a1 = mx.sym.Activation(f1, name="relu1", act_type="relu")
+    f2 = mx.sym.FullyConnected(a1, name="fc2", num_hidden=classes)
+    return mx.sym.SoftmaxOutput(f2, name="softmax")
+
+
+def _module(optimizer="sgd", scheduler=None):
+    mod = mx.mod.Module(_mlp(), context=[mx.cpu()])
+    mod.bind(data_shapes=[("data", (16, 20))],
+             label_shapes=[("softmax_label", (16,))])
+    mx.random.seed(11)
+    mod.init_params(mx.initializer.Uniform(0.07))
+    opt_params = [("learning_rate", 0.1), ("wd", 1e-4)]
+    if optimizer == "sgd":
+        opt_params.append(("momentum", 0.9))
+    if scheduler is not None:
+        opt_params.append(("lr_scheduler", scheduler))
+    mod.init_optimizer(kvstore="tpu", optimizer=optimizer,
+                       optimizer_params=tuple(opt_params))
+    assert mod._fused_step is not None
+    return mod
+
+
+def _batches(k, seed=3):
+    rs = np.random.RandomState(seed)
+    X = rs.uniform(-1, 1, (k, 16, 20)).astype("float32")
+    Y = rs.randint(0, 10, (k, 16)).astype("float32")
+    return X, Y
+
+
+def _params(mod):
+    mod._flush_fused()
+    a, _ = mod.get_params()
+    return {n: v.asnumpy() for n, v in a.items()}
+
+
+def _assert_same(pa, pb):
+    assert set(pa) == set(pb)
+    for n in pa:
+        np.testing.assert_allclose(pa[n], pb[n], rtol=2e-5, atol=2e-6,
+                                   err_msg=n)
+
+
+@pytest.mark.parametrize("k", [1, 4])
+def test_run_steps_stacked_matches_sequential(k):
+    X, Y = _batches(k)
+
+    seq = _module()
+    for i in range(k):
+        seq.forward_backward(mx.io.DataBatch(
+            data=[mx.nd.array(X[i])], label=[mx.nd.array(Y[i])]))
+        seq.update()
+
+    fused = _module()
+    fused.run_steps(
+        mx.io.DataBatch(data=[mx.nd.array(X)], label=[mx.nd.array(Y)]),
+        k, stacked=True)
+    _assert_same(_params(seq), _params(fused))
+
+
+def test_run_steps_resident_batch_matches_sequential():
+    X, Y = _batches(1)
+    b = mx.io.DataBatch(data=[mx.nd.array(X[0])],
+                        label=[mx.nd.array(Y[0])])
+    k = 5
+
+    seq = _module()
+    for _ in range(k):
+        seq.forward_backward(b)
+        seq.update()
+
+    fused = _module()
+    fused.run_steps(b, k, stacked=False)
+    _assert_same(_params(seq), _params(fused))
+
+
+def test_run_steps_scheduler_and_t_advance():
+    """Per-step lr follows the scheduler inside the loop, and the step
+    counter advances by k (so a later eager step sees the right t)."""
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    X, Y = _batches(4)
+
+    seq = _module(scheduler=sched)
+    for i in range(4):
+        seq.forward_backward(mx.io.DataBatch(
+            data=[mx.nd.array(X[i])], label=[mx.nd.array(Y[i])]))
+        seq.update()
+
+    sched2 = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    fused = _module(scheduler=sched2)
+    fused.run_steps(
+        mx.io.DataBatch(data=[mx.nd.array(X)], label=[mx.nd.array(Y)]),
+        4, stacked=True)
+    assert fused._fused_step._t == seq._fused_step._t == 4
+    assert fused._optimizer.num_update == seq._optimizer.num_update
+    _assert_same(_params(seq), _params(fused))
+
+
+def test_run_steps_adam_and_outputs():
+    """A stateful optimizer with per-element moments round-trips
+    through the scan carry; outputs of the LAST inner step surface
+    through get_outputs()."""
+    X, Y = _batches(3, seed=9)
+
+    seq = _module(optimizer="adam")
+    for i in range(3):
+        seq.forward_backward(mx.io.DataBatch(
+            data=[mx.nd.array(X[i])], label=[mx.nd.array(Y[i])]))
+        seq.update()
+    seq_out = seq.get_outputs()[0].asnumpy()
+
+    fused = _module(optimizer="adam")
+    fused.run_steps(
+        mx.io.DataBatch(data=[mx.nd.array(X)], label=[mx.nd.array(Y)]),
+        3, stacked=True)
+    out = fused.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(seq_out, out, rtol=2e-5, atol=2e-6)
+    _assert_same(_params(seq), _params(fused))
+
+
+def test_run_steps_bn_aux_carry():
+    """BatchNorm moving stats (aux states) advance per inner step
+    through the scan carry, matching the sequential path."""
+    def net():
+        d = mx.sym.Variable("data")
+        c = mx.sym.Convolution(d, name="c1", num_filter=8,
+                               kernel=(3, 3), pad=(1, 1))
+        b = mx.sym.BatchNorm(c, name="bn1")
+        f = mx.sym.FullyConnected(mx.sym.Flatten(b), name="fc",
+                                  num_hidden=10)
+        return mx.sym.SoftmaxOutput(f, name="softmax")
+
+    def module():
+        mod = mx.mod.Module(net(), context=[mx.cpu()])
+        mod.bind(data_shapes=[("data", (8, 3, 8, 8))],
+                 label_shapes=[("softmax_label", (8,))])
+        mx.random.seed(5)
+        mod.init_params(mx.initializer.Uniform(0.07))
+        mod.init_optimizer(kvstore="tpu", optimizer="sgd",
+                           optimizer_params=(("learning_rate", 0.1),
+                                             ("momentum", 0.9)))
+        return mod
+
+    rs = np.random.RandomState(1)
+    X = rs.uniform(-1, 1, (3, 8, 3, 8, 8)).astype("float32")
+    Y = rs.randint(0, 10, (3, 8)).astype("float32")
+
+    seq = module()
+    for i in range(3):
+        seq.forward_backward(mx.io.DataBatch(
+            data=[mx.nd.array(X[i])], label=[mx.nd.array(Y[i])]))
+        seq.update()
+    seq._flush_fused()
+    sa, sx = seq.get_params()
+
+    fused = module()
+    fused.run_steps(
+        mx.io.DataBatch(data=[mx.nd.array(X)], label=[mx.nd.array(Y)]),
+        3, stacked=True)
+    fused._flush_fused()
+    fa, fx = fused.get_params()
+
+    for n in sa:
+        np.testing.assert_allclose(sa[n].asnumpy(), fa[n].asnumpy(),
+                                   rtol=2e-5, atol=2e-6, err_msg=n)
+    assert set(sx) == set(fx) and len(fx) >= 2  # moving mean + var
+    for n in sx:
+        np.testing.assert_allclose(sx[n].asnumpy(), fx[n].asnumpy(),
+                                   rtol=2e-5, atol=2e-6, err_msg=n)
+
+
+def test_run_steps_flops_estimate_matches_single_step():
+    """train_step_flops() from a run_steps-only module (cost of the
+    k-loop program / 2: scan body counted once + the peeled step) must
+    agree with the single-step AOT cost within scan-plumbing noise."""
+    X, Y = _batches(3)
+
+    single = _module()
+    single.forward_backward(mx.io.DataBatch(
+        data=[mx.nd.array(X[0])], label=[mx.nd.array(Y[0])]))
+    single.update()
+    ref = single.train_step_flops()
+    assert ref > 0
+
+    multi = _module()
+    multi.run_steps(
+        mx.io.DataBatch(data=[mx.nd.array(X)], label=[mx.nd.array(Y)]),
+        3, stacked=True)
+    est = multi.train_step_flops()
+    assert est > 0
+    assert abs(est - ref) / ref < 0.10, (est, ref)
+
+
+def test_run_steps_partial_batch_falls_back_eager():
+    """A batch the fused signature can't shard (mesh divisibility)
+    routes through the eager fallback instead of dying inside jit —
+    same behavior as forward()'s staging gate."""
+    X, Y = _batches(2)
+    mod = _module()
+    # wrong leading dim (3 != bound 16) — _stage_for_fused would still
+    # accept shape-compatible partial batches, so force ineligibility
+    # via a name mismatch instead: drop the label
+    bad = mx.io.DataBatch(data=[mx.nd.array(X[0][:3])],
+                          label=[mx.nd.array(Y[0][:3])])
+    mod.run_steps(bad, 1, stacked=False)  # must not raise
+    assert mod._fused_step is not None
+
+
+def test_run_steps_then_eager_coherent():
+    """State advanced by run_steps is visible to a following eager
+    save/get_params path (the _fused_dirty flush)."""
+    X, Y = _batches(2)
+    mod = _module()
+    mod.run_steps(
+        mx.io.DataBatch(data=[mx.nd.array(X)], label=[mx.nd.array(Y)]),
+        2, stacked=True)
+    p1 = _params(mod)  # flushes
+    mod.forward_backward(mx.io.DataBatch(
+        data=[mx.nd.array(X[0])], label=[mx.nd.array(Y[0])]))
+    mod.update()
+    p2 = _params(mod)
+    changed = any(
+        not np.array_equal(p1[n], p2[n]) for n in p1)
+    assert changed, "eager step after run_steps must keep training"

@@ -25,9 +25,15 @@ EOF
     echo "probe $i SUCCESS at $(date): $(cat /tmp/tpu_probe_r05.out)" >> "$LOG"
     echo "running bench.py (no wrapper, no timeout)" >> "$LOG"
     python bench.py > /tmp/bench_tpu_r05.json 2> /tmp/bench_tpu_r05.err
-    echo "bench rc=$? at $(date)" >> "$LOG"
+    echo "bench rc=$? at $(date): $(cat /tmp/bench_tpu_r05.json)" >> "$LOG"
+    BENCH_MULTISTEP=1 python bench.py > /tmp/bench_tpu_r05_k1.json 2> /tmp/bench_tpu_r05_k1.err
+    echo "k1 bench rc=$? at $(date): $(cat /tmp/bench_tpu_r05_k1.json)" >> "$LOG"
+    BENCH_MULTISTEP=32 python bench.py > /tmp/bench_tpu_r05_k32.json 2> /tmp/bench_tpu_r05_k32.err
+    echo "k32 bench rc=$? at $(date): $(cat /tmp/bench_tpu_r05_k32.json)" >> "$LOG"
     BENCH_DATA=recordio python bench.py > /tmp/bench_tpu_r05_io.json 2> /tmp/bench_tpu_r05_io.err
-    echo "recordio bench rc=$? at $(date)" >> "$LOG"
+    echo "recordio bench rc=$? at $(date): $(cat /tmp/bench_tpu_r05_io.json)" >> "$LOG"
+    BENCH_DATA=recordio BENCH_U8=1 python bench.py > /tmp/bench_tpu_r05_iou8.json 2> /tmp/bench_tpu_r05_iou8.err
+    echo "recordio+u8 bench rc=$? at $(date): $(cat /tmp/bench_tpu_r05_iou8.json)" >> "$LOG"
     echo "captures done at $(date)" >> "$LOG"
     exit 0
   fi

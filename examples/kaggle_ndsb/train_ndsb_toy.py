@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Plankton-style classification + Kaggle submission file
+(the reference example/kaggle-ndsb1 pipeline: gen_img_list.py builds a
+train/val split, train_dsb.py trains a convnet with augmentation,
+predict_dsb.py + submission_dsb.py score the test set and write a
+probabilities CSV — reference example/kaggle-ndsb1/train_dsb.py,
+submission_dsb.py:8-40).
+
+Synthetic stand-in for the plankton images: K classes of procedural
+grayscale organisms (ring / spike / blob / chain) with random pose,
+scale and sensor noise. The pipeline mirrors the competition flow:
+  1. synthesize a labelled train/val split and an UNLABELLED test set
+  2. train a small convnet with flip/shift augmentation
+  3. predict test-set class probabilities
+  4. write submission.csv (image id + one probability column per
+     class, rows summing to 1) and gate on val accuracy + CSV shape
+"""
+import argparse
+import csv
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+CLASSES = ("ring_protist", "spike_diatom", "blob_detritus",
+           "chain_diatom")
+S = 24  # image side
+
+
+def _draw(rs, kind):
+    img = np.zeros((S, S), np.float32)
+    yy, xx = np.mgrid[0:S, 0:S]
+    cy, cx = rs.randint(8, S - 8, 2)
+    r = rs.randint(4, 8)
+    d = np.sqrt((yy - cy) ** 2 + (xx - cx) ** 2)
+    if kind == 0:      # ring
+        img += ((d > r - 1.5) & (d < r + 1.5)).astype(np.float32)
+    elif kind == 1:    # spike: one bright diagonal
+        t = rs.uniform(0, np.pi)
+        img += (np.abs((yy - cy) * np.cos(t) - (xx - cx) * np.sin(t))
+                < 1.2).astype(np.float32) * (d < 2 * r)
+    elif kind == 2:    # blob: filled disc
+        img += (d < r).astype(np.float32) * 0.8
+    else:              # chain: three small discs in a row
+        for k in (-1, 0, 1):
+            dk = np.sqrt((yy - cy) ** 2 + (xx - cx - 3 * k) ** 2)
+            img += (dk < 2.2).astype(np.float32)
+    img += rs.randn(S, S).astype(np.float32) * 0.15
+    return np.clip(img, 0, 1.5)
+
+
+def make_set(rs, n):
+    X = np.zeros((n, 1, S, S), np.float32)
+    Y = rs.randint(0, len(CLASSES), n).astype("float32")
+    for i in range(n):
+        X[i, 0] = _draw(rs, int(Y[i]))
+    return X, Y
+
+
+def augment(rs, X):
+    """flip + 1px shift, the NDSB recipe's cheap core
+    (reference train_dsb.py: rand_mirror/rand_crop)."""
+    out = X.copy()
+    for i in range(len(out)):
+        if rs.rand() < 0.5:
+            out[i] = out[i, :, :, ::-1]
+        sy, sx = rs.randint(-1, 2, 2)
+        out[i] = np.roll(np.roll(out[i], sy, axis=1), sx, axis=2)
+    return out
+
+
+def build():
+    d = sym.Variable("data")
+    c1 = sym.Convolution(d, name="c1", num_filter=12, kernel=(3, 3),
+                         pad=(1, 1))
+    a1 = sym.Activation(c1, act_type="relu")
+    p1 = sym.Pooling(a1, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    c2 = sym.Convolution(p1, name="c2", num_filter=24, kernel=(3, 3),
+                         pad=(1, 1))
+    a2 = sym.Activation(c2, act_type="relu")
+    p2 = sym.Pooling(a2, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    fc = sym.FullyConnected(sym.Flatten(p2), name="fc",
+                            num_hidden=len(CLASSES))
+    return sym.SoftmaxOutput(fc, name="softmax")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=12)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--min-acc", type=float, default=0.9)
+    ap.add_argument("--out", default="/tmp/ndsb_submission.csv")
+    args = ap.parse_args()
+
+    mx.random.seed(42)
+    rs = np.random.RandomState(42)
+    Xtr, Ytr = make_set(rs, 512)
+    Xva, Yva = make_set(rs, 128)
+    Xte, _ = make_set(rs, 96)  # labels withheld, kaggle-style
+
+    mod = mx.mod.Module(build(), context=[mx.cpu()])
+    mod.bind(data_shapes=[("data", (args.batch_size, 1, S, S))],
+             label_shapes=[("softmax_label", (args.batch_size,))])
+    mod.init_params(mx.initializer.Xavier(factor_type="in",
+                                          magnitude=2.0))
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params=(("learning_rate", 2e-3),))
+
+    nb = len(Xtr) // args.batch_size
+    for ep in range(args.epochs):
+        perm = rs.permutation(len(Xtr))
+        for b in range(nb):
+            idx = perm[b * args.batch_size:(b + 1) * args.batch_size]
+            batch = mx.io.DataBatch(
+                data=[mx.nd.array(augment(rs, Xtr[idx]))],
+                label=[mx.nd.array(Ytr[idx])])
+            mod.forward_backward(batch)
+            mod.update()
+
+    def predict(X):
+        probs = []
+        for b in range(0, len(X), args.batch_size):
+            chunk = X[b:b + args.batch_size]
+            pad = args.batch_size - len(chunk)
+            if pad:
+                chunk = np.concatenate(
+                    [chunk, np.zeros((pad,) + chunk.shape[1:],
+                                     np.float32)])
+            mod.forward(mx.io.DataBatch(data=[mx.nd.array(chunk)]),
+                        is_train=False)
+            p = mod.get_outputs()[0].asnumpy()
+            probs.append(p[:len(X[b:b + args.batch_size])])
+        return np.concatenate(probs)
+
+    acc = float((predict(Xva).argmax(1) == Yva).mean())
+    print(f"val accuracy {acc:.3f}")
+
+    probs = predict(Xte)
+    with open(args.out, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["image"] + list(CLASSES))
+        for i, row in enumerate(probs):
+            w.writerow([f"test_{i:05d}.jpg"] +
+                       [f"{p:.6f}" for p in row])
+    print(f"submission: {args.out} ({len(probs)} rows)")
+
+    assert acc >= args.min_acc, f"val accuracy {acc} < {args.min_acc}"
+    with open(args.out) as f:
+        rows = list(csv.reader(f))
+    assert rows[0] == ["image"] + list(CLASSES)
+    assert len(rows) == len(Xte) + 1
+    body = np.array([[float(v) for v in r[1:]] for r in rows[1:]])
+    assert np.allclose(body.sum(1), 1.0, atol=1e-4)
+    print("ndsb toy pipeline done")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Module-API walkthrough: the reference example/module directory's
+two advanced recipes, end-to-end —
+  1. SequentialModule: chain a feature module into a head module
+     with gradients flowing across the seam (sequential_module.py)
+  2. PythonLossModule: a custom multiclass-hinge loss computed in
+     python, training the symbolic network below it (python_loss.py)
+
+(The directory's other scripts — mnist_mlp, lstm_bucketing — live as
+examples/image_classification and examples/rnn here.)
+
+Usage: python examples/module_api/module_walkthrough.py [--epochs N]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def make_blobs(rs, n, feat=16, classes=4):
+    """Linearly separable class blobs."""
+    y = rs.randint(0, classes, n)
+    x = rs.randn(n, feat).astype("float32") * 0.4
+    for c in range(classes):
+        x[y == c, c] += 2.0
+    return x, y.astype("float32")
+
+
+def _eval(seq, rs, batch, rounds=4):
+    """Accuracy over `rounds` fresh bound-size batches (the chain is
+    bound to one batch shape)."""
+    hits, total = 0, 0
+    for _ in range(rounds):
+        X, Y = make_blobs(rs, batch)
+        seq.forward(mx.io.DataBatch(data=[mx.nd.array(X)]),
+                    is_train=False)
+        hits += int((seq.get_outputs()[0].asnumpy().argmax(1)
+                     == Y).sum())
+        total += len(Y)
+    return hits / total
+
+
+def demo_sequential(epochs, batch):
+    """Feature MLP -> head MLP chained by SequentialModule; the chain
+    trains to blob accuracy like a monolithic net would."""
+    rs = np.random.RandomState(2)
+    feat_net = sym.Activation(sym.FullyConnected(
+        sym.Variable("data"), name="feat_fc", num_hidden=16),
+        act_type="relu")
+    head_net = sym.SoftmaxOutput(sym.FullyConnected(
+        sym.Variable("data"), name="head_fc", num_hidden=4),
+        name="softmax")
+
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(feat_net, label_names=[], context=[mx.cpu()]))
+    seq.add(mx.mod.Module(head_net, context=[mx.cpu()]),
+            take_labels=True, auto_wiring=True)
+
+    seq.bind(data_shapes=[("data", (batch, 16))],
+             label_shapes=[("softmax_label", (batch,))])
+    mx.random.seed(4)
+    seq.init_params(mx.initializer.Uniform(0.1))
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.3),))
+
+    for _ in range(epochs):
+        X, Y = make_blobs(rs, batch)
+        b = mx.io.DataBatch(data=[mx.nd.array(X)],
+                            label=[mx.nd.array(Y)])
+        seq.forward_backward(b)
+        seq.update()
+    acc = _eval(seq, rs, batch)
+    assert acc > 0.9, f"sequential chain accuracy {acc}"
+    print(f"1. SequentialModule feature->head chain: acc {acc:.2f}")
+
+
+def mc_hinge_grad(scores, labels):
+    """Crammer-Singer multiclass hinge subgradient, computed on host
+    (the reference python_loss.py recipe, numba dropped)."""
+    s = scores.asnumpy()
+    y = labels.asnumpy().astype(int)
+    n = len(y)
+    margin = 1.0 + s - s[np.arange(n), y][:, None]
+    margin[np.arange(n), y] = 0.0
+    viol = (margin > 0).astype(s.dtype)      # every violating class
+    grad = viol.copy()
+    grad[np.arange(n), y] = -viol.sum(1)     # true class pushes back
+    return grad / n
+
+
+def demo_python_loss(epochs, batch):
+    """Symbolic MLP scores + python hinge loss: gradients enter the
+    symbolic half through set_input_grads-style chaining."""
+    rs = np.random.RandomState(3)
+    scores_net = sym.FullyConnected(sym.Activation(
+        sym.FullyConnected(sym.Variable("data"), name="fc1",
+                           num_hidden=16), act_type="relu"),
+        name="fc2", num_hidden=4)
+
+    net = mx.mod.Module(scores_net, label_names=[], context=[mx.cpu()])
+    loss = mx.mod.PythonLossModule(grad_func=mc_hinge_grad)
+
+    seq = mx.mod.SequentialModule()
+    seq.add(net).add(loss, take_labels=True, auto_wiring=True)
+    seq.bind(data_shapes=[("data", (batch, 16))],
+             label_shapes=[("softmax_label", (batch,))])
+    mx.random.seed(5)
+    seq.init_params(mx.initializer.Uniform(0.1))
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.5),))
+
+    for _ in range(epochs):
+        X, Y = make_blobs(rs, batch)
+        seq.forward_backward(mx.io.DataBatch(
+            data=[mx.nd.array(X)], label=[mx.nd.array(Y)]))
+        seq.update()
+    acc = _eval(seq, rs, batch)
+    assert acc > 0.9, f"python-loss accuracy {acc}"
+    print(f"2. PythonLossModule hinge training: acc {acc:.2f}")
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=80)
+    ap.add_argument("--batch-size", type=int, default=64)
+    args = ap.parse_args()
+    demo_sequential(args.epochs, args.batch_size)
+    demo_python_loss(args.epochs, args.batch_size)
+    print("module_api walkthrough done")

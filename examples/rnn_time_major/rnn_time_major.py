@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Time-major vs batch-major RNN training
+(the reference example/rnn-time-major/rnn_cell_demo.py: the same
+char-level model laid out time-major — (T, N, C) — so per-step slices
+are contiguous, vs the batch-major default; the reference reports the
+layout as a throughput lever for its CUDA kernels).
+
+On TPU/XLA the fused RNN consumes TNC natively and the transpose is a
+compiler-visible relayout, so the demonstration here is SEMANTIC: the
+two layouts are the same model. Both variants train a copy-memory
+char task from identical seeds; the gate asserts their loss curves
+match within float tolerance AND both converge.
+
+Usage: python examples/rnn_time_major/rnn_time_major.py [--epochs N]
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+V = 8          # vocab
+T = 12         # sequence length
+H = 32         # hidden
+
+
+def make_batch(rs, n):
+    """Copy task: input is a random symbol sequence; the target is the
+    same sequence shifted right by one (predict the previous symbol)."""
+    seq = rs.randint(1, V, (n, T)).astype("float32")
+    lab = np.zeros_like(seq)
+    lab[:, 1:] = seq[:, :-1]
+    return seq, lab
+
+
+def build(time_major):
+    data = sym.Variable("data")      # (N, T) symbol ids
+    label = sym.Variable("softmax_label")
+    emb = sym.Embedding(data, input_dim=V, output_dim=16, name="emb")
+    if time_major:
+        seq = sym.transpose(emb, axes=(1, 0, 2))   # (T, N, 16)
+        rnn = sym.RNN(seq, mode="lstm", num_layers=1, state_size=H,
+                      name="lstm")                 # (T, N, H)
+        flat = sym.Reshape(rnn, shape=(-1, H))     # time-major rows
+        fc = sym.FullyConnected(flat, num_hidden=V, name="fc")
+        # back to (N, T, V) for the same label layout as batch-major
+        out = sym.transpose(sym.Reshape(fc, shape=(T, -1, V)),
+                            axes=(1, 0, 2))
+    else:
+        seq = sym.transpose(emb, axes=(1, 0, 2))
+        rnn = sym.RNN(seq, mode="lstm", num_layers=1, state_size=H,
+                      name="lstm")
+        nmaj = sym.transpose(rnn, axes=(1, 0, 2))  # (N, T, H)
+        flat = sym.Reshape(nmaj, shape=(-1, H))    # batch-major rows
+        fc = sym.FullyConnected(flat, num_hidden=V, name="fc")
+        out = sym.Reshape(fc, shape=(-1, T, V))
+    sm = sym.SoftmaxOutput(sym.Reshape(out, shape=(-1, V)),
+                           sym.Reshape(label, shape=(-1,)),
+                           name="softmax")
+    return sm
+
+
+def train(time_major, epochs, batch):
+    mx.random.seed(13)
+    rs = np.random.RandomState(13)
+    mod = mx.mod.Module(build(time_major), context=[mx.cpu()])
+    mod.bind(data_shapes=[("data", (batch, T))],
+             label_shapes=[("softmax_label", (batch, T))])
+    mod.init_params(mx.initializer.Mixed(
+        [".*_parameters", ".*_state(_cell)?$", ".*"],
+        [mx.initializer.Uniform(0.1), mx.initializer.Zero(),
+         mx.initializer.Xavier()]))
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params=(("learning_rate", 5e-3),))
+    losses = []
+    t0 = time.perf_counter()
+    for _ in range(epochs):
+        X, Y = make_batch(rs, batch)
+        b = mx.io.DataBatch(data=[mx.nd.array(X)],
+                            label=[mx.nd.array(Y)])
+        mod.forward_backward(b)
+        mod.update()
+        p = mod.get_outputs()[0].asnumpy()
+        # mean NLL of the true next symbol
+        flat_lab = Y.reshape(-1).astype(int)
+        nll = -np.log(np.maximum(
+            p[np.arange(len(flat_lab)), flat_lab], 1e-9)).mean()
+        losses.append(nll)
+    return np.array(losses), time.perf_counter() - t0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=60)
+    ap.add_argument("--batch-size", type=int, default=32)
+    args = ap.parse_args()
+
+    tm, t_tm = train(True, args.epochs, args.batch_size)
+    bm, t_bm = train(False, args.epochs, args.batch_size)
+    print(f"time-major : loss {tm[0]:.3f} -> {tm[-1]:.3f} "
+          f"({t_tm:.1f}s)")
+    print(f"batch-major: loss {bm[0]:.3f} -> {bm[-1]:.3f} "
+          f"({t_bm:.1f}s)")
+    drift = float(np.abs(tm - bm).max())
+    print(f"max per-step loss drift {drift:.2e}")
+    assert drift < 1e-3, "layouts diverged — same model, same seeds"
+    assert tm[-1] < 0.6 * tm[0], "copy task failed to learn"
+    print("rnn_time_major done")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""OCR with CTC: read a digit string off an image strip
+(the reference example/warpctc/lstm_ocr.py + toy_ctc.py role: CTC
+training where the supervision is an UNSEGMENTED symbol sequence, plus
+greedy CTC decoding for inference — reference
+example/warpctc/lstm_ocr.py:24-60, infer_ocr.py).
+
+Synthetic task: each sample renders L digits as fixed 5x4 glyph
+patterns at jittered horizontal positions on a (H=8, W=40) noisy
+strip; image COLUMNS are the time axis (the lstm_ocr trick), an LSTM
+reads them left to right, and CTCLoss aligns the per-column posteriors
+with the digit string. The gate is exact-string greedy-decode accuracy.
+
+Usage: python examples/warpctc/ocr_ctc.py [--epochs N] [--min-acc A]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+N_DIGITS = 4        # symbol ids 1..4 (0 is the CTC blank)
+L = 3               # string length
+H, W = 8, 40        # strip height (= feature size) and width (= time)
+
+# 5x4 glyphs, one per digit: distinct two-bar codes (every digit is
+# separable from every other in ANY single column, so recognition is
+# column-local and CTC carries the alignment burden — same balance as
+# the reference toy_ctc's one-hot stripes)
+_CODES = np.array([
+    [1, 1, 0, 0, 0],   # "1"
+    [0, 0, 1, 1, 0],   # "2"
+    [0, 1, 0, 0, 1],   # "3"
+    [1, 0, 0, 1, 1],   # "4"
+], np.float32)
+_GLYPHS = np.repeat(_CODES[:, :, None], 4, axis=2)  # (4, 5rows, 4cols)
+
+
+def render(rs, n):
+    strips = np.zeros((n, H, W), np.float32)
+    labels = np.zeros((n, L), np.float32)
+    for i in range(n):
+        digits = rs.randint(1, N_DIGITS + 1, L)
+        labels[i] = digits
+        x = rs.randint(0, 3)
+        for d in digits:
+            x += rs.randint(1, 4)           # gap
+            if x + 4 >= W:
+                break
+            strips[i, 1:6, x:x + 4] += _GLYPHS[d - 1]
+            x += 4
+    strips += rs.randn(n, H, W).astype(np.float32) * 0.05
+    return strips, labels
+
+
+def greedy_decode(post):
+    """(T, N, C) posteriors -> list of symbol strings: argmax per
+    frame, collapse repeats, drop blanks (id 0)."""
+    ids = post.argmax(axis=2)  # (T, N)
+    out = []
+    for i in range(ids.shape[1]):
+        prev, s = -1, []
+        for t in range(ids.shape[0]):
+            c = int(ids[t, i])
+            if c != prev and c != 0:
+                s.append(c)
+            prev = c
+        out.append(tuple(s))
+    return out
+
+
+def build():
+    data = sym.Variable("data")              # (N, H, W)
+    label = sym.Variable("label")            # (N, L)
+    # columns as time: (N, H, W) -> (W, N, H), then the fused LSTM
+    seq = sym.transpose(data, axes=(2, 0, 1))
+    rnn = sym.RNN(seq, mode="lstm", num_layers=1, state_size=48,
+                  name="lstm")
+    flat = sym.Reshape(rnn, shape=(-1, 48))
+    fc = sym.FullyConnected(flat, num_hidden=N_DIGITS + 1, name="fc")
+    act = sym.Reshape(fc, shape=(W, -1, N_DIGITS + 1))
+    return sym.CTCLoss(act, label, name="ctc"), act
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=60)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--min-acc", type=float, default=0.85)
+    args = ap.parse_args()
+
+    mx.random.seed(7)
+    rs = np.random.RandomState(7)
+    loss_sym, act_sym = build()
+    net = sym.Group([sym.MakeLoss(loss_sym),
+                     sym.BlockGrad(sym.softmax(act_sym, axis=2))])
+
+    mod = mx.mod.Module(net, data_names=("data",),
+                        label_names=("label",), context=[mx.cpu()])
+    mod.bind(data_shapes=[("data", (args.batch_size, H, W))],
+             label_shapes=[("label", (args.batch_size, L))])
+    # the fused RNN packed blob is 1-D — Xavier cannot scale it
+    mod.init_params(mx.initializer.Mixed(
+        [".*_parameters", ".*_state(_cell)?$", ".*"],
+        [mx.initializer.Uniform(0.1), mx.initializer.Zero(),
+         mx.initializer.Xavier()]))
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params=(("learning_rate", 0.01),))
+
+    first = tot = float("nan")
+    for ep in range(args.epochs):
+        tot = 0.0
+        for _ in range(8):
+            X, Y = render(rs, args.batch_size)
+            b = mx.io.DataBatch(data=[mx.nd.array(X)],
+                                label=[mx.nd.array(Y)])
+            mod.forward_backward(b)
+            mod.update()
+            tot += float(mod.get_outputs()[0].asnumpy().mean())
+        tot /= 8
+        if ep == 0:
+            first = tot
+        print(f"epoch {ep}: ctc loss {tot:.4f}")
+
+    # greedy-decode exact-match accuracy on fresh strips
+    X, Y = render(rs, args.batch_size)
+    mod.forward(mx.io.DataBatch(data=[mx.nd.array(X)],
+                                label=[mx.nd.array(Y)]),
+                is_train=False)
+    post = mod.get_outputs()[1].asnumpy()  # (T, N, C)
+    hyp = greedy_decode(post)
+    want = [tuple(int(d) for d in row if d) for row in Y]
+    acc = float(np.mean([h == w for h, w in zip(hyp, want)]))
+    print(f"decode exact-match {acc:.2f} (loss {first:.1f} -> {tot:.1f})")
+    assert acc >= args.min_acc, f"decode accuracy {acc} < {args.min_acc}"
+
+
+if __name__ == "__main__":
+    main()

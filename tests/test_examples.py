@@ -53,6 +53,12 @@ EXAMPLES = {
     "memcost/memcost.py": [],
     "plugins/torch_caffe_ops.py": ["--epochs", "10"],
     "dec/dec_cluster.py": [],
+    "warpctc/ocr_ctc.py": ["--epochs", "50", "--min-acc", "0.8"],
+    "kaggle_ndsb/train_ndsb_toy.py": [
+        "--epochs", "8", "--min-acc", "0.85"],
+    "rnn_time_major/rnn_time_major.py": [],
+    "python_howto/howto_walkthrough.py": [],
+    "module_api/module_walkthrough.py": [],
 }
 
 

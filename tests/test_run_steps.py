@@ -231,6 +231,30 @@ def test_run_steps_partial_batch_falls_back_eager():
     assert mod._fused_step is not None
 
 
+def test_fit_steps_per_dispatch_parity():
+    """Module.fit(steps_per_dispatch=2) trains the same trajectory as
+    the default per-batch loop (same iterator order, same seeds) —
+    including a non-multiple epoch remainder."""
+    rs = np.random.RandomState(4)
+    X = rs.uniform(-1, 1, (80, 20)).astype("float32")  # 5 batches of 16
+    Y = rs.randint(0, 10, (80,)).astype("float32")
+
+    def fit(k):
+        it = mx.io.NDArrayIter(X, Y, batch_size=16, shuffle=False,
+                               label_name="softmax_label")
+        mod = mx.mod.Module(_mlp(), context=[mx.cpu()])
+        mx.random.seed(21)
+        mod.fit(it, num_epoch=2, kvstore="tpu", optimizer="sgd",
+                optimizer_params=(("learning_rate", 0.1),
+                                  ("momentum", 0.9)),
+                initializer=mx.initializer.Uniform(0.07),
+                steps_per_dispatch=k)
+        a, _ = mod.get_params()
+        return {n: v.asnumpy() for n, v in a.items()}
+
+    _assert_same(fit(1), fit(2))
+
+
 def test_run_steps_then_eager_coherent():
     """State advanced by run_steps is visible to a following eager
     save/get_params path (the _fused_dirty flush)."""

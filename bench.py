@@ -122,6 +122,14 @@ def _synth_recordio(n, classes, side=(280, 320)):
 
 
 def main():
+    # BENCH_XLA_FLAGS: extra XLA flags for A/B capture runs (e.g.
+    # "--xla_tpu_enable_latency_hiding_scheduler=true"); appended
+    # before jax import so the backend sees them.
+    extra_flags = os.environ.get("BENCH_XLA_FLAGS", "")
+    if extra_flags:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + extra_flags).strip()
+
     # The real chip registers as platform "axon" (tunnel), not "tpu" —
     # anything non-cpu counts as the accelerator.
     platform = _probe_platform()

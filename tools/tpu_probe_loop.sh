@@ -35,8 +35,12 @@ EOF
     BENCH_DATA=recordio BENCH_U8=1 python bench.py > /tmp/bench_tpu_r05_iou8.json 2> /tmp/bench_tpu_r05_iou8.err
     echo "recordio+u8 bench rc=$? at $(date): $(cat /tmp/bench_tpu_r05_iou8.json)" >> "$LOG"
     echo "captures done at $(date)" >> "$LOG"
+    # persist the artifacts where the repo (and the next session) can
+    # see them even after /tmp is wiped
+    mkdir -p /root/repo/bench_artifacts
+    cp /tmp/bench_tpu_r05*.json /tmp/tpu_probe_r05.log /root/repo/bench_artifacts/ 2>> "$LOG"
     exit 0
   fi
   echo "probe $i failed (rc=$rc) at $(date)" >> "$LOG"
-  sleep 600
+  sleep 420
 done

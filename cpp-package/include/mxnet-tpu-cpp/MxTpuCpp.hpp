@@ -15,6 +15,7 @@
 #define MXNET_TPU_CPP_MXTPUCPP_HPP_
 
 #include <map>
+#include <memory>
 #include <stdexcept>
 #include <string>
 #include <utility>
@@ -71,10 +72,13 @@ KwPtrs(const KWArgs& kw) {
   return {std::move(keys), std::move(vals)};
 }
 
+// Copy-shared device array handle (the reference's NDArray is a
+// shared_ptr-like chunk reference too, python/mxnet/ndarray.py): a
+// copy is another reference to the SAME device buffer, freed once.
 class NDArray {
  public:
   NDArray() = default;
-  explicit NDArray(void* raw) : h_(raw) {}
+  explicit NDArray(void* raw) : h_(std::make_shared<Handle>(raw)) {}
   NDArray(const std::vector<int>& shape,
           const std::vector<float>& data) {
     void* out = nullptr;
@@ -82,7 +86,7 @@ class NDArray {
                              static_cast<int>(shape.size()),
                              data.data(), &out),
           "NDArrayCreate");
-    h_ = Handle(out);
+    h_ = std::make_shared<Handle>(out);
   }
   static NDArray Zeros(const std::vector<int>& shape) {
     void* out = nullptr;
@@ -95,12 +99,12 @@ class NDArray {
   std::vector<int> Shape() const {
     int ndim = 0;
     std::vector<int> dims(16);
-    Check(MXTpuNDArrayGetShape(h_.get(), dims.data(),
+    Check(MXTpuNDArrayGetShape(get(), dims.data(),
                                static_cast<int>(dims.size()), &ndim),
           "NDArrayGetShape");
     if (ndim > static_cast<int>(dims.size())) {
       dims.resize(static_cast<size_t>(ndim));
-      Check(MXTpuNDArrayGetShape(h_.get(), dims.data(), ndim, &ndim),
+      Check(MXTpuNDArrayGetShape(get(), dims.data(), ndim, &ndim),
             "NDArrayGetShape");
     }
     dims.resize(static_cast<size_t>(ndim));
@@ -111,21 +115,21 @@ class NDArray {
     long n = 1;
     for (int d : Shape()) n *= d;
     std::vector<float> buf(static_cast<size_t>(n));
-    Check(MXTpuNDArrayCopyOut(h_.get(), buf.data(), n) < 0 ? -1 : 0,
+    Check(MXTpuNDArrayCopyOut(get(), buf.data(), n) < 0 ? -1 : 0,
           "NDArrayCopyOut");
     return buf;
   }
 
   void Set(const std::vector<float>& data) {
-    Check(MXTpuNDArrayCopyIn(h_.get(), data.data(),
+    Check(MXTpuNDArrayCopyIn(get(), data.data(),
                              static_cast<long>(data.size())),
           "NDArrayCopyIn");
   }
 
-  void* get() const { return h_.get(); }
+  void* get() const { return h_ ? h_->get() : nullptr; }
 
  private:
-  Handle h_;
+  std::shared_ptr<Handle> h_;
 };
 
 // Imperative op call producing new arrays.

@@ -1,7 +1,7 @@
 #!/bin/bash
 # Persistent TPU tunnel probe (VERDICT r4 next-round #1).
 #
-# Every 10 minutes, probe the axon TPU platform in a throwaway
+# Every 7 minutes, probe the axon TPU platform in a throwaway
 # subprocess (safe to kill: it only dials, never compiles).  The
 # moment the tunnel answers, run the real-chip captures UNMODIFIED and
 # NOT under any kill-prone wrapper (the round-3 wedge root cause):
@@ -38,7 +38,11 @@ EOF
     # persist the artifacts where the repo (and the next session) can
     # see them even after /tmp is wiped
     mkdir -p /root/repo/bench_artifacts
-    cp /tmp/bench_tpu_r05*.json /tmp/tpu_probe_r05.log /root/repo/bench_artifacts/ 2>> "$LOG"
+    if ! cp /tmp/bench_tpu_r05*.json /tmp/bench_tpu_r05*.err \
+         /tmp/tpu_probe_r05.log /root/repo/bench_artifacts/ 2>> "$LOG"; then
+      echo "artifact copy FAILED at $(date)" >> "$LOG"
+      echo "artifact copy FAILED" >&2
+    fi
     exit 0
   fi
   echo "probe $i failed (rc=$rc) at $(date)" >> "$LOG"

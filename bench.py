@@ -204,6 +204,11 @@ def main():
     # resident synthetic batch (pure-compute MFU). BENCH_REC points at
     # an existing .rec; otherwise an ImageNet-shaped one is synthesized.
     data_mode = os.environ.get("BENCH_DATA", "synthetic")
+    if data_mode not in ("synthetic", "recordio"):
+        sys.stderr.write(
+            f"bench: unknown BENCH_DATA={data_mode!r} — "
+            "using synthetic\n")
+        data_mode = "synthetic"
     rs = np.random.RandomState(0)
     if data_mode == "recordio":
         rec_path = os.environ.get("BENCH_REC") or _synth_recordio(
@@ -253,28 +258,38 @@ def main():
     # BENCH_MULTISTEP=k compiles a device-side k-step loop
     # (Module.run_steps: lax.scan over the fused step) so ONE dispatch
     # advances k optimizer steps — per-dispatch host/tunnel round-trip
-    # amortizes k-fold. Default on the accelerator: 8 (synthetic mode
-    # feeds k distinct resident batches through the scan, so the math
-    # is a real k-step training trajectory, not one batch replayed).
+    # amortizes k-fold. Default on the accelerator: 8. Synthetic mode
+    # feeds k distinct RESIDENT batches through the scan; recordio
+    # mode host-stacks k fresh iterator batches per dispatch (one
+    # upload of k batches instead of k dispatches), so both modes
+    # train a real k-step trajectory, never one batch replayed.
     multistep = int(os.environ.get(
-        "BENCH_MULTISTEP",
-        "8" if (on_accel and data_mode == "synthetic") else "1"))
-    if multistep > 1 and data_mode != "synthetic":
-        sys.stderr.write(
-            "bench: BENCH_MULTISTEP ignored with BENCH_DATA=%s — the "
-            "k-step device loop needs resident batches\n" % data_mode)
-    if multistep > 1 and data_mode == "synthetic":
-        Xs = rs.uniform(-1, 1, (multistep,) + dshape).astype("float32")
-        Ys = rs.randint(0, classes, (multistep, batch)).astype("float32")
-        stacked = mx.io.DataBatch(data=[mx.nd.array(Xs, ctx=ctx)],
-                                  label=[mx.nd.array(Ys, ctx=ctx)])
+        "BENCH_MULTISTEP", "8" if on_accel else "1"))
+    if multistep > 1:
+        if data_mode == "synthetic":
+            Xs = rs.uniform(
+                -1, 1, (multistep,) + dshape).astype("float32")
+            Ys = rs.randint(
+                0, classes, (multistep, batch)).astype("float32")
+            stacked = mx.io.DataBatch(
+                data=[mx.nd.array(Xs, ctx=ctx)],
+                label=[mx.nd.array(Ys, ctx=ctx)])
+            next_group = lambda: stacked  # noqa: E731
+        else:
+            def next_group():
+                bs = [next_batch() for _ in range(multistep)]
+                X = np.stack([b.data[0].asnumpy() for b in bs])
+                Y = np.stack([b.label[0].asnumpy() for b in bs])
+                return mx.io.DataBatch(
+                    data=[mx.nd.array(X, ctx=ctx)],
+                    label=[mx.nd.array(Y, ctx=ctx)])
         # warmup / compile (the k-loop is the only program compiled)
-        mod.run_steps(stacked, multistep, stacked=True)
+        mod.run_steps(next_group(), multistep, stacked=True)
         mod.sync()
         iters = max(multistep, (iters // multistep) * multistep)
         t0 = time.perf_counter()
         for _ in range(iters // multistep):
-            mod.run_steps(stacked, multistep, stacked=True)
+            mod.run_steps(next_group(), multistep, stacked=True)
         mod.sync()
         dt = time.perf_counter() - t0
     else:
@@ -307,7 +322,8 @@ def main():
     _emit({
         "metric": f"resnet{num_layers}_train_throughput_{platform}"
                   f"_b{batch}_{dtype}_{layout.lower()}"
-                  + ("_recio" if data_mode == "recordio" else ""),
+                  + ("_recio" if data_mode == "recordio" else "")
+                  + (f"_k{multistep}" if multistep > 1 else ""),
         "value": round(img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(vs, 3),

@@ -35,9 +35,15 @@ EOF
     BENCH_DATA=recordio BENCH_U8=1 python bench.py > /tmp/bench_tpu_r05_iou8.json 2> /tmp/bench_tpu_r05_iou8.err
     echo "recordio+u8 bench rc=$? at $(date): $(cat /tmp/bench_tpu_r05_iou8.json)" >> "$LOG"
     echo "captures done at $(date)" >> "$LOG"
+    # profiled short run LAST (tracing skews throughput, so never
+    # before the real captures): merged trace + per-step walls for
+    # the optimization queue
+    python tools/tpu_profile_capture.py > /tmp/bench_tpu_r05_prof.out 2>&1
+    echo "profile capture rc=$? at $(date)" >> "$LOG"
     # persist the artifacts where the repo (and the next session) can
     # see them even after /tmp is wiped
     mkdir -p /root/repo/bench_artifacts
+    cp /tmp/bench_tpu_r05_prof.out /root/repo/bench_artifacts/ 2>> "$LOG"
     if ! cp /tmp/bench_tpu_r05*.json /tmp/bench_tpu_r05*.err \
          /tmp/tpu_probe_r05.log /root/repo/bench_artifacts/ 2>> "$LOG"; then
       echo "artifact copy FAILED at $(date)" >> "$LOG"

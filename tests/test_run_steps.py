@@ -255,6 +255,42 @@ def test_fit_steps_per_dispatch_parity():
     _assert_same(fit(1), fit(2))
 
 
+def test_fit_steps_per_dispatch_variable_shapes():
+    """A group with mismatched batch shapes (bucketing-style iterator)
+    must fall back to per-batch training, not crash in jnp.stack."""
+    class VarIter(mx.io.DataIter):
+        def __init__(self):
+            super().__init__()
+            self.batch_size = 16
+            self._i = 0
+            self._rs = np.random.RandomState(0)
+            self.provide_data = [("data", (16, 20))]
+            self.provide_label = [("softmax_label", (16,))]
+
+        def reset(self):
+            self._i = 0
+
+        def next(self):
+            if self._i >= 4:
+                raise StopIteration
+            self._i += 1
+            n = 16 if self._i % 2 else 8  # alternating batch rows
+            return mx.io.DataBatch(
+                data=[mx.nd.array(self._rs.uniform(
+                    -1, 1, (n, 20)).astype("float32"))],
+                label=[mx.nd.array(self._rs.randint(
+                    0, 10, (n,)).astype("float32"))])
+
+    mod = mx.mod.Module(_mlp(), context=[mx.cpu()])
+    mx.random.seed(9)
+    mod.fit(VarIter(), num_epoch=1, kvstore="tpu", optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.1),),
+            initializer=mx.initializer.Uniform(0.07),
+            steps_per_dispatch=2)  # must not raise
+    a, _ = mod.get_params()
+    assert all(np.isfinite(v.asnumpy()).all() for v in a.values())
+
+
 def test_run_steps_then_eager_coherent():
     """State advanced by run_steps is visible to a following eager
     save/get_params path (the _fused_dirty flush)."""

@@ -147,6 +147,20 @@ def main():
         except Exception:
             pass
 
+    # Persistent compilation cache: the capture sequence runs bench.py
+    # several times with identical shapes — each run after the first
+    # should deserialize the executable instead of paying the (remote)
+    # XLA compile again. Harmless if the backend rejects it.
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                           "/tmp/mxnet_tpu_jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          5.0)
+    except Exception:
+        pass
+
     import jax.numpy as jnp
     import numpy as np
 

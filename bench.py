@@ -152,10 +152,16 @@ def main():
     # should deserialize the executable instead of paying the (remote)
     # XLA compile again. Harmless if the backend rejects it.
     try:
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.environ.get("JAX_COMPILATION_CACHE_DIR",
-                           "/tmp/mxnet_tpu_jax_cache"))
+        cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                   "/tmp/mxnet_tpu_jax_cache")
+        if extra_flags:
+            # A/B flag runs must not share executables with the
+            # baseline: backend-side flags may not enter jax's cache
+            # key, so give each flag set its own directory
+            import hashlib
+            cache_dir += "_" + hashlib.sha1(
+                extra_flags.encode()).hexdigest()[:12]
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           5.0)
     except Exception:

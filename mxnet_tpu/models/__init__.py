@@ -8,6 +8,7 @@ headline: ResNet-50 throughput + MFU).
 from .mlp import get_mlp
 from .lenet import get_lenet
 from .resnet import get_resnet
+from .resnext import get_resnext
 from .alexnet import get_alexnet
 from .googlenet import get_googlenet
 from .inception import get_inception_bn

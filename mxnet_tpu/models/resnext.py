@@ -1,0 +1,101 @@
+"""ResNeXt: aggregated residual transformations (Xie et al. 2017)
+(reference example/image-classification/symbols/resnext.py — the
+post-activation bottleneck whose 3x3 runs at half width split into
+`num_group` grouped paths).
+
+TPU notes: grouped convolution lowers to XLA `feature_group_count`,
+which tiles each group's contraction on the MXU directly — no
+concat-of-slices emulation. NHWC keeps channels on the lane
+dimension; groups of 4 (=128/32) lanes per path at ImageNet widths
+stay MXU-aligned (the classic 32x4d config).
+"""
+from .. import symbol as sym
+
+
+def _unit(data, num_filter, stride, dim_match, name, num_group,
+          bn_mom, layout):
+    """Post-activation bottleneck unit (conv-bn-relu x3 + identity),
+    grouped 3x3 in the middle."""
+    ax = layout.index("C")
+
+    def conv_bn(x, nf, kernel, stride, pad, cname, bname, group=1,
+                act=True):
+        c = sym.Convolution(
+            x, name=name + cname, num_filter=nf, kernel=kernel,
+            stride=stride, pad=pad, num_group=group, no_bias=True,
+            layout=layout)
+        b = sym.BatchNorm(c, name=name + bname, fix_gamma=False,
+                          eps=2e-5, momentum=bn_mom, axis=ax)
+        return sym.Activation(b, act_type="relu") if act else b
+
+    mid = num_filter // 2
+    body = conv_bn(data, mid, (1, 1), (1, 1), (0, 0),
+                   "_conv1", "_bn1")
+    body = conv_bn(body, mid, (3, 3), stride, (1, 1),
+                   "_conv2", "_bn2", group=num_group)
+    body = conv_bn(body, num_filter, (1, 1), (1, 1), (0, 0),
+                   "_conv3", "_bn3", act=False)
+    if dim_match:
+        shortcut = data
+    else:
+        shortcut = conv_bn(data, num_filter, (1, 1), stride, (0, 0),
+                           "_sc", "_sc_bn", act=False)
+    return sym.Activation(body + shortcut, act_type="relu",
+                          name=name + "_relu_out")
+
+
+_CONFIGS = {
+    # layers: (units per stage, stage filters)
+    26: ([2, 2, 2, 2], [256, 512, 1024, 2048]),
+    50: ([3, 4, 6, 3], [256, 512, 1024, 2048]),
+    101: ([3, 4, 23, 3], [256, 512, 1024, 2048]),
+}
+
+
+def get_resnext(num_classes=1000, num_layers=50,
+                image_shape=(3, 224, 224), num_group=32,
+                layout="NCHW", bn_mom=0.9):
+    """Build a ResNeXt-(26|50|101) (32x4d-style) classifier Symbol."""
+    if num_layers not in _CONFIGS:
+        raise ValueError(f"no ResNeXt-{num_layers} config")
+    if layout not in ("NCHW", "NHWC"):
+        raise ValueError(f"layout must be NCHW or NHWC, got {layout!r}")
+    units, filters = _CONFIGS[num_layers]
+    if (filters[0] // 2) % num_group:
+        raise ValueError(
+            f"num_group={num_group} must divide the narrowest grouped "
+            f"width {filters[0] // 2}")
+    ax = layout.index("C")
+    small = image_shape[1] <= 32
+
+    data = sym.Variable("data")
+    data = sym.BatchNorm(data, name="bn_data", fix_gamma=True,
+                         eps=2e-5, axis=ax)
+    if small:  # CIFAR-style stem
+        body = sym.Convolution(
+            data, name="conv0", num_filter=64, kernel=(3, 3),
+            stride=(1, 1), pad=(1, 1), no_bias=True, layout=layout)
+    else:
+        body = sym.Convolution(
+            data, name="conv0", num_filter=64, kernel=(7, 7),
+            stride=(2, 2), pad=(3, 3), no_bias=True, layout=layout)
+        body = sym.BatchNorm(body, name="bn0", fix_gamma=False,
+                             eps=2e-5, momentum=bn_mom, axis=ax)
+        body = sym.Activation(body, act_type="relu", name="relu0")
+        body = sym.Pooling(body, kernel=(3, 3), stride=(2, 2),
+                           pad=(1, 1), pool_type="max", layout=layout)
+
+    for i, (n, nf) in enumerate(zip(units, filters)):
+        stride = (1, 1) if i == 0 else (2, 2)
+        body = _unit(body, nf, stride, False, f"stage{i+1}_unit1",
+                     num_group, bn_mom, layout)
+        for j in range(2, n + 1):
+            body = _unit(body, nf, (1, 1), True,
+                         f"stage{i+1}_unit{j}", num_group,
+                         bn_mom, layout)
+
+    pool = sym.Pooling(body, global_pool=True, pool_type="avg",
+                       kernel=(7, 7), name="pool1", layout=layout)
+    flat = sym.Flatten(pool)
+    fc = sym.FullyConnected(flat, num_hidden=num_classes, name="fc1")
+    return sym.SoftmaxOutput(fc, name="softmax")

@@ -142,6 +142,49 @@ def test_googlenet_builds_and_runs():
     assert out[0].shape == (1, 11)
 
 
+def test_resnext_builds_trains_and_groups():
+    """ResNeXt (models/resnext.py): canonical 224^2 shapes, grouped
+    3x3 weight shape ((mid, mid/groups, 3, 3) — the aggregated-paths
+    signature), and a small training step that moves grouped-conv
+    weights in both layouts."""
+    net = models.get_resnext(num_classes=13, num_layers=50)
+    args, outs, _ = net.infer_shape(data=(2, 3, 224, 224))
+    assert outs == [(2, 13)]
+    shapes = dict(zip(net.list_arguments(), args))
+    # stage1 bottleneck: filter 256 -> mid 128, 32 groups -> 4-chan in
+    assert shapes["stage1_unit1_conv2_weight"] == (128, 4, 3, 3)
+
+    for layout in ("NCHW", "NHWC"):
+        net = models.get_resnext(num_classes=5, num_layers=26,
+                                 image_shape=(3, 32, 32), num_group=8,
+                                 layout=layout)
+        dshape = (4, 3, 32, 32) if layout == "NCHW" else (4, 32, 32, 3)
+        mod = mx.mod.Module(net, context=[mx.cpu()])
+        mod.bind(data_shapes=[("data", dshape)],
+                 label_shapes=[("softmax_label", (4,))])
+        mx.random.seed(3)
+        mod.init_params(mx.initializer.Xavier(factor_type="in",
+                                              magnitude=2.0))
+        mod.init_optimizer(kvstore="tpu", optimizer="sgd",
+                           optimizer_params=(("learning_rate", 0.1),))
+        rs = np.random.RandomState(0)
+        b = mx.io.DataBatch(
+            data=[mx.nd.array(rs.uniform(-1, 1, dshape)
+                              .astype("float32"))],
+            label=[mx.nd.array(rs.randint(0, 5, (4,))
+                               .astype("float32"))])
+        before = mod.get_params()[0][
+            "stage1_unit1_conv1_weight"].asnumpy().copy()
+        mod.forward_backward(b)
+        mod.update()
+        mod._flush_fused()
+        after = mod.get_params()[0][
+            "stage1_unit1_conv1_weight"].asnumpy()
+        assert np.abs(after - before).max() > 0
+        out = mod.get_outputs()[0].asnumpy()
+        assert out.shape == (4, 5) and np.isfinite(out).all()
+
+
 def test_big_zoo_shapes():
     """AlexNet/VGG/Inception-BN/GoogLeNet infer end-to-end shapes at
     the canonical 224^2 input (reference symbol_*.py zoo)."""

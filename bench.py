@@ -154,13 +154,15 @@ def main():
     try:
         cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR",
                                    "/tmp/mxnet_tpu_jax_cache")
-        if extra_flags:
-            # A/B flag runs must not share executables with the
-            # baseline: backend-side flags may not enter jax's cache
-            # key, so give each flag set its own directory
+        merged_flags = os.environ.get("XLA_FLAGS", "")
+        if merged_flags:
+            # A/B flag runs (BENCH_XLA_FLAGS or raw XLA_FLAGS) must
+            # not share executables with the baseline: backend-side
+            # flags may not enter jax's cache key, so every flag set
+            # gets its own directory
             import hashlib
             cache_dir += "_" + hashlib.sha1(
-                extra_flags.encode()).hexdigest()[:12]
+                merged_flags.encode()).hexdigest()[:12]
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           5.0)

@@ -13,6 +13,7 @@ from .alexnet import get_alexnet
 from .googlenet import get_googlenet
 from .inception import get_inception_bn
 from .inception_v3 import get_inception_v3
+from .inception_resnet_v2 import get_inception_resnet_v2
 from .vgg import get_vgg
 from .lstm_lm import get_lstm_lm, lstm_lm_sym_gen
 from .ssd import get_ssd_train, get_ssd_detect

@@ -142,6 +142,33 @@ def test_googlenet_builds_and_runs():
     assert out[0].shape == (1, 11)
 
 
+def _train_one_step(net, dshape, classes, probe_weight, lr=0.1,
+                    seed=3):
+    """Bind the net through the fused product path, run one
+    forward_backward+update on random data, and return the probed
+    weight (before, after) plus the outputs."""
+    mod = mx.mod.Module(net, context=[mx.cpu()])
+    mod.bind(data_shapes=[("data", dshape)],
+             label_shapes=[("softmax_label", (dshape[0],))])
+    mx.random.seed(seed)
+    mod.init_params(mx.initializer.Xavier(factor_type="in",
+                                          magnitude=2.0))
+    mod.init_optimizer(kvstore="tpu", optimizer="sgd",
+                       optimizer_params=(("learning_rate", lr),))
+    rs = np.random.RandomState(0)
+    b = mx.io.DataBatch(
+        data=[mx.nd.array(rs.uniform(-1, 1, dshape)
+                          .astype("float32"))],
+        label=[mx.nd.array(rs.randint(0, classes, (dshape[0],))
+                           .astype("float32"))])
+    before = mod.get_params()[0][probe_weight].asnumpy().copy()
+    mod.forward_backward(b)
+    mod.update()
+    mod._flush_fused()
+    after = mod.get_params()[0][probe_weight].asnumpy()
+    return before, after, mod.get_outputs()[0].asnumpy()
+
+
 def test_resnext_builds_trains_and_groups():
     """ResNeXt (models/resnext.py): canonical 224^2 shapes, grouped
     3x3 weight shape ((mid, mid/groups, 3, 3) — the aggregated-paths
@@ -159,30 +186,50 @@ def test_resnext_builds_trains_and_groups():
                                  image_shape=(3, 32, 32), num_group=8,
                                  layout=layout)
         dshape = (4, 3, 32, 32) if layout == "NCHW" else (4, 32, 32, 3)
-        mod = mx.mod.Module(net, context=[mx.cpu()])
-        mod.bind(data_shapes=[("data", dshape)],
-                 label_shapes=[("softmax_label", (4,))])
-        mx.random.seed(3)
-        mod.init_params(mx.initializer.Xavier(factor_type="in",
-                                              magnitude=2.0))
-        mod.init_optimizer(kvstore="tpu", optimizer="sgd",
-                           optimizer_params=(("learning_rate", 0.1),))
-        rs = np.random.RandomState(0)
-        b = mx.io.DataBatch(
-            data=[mx.nd.array(rs.uniform(-1, 1, dshape)
-                              .astype("float32"))],
-            label=[mx.nd.array(rs.randint(0, 5, (4,))
-                               .astype("float32"))])
-        before = mod.get_params()[0][
-            "stage1_unit1_conv1_weight"].asnumpy().copy()
-        mod.forward_backward(b)
-        mod.update()
-        mod._flush_fused()
-        after = mod.get_params()[0][
-            "stage1_unit1_conv1_weight"].asnumpy()
+        before, after, out = _train_one_step(
+            net, dshape, 5, "stage1_unit1_conv1_weight")
         assert np.abs(after - before).max() > 0
-        out = mod.get_outputs()[0].asnumpy()
         assert out.shape == (4, 5) and np.isfinite(out).all()
+
+
+def test_inception_resnet_v2_builds_and_trains():
+    """Inception-ResNet-v2 (models/inception_resnet_v2.py): canonical
+    299^2 shapes at full depth; a shrunk (1,1,1)-repeat variant runs a
+    training step with finite outputs and moving scaled-residual
+    projection weights."""
+    net = models.get_inception_resnet_v2(num_classes=7)
+    args, outs, _ = net.infer_shape(data=(1, 3, 299, 299))
+    assert outs == [(1, 7)]
+    shapes = dict(zip(net.list_arguments(), args))
+    assert shapes["b35_1_proj_conv_weight"] == (320, 128, 1, 1)
+    assert shapes["b17_1_proj_conv_weight"] == (1088, 384, 1, 1)
+    assert shapes["b8_final_proj_conv_weight"] == (2080, 448, 1, 1)
+
+    small = models.get_inception_resnet_v2(
+        num_classes=4, repeats=(1, 1, 1), dropout=0.0)
+    mod = mx.mod.Module(small, context=[mx.cpu()])
+    mod.bind(data_shapes=[("data", (2, 3, 299, 299))],
+             label_shapes=[("softmax_label", (2,))])
+    mx.random.seed(6)
+    mod.init_params(mx.initializer.Xavier(factor_type="in",
+                                          magnitude=2.0))
+    mod.init_optimizer(kvstore="tpu", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.05),))
+    rs = np.random.RandomState(1)
+    b = mx.io.DataBatch(
+        data=[mx.nd.array(rs.uniform(-1, 1, (2, 3, 299, 299))
+                          .astype("float32"))],
+        label=[mx.nd.array(rs.randint(0, 4, (2,))
+                           .astype("float32"))])
+    before = mod.get_params()[0]["b35_1_proj_conv_weight"] \
+        .asnumpy().copy()
+    mod.forward_backward(b)
+    mod.update()
+    mod._flush_fused()
+    after = mod.get_params()[0]["b35_1_proj_conv_weight"].asnumpy()
+    assert np.abs(after - before).max() > 0
+    out = mod.get_outputs()[0].asnumpy()
+    assert out.shape == (2, 4) and np.isfinite(out).all()
 
 
 def test_big_zoo_shapes():

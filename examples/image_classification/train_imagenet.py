@@ -27,7 +27,17 @@ def parse_args():
     ap.add_argument("--data-train", default=None,
                     help="RecordIO file (tools/im2rec.py); synthetic "
                          "data when omitted")
+    ap.add_argument("--network", default="resnet",
+                    choices=["resnet", "resnext",
+                             "inception-resnet-v2"],
+                    help="model family (reference train_imagenet.py "
+                         "--network)")
     ap.add_argument("--num-layers", type=int, default=50)
+    ap.add_argument("--num-group", type=int, default=32,
+                    help="resnext cardinality")
+    ap.add_argument("--steps-per-dispatch", type=int, default=1,
+                    help="k training steps per device dispatch "
+                         "(Module.run_steps; docs/perf.md)")
     ap.add_argument("--num-classes", type=int, default=1000)
     ap.add_argument("--image-shape", default="3,224,224")
     ap.add_argument("--batch-size", type=int, default=256)
@@ -119,7 +129,7 @@ def main():
     import jax.numpy as jnp
 
     import mxnet_tpu as mx
-    from mxnet_tpu.models import get_resnet
+    from mxnet_tpu import models
 
     c, h, w = (int(v) for v in args.image_shape.split(","))
     on_accel = mx.default_context().device_type == "tpu" and \
@@ -128,9 +138,21 @@ def main():
         "space_to_depth" if args.layout == "NHWC" and h > 32
         else "standard")
 
-    net = get_resnet(num_classes=args.num_classes,
-                     num_layers=args.num_layers, image_shape=(c, h, w),
-                     layout=args.layout, stem=stem)
+    if args.network == "resnext":
+        net = models.get_resnext(
+            num_classes=args.num_classes, num_layers=args.num_layers,
+            image_shape=(c, h, w), num_group=args.num_group,
+            layout=args.layout)
+    elif args.network == "inception-resnet-v2":
+        if args.layout != "NCHW":
+            raise SystemExit(
+                "inception-resnet-v2 is NCHW-only here")
+        net = models.get_inception_resnet_v2(
+            num_classes=args.num_classes)
+    else:
+        net = models.get_resnet(
+            num_classes=args.num_classes, num_layers=args.num_layers,
+            image_shape=(c, h, w), layout=args.layout, stem=stem)
 
     steps = [int(e) for e in args.lr_step_epochs.split(",") if e]
     train = get_iter(args, c, h, w)
@@ -161,7 +183,8 @@ def main():
             initializer=mx.initializer.Xavier(rnd_type="gaussian",
                                               factor_type="in",
                                               magnitude=2.0),
-            batch_end_callback=cbs, epoch_end_callback=epoch_cbs)
+            batch_end_callback=cbs, epoch_end_callback=epoch_cbs,
+            steps_per_dispatch=args.steps_per_dispatch)
     print("train_imagenet done")
 
 

@@ -29,6 +29,8 @@ nightly:
 	    $(PY) tests/nightly/dist_fault_detect.py
 	$(CPUENV) $(PY) tools/launch.py -n 2 --launcher local \
 	    $(PY) tests/nightly/dist_push_overlap.py
+	$(CPUENV) $(PY) tools/launch.py -n 2 --launcher local \
+	    $(PY) tests/nightly/dist_run_steps.py
 	$(CPUENV) $(PY) tests/nightly/multi_kvstore_types.py
 
 examples:

@@ -544,11 +544,12 @@ class FusedTrainStep:
         (synthetic benchmarking); stacked=True expects every data value
         with a leading (k,) axis of per-step batches and scans over it.
 
-        Multi-process meshes fall back to k sequential steps: the
-        per-process assembly of a global stacked array is not wired up
-        (the cross-process gradient sum inside the body already
-        overlaps; dispatch amortization matters on the single-host
-        tunnel path)."""
+        Multi-process meshes run the SAME compiled k-loop for stacked
+        batches: each process contributes its local (k, local_rows,
+        ...) slice and the global array assembles without a host
+        gather, exactly like the single-step data plane (_place_data).
+        The non-stacked (replayed-batch) form stays sequential there —
+        it exists for single-host benching only."""
         if k < 1:
             raise ValueError("run_steps needs k >= 1")
         opt = self._opt
@@ -560,26 +561,37 @@ class FusedTrainStep:
                 opt.lr_scheduler(opt.num_update)
                 if opt.lr_scheduler is not None else opt.lr))
             ts.append(self._t)
-        if self._nproc > 1:
+        if self._nproc > 1 and not stacked:
             outs = None
+            placed = self._place_data(data_vals)  # loop-invariant
             for i in range(k):
-                d = {n: v[i] for n, v in data_vals.items()} if stacked \
-                    else data_vals
-                args = (self.params, self.states, self.auxs,
-                        self._place_data(d),
+                args = (self.params, self.states, self.auxs, placed,
                         np.float32(lrs[i]), np.int32(ts[i]))
                 with self._ambient():
                     outs, self.params, self.states, self.auxs = \
                         self._jitted(*args)
             return outs
-        lrs_v = jnp.asarray(np.asarray(lrs, np.float32))
-        ts_v = jnp.asarray(np.asarray(ts, np.int32))
-        if stacked and self._mesh is not None:
+        lrs_v = np.asarray(lrs, np.float32)
+        ts_v = np.asarray(ts, np.int32)
+
+        def stacked_sharding(n):
+            return NamedSharding(
+                self._mesh,
+                P(None, *(self._data_sh.get(n)
+                          or self._batch_sh).spec))
+
+        if stacked and self._nproc > 1:
+            # global (k, global_rows, ...) from per-process local
+            # slices — the multi-process data plane, leading step
+            # axis replicated
             data = {
-                n: jax.device_put(v, NamedSharding(
-                    self._mesh,
-                    P(None, *(self._data_sh.get(n)
-                              or self._batch_sh).spec)))
+                n: jax.make_array_from_process_local_data(
+                    stacked_sharding(n), np.asarray(v))
+                for n, v in data_vals.items()
+            }
+        elif stacked and self._mesh is not None:
+            data = {
+                n: jax.device_put(v, stacked_sharding(n))
                 for n, v in data_vals.items()
             }
         elif stacked:

@@ -63,6 +63,17 @@ def test_dist_sync_kvstore_two_workers():
     )
 
 
+def test_dist_run_steps_two_workers():
+    """Multi-process compiled k-step loop: stacked run_steps over the
+    2-process mesh matches the same batches fed as sequential fused
+    steps, with identical params on every rank."""
+    proc = _launch("dist_run_steps.py")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count("dist_run_steps OK") == 2, (
+        proc.stdout + proc.stderr
+    )
+
+
 def test_dist_model_parallel_two_workers(tmp_path):
     """Multi-host model parallelism (VERDICT r3 #2): the SP+TP
     transformer and the dryrun PP config train over ONE

@@ -9,6 +9,16 @@ import pytest
 import mxnet_tpu as mx
 
 
+@pytest.fixture(autouse=True)
+def _default_opt_state_dtype(monkeypatch):
+    """These gates assert fused == eager to tight tolerances; an
+    ambient MXNET_TPU_OPT_STATE_DTYPE=bfloat16 rounds the FUSED path's
+    optimizer state (by design) while the eager path stays f32, so the
+    parity bar only holds under the default state dtype (same pin as
+    tests/test_fused_step.py)."""
+    monkeypatch.delenv("MXNET_TPU_OPT_STATE_DTYPE", raising=False)
+
+
 def _gen(key, vocab=17, d=8, classes=3):
     data = mx.sym.Variable("data")
     emb = mx.sym.Embedding(data, input_dim=vocab, output_dim=d,

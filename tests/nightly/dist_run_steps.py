@@ -91,6 +91,49 @@ def main():
     out = a.get_outputs()[0]
     assert out.shape[0] == local, out.shape
 
+    # the fit() driver path: steps_per_dispatch groups local iterator
+    # batches on device and must train the same trajectory as the
+    # per-batch loop (same iterator order, same init)
+    def fit_params(spd):
+        Xf = Xl.reshape(-1, 8)   # k*local rows, batch 16 -> k batches
+        Yf = Yl.reshape(-1)
+        it = mx.io.NDArrayIter(Xf, Yf, batch_size=local,
+                               shuffle=False,
+                               label_name="softmax_label")
+        mod = build_module(seed=11)
+        # fit would rebind/reinit; drive the epoch loop pieces directly
+        for epoch in range(2):
+            it.reset()
+            if spd > 1:
+                group = []
+                for bt in it:
+                    group.append(bt)
+                    if len(group) == spd:
+                        stacked = mx.io.DataBatch(
+                            data=[mx.nd.array(np.stack(
+                                [g.data[0].asnumpy()
+                                 for g in group]))],
+                            label=[mx.nd.array(np.stack(
+                                [g.label[0].asnumpy()
+                                 for g in group]))])
+                        mod.run_steps(stacked, spd, stacked=True)
+                        group = []
+                for bt in group:
+                    mod.forward_backward(bt)
+                    mod.update()
+            else:
+                for bt in it:
+                    mod.forward_backward(bt)
+                    mod.update()
+        mod._flush_fused()
+        return {n: v.asnumpy() for n, v in mod.get_params()[0].items()}
+
+    p1 = fit_params(1)
+    p3 = fit_params(3)
+    for n in p1:
+        np.testing.assert_allclose(p1[n], p3[n], rtol=2e-5,
+                                   atol=2e-6, err_msg="fit " + n)
+
     print(f"dist_run_steps OK rank={rank} (k={k}, {nw} procs)")
 
 

@@ -47,6 +47,9 @@ def main():
     ap.add_argument("--mesh", default=None,
                     help="e.g. data=2,seq=4 (needs that many devices)")
     ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--multistep", type=int, default=1,
+                    help="k steps per dispatch (Module.run_steps; "
+                         "amortizes remote-dispatch latency)")
     ap.add_argument("--dtype", default=None,
                     choices=[None, "float32", "bfloat16"])
     args = ap.parse_args()
@@ -91,20 +94,41 @@ def main():
         mod.cast_compute(jnp.bfloat16)
 
     rs = np.random.RandomState(0)
-    batch = mx.io.DataBatch(
-        data=[mx.nd.array(rs.randn(*shape).astype("float32"), ctx=ctx)],
-        label=[mx.nd.array(rs.randn(*shape).astype("float32"),
-                           ctx=ctx)])
-    mod.forward_backward(batch)
-    mod.update()
-    mod.sync()
-
-    t0 = time.perf_counter()
-    for _ in range(args.iters):
+    k = args.multistep
+    if k > 1:
+        # stacked per-step batches through the compiled k-loop
+        # (Module.run_steps) — one dispatch per k steps, like
+        # BENCH_MULTISTEP in bench.py
+        Xs = rs.randn(k, *shape).astype("float32")
+        Ys = rs.randn(k, *shape).astype("float32")
+        stacked = mx.io.DataBatch(
+            data=[mx.nd.array(Xs, ctx=ctx)],
+            label=[mx.nd.array(Ys, ctx=ctx)])
+        mod.run_steps(stacked, k, stacked=True)
+        mod.sync()
+        iters = max(k, (args.iters // k) * k)
+        args.iters = iters
+        t0 = time.perf_counter()
+        for _ in range(iters // k):
+            mod.run_steps(stacked, k, stacked=True)
+        mod.sync()
+        dt = time.perf_counter() - t0
+    else:
+        batch = mx.io.DataBatch(
+            data=[mx.nd.array(rs.randn(*shape).astype("float32"),
+                              ctx=ctx)],
+            label=[mx.nd.array(rs.randn(*shape).astype("float32"),
+                               ctx=ctx)])
         mod.forward_backward(batch)
         mod.update()
-    mod.sync()
-    dt = time.perf_counter() - t0
+        mod.sync()
+
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            mod.forward_backward(batch)
+            mod.update()
+        mod.sync()
+        dt = time.perf_counter() - t0
 
     tokens_s = args.batch * args.seq * args.iters / dt
     fwd = transformer_flops(args.batch, args.seq, args.d_model,

@@ -9,7 +9,8 @@ PY      ?= python
 CPUENV  := JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS=
 XLA8    := XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all test nightly examples lint libs predict perl docs dryrun clean
+.PHONY: all test nightly examples lint libs predict perl docs dryrun \
+	cache-check clean
 
 all: libs test
 
@@ -58,6 +59,10 @@ perl:
 
 docs:
 	$(CPUENV) $(PY) tools/gen_env_docs.py
+
+# executor-cache tier: static no-jit-in-per-step guard + cache tests
+cache-check:
+	$(CPUENV) bash ci/check_exec_cache.sh
 
 # multi-chip sharding dryrun (DP / SP+TP / PP / EP) on 8 virtual devices
 dryrun:

@@ -59,9 +59,46 @@ def _detect_peak_flops(device) -> float:
     return 0.0  # CPU: MFU not reported
 
 
-def _probe_platform(timeout=180, retries=3):
+def _probe_cache_path():
+    import tempfile
+
+    return os.environ.get(
+        "BENCH_PLATFORM_CACHE",
+        os.path.join(tempfile.gettempdir(),
+                     "mxnet_tpu_bench_platform.json"))
+
+
+def _probe_platform(timeout=None, retries=None):
     """Decide the jax platform in a THROWAWAY subprocess so a hung TPU
-    backend init cannot wedge this process. Returns 'tpu' or 'cpu'."""
+    backend init cannot wedge this process. Returns 'tpu' or 'cpu'.
+
+    Successful probes are cached in a temp file (BENCH_PLATFORM_CACHE,
+    TTL BENCH_PLATFORM_CACHE_TTL seconds, default 1h): the capture
+    sequence runs bench.py several times back-to-back, and BENCH_r05
+    showed 3x180 s of probe timeouts per run before the CPU fallback
+    even started. The retry budget is correspondingly cut to one
+    attempt (BENCH_PROBE_RETRIES) at 120 s (BENCH_PROBE_TIMEOUT) — a
+    wedged tunnel rarely un-wedges between back-to-back attempts.
+    BENCH_PLATFORM=<name> skips probing entirely; the cpu FALLBACK is
+    never cached (a recovered accelerator must be re-probed)."""
+    forced = os.environ.get("BENCH_PLATFORM")
+    if forced:
+        return forced
+    timeout = timeout or int(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
+    retries = retries or int(os.environ.get("BENCH_PROBE_RETRIES", "1"))
+    ttl = float(os.environ.get("BENCH_PLATFORM_CACHE_TTL", "3600"))
+    # the probe result depends on the platform env the subprocess sees
+    env_tag = os.environ.get("JAX_PLATFORMS", "")
+    cache_path = _probe_cache_path()
+    try:
+        with open(cache_path) as f:
+            rec = json.load(f)
+        if (rec.get("platform")
+                and rec.get("jax_platforms", "") == env_tag
+                and time.time() - rec.get("t", 0) < ttl):
+            return rec["platform"]
+    except Exception:
+        pass
     code = "import jax; print(jax.devices()[0].platform)"
     for attempt in range(retries):
         try:
@@ -71,6 +108,12 @@ def _probe_platform(timeout=180, retries=3):
             )
             plat = out.stdout.strip().splitlines()[-1] if out.stdout else ""
             if out.returncode == 0 and plat:
+                try:
+                    with open(cache_path, "w") as f:
+                        json.dump({"platform": plat, "t": time.time(),
+                                   "jax_platforms": env_tag}, f)
+                except Exception:
+                    pass
                 return plat
             sys.stderr.write(
                 f"bench: platform probe attempt {attempt + 1} failed "
@@ -81,7 +124,8 @@ def _probe_platform(timeout=180, retries=3):
                 f"bench: platform probe attempt {attempt + 1} timed out "
                 f"after {timeout}s\n"
             )
-        time.sleep(5 * (attempt + 1))
+        if attempt + 1 < retries:
+            time.sleep(5 * (attempt + 1))
     return "cpu"
 
 
@@ -309,9 +353,16 @@ def main():
         mod.run_steps(next_group(), multistep, stacked=True)
         mod.sync()
         iters = max(multistep, (iters // multistep) * multistep)
+        # dispatch_s accumulates ONLY the host time spent inside the
+        # dispatch calls (data staging excluded): on async backends
+        # this is the steady-state per-step host/framework overhead
+        dispatch_s = 0.0
         t0 = time.perf_counter()
         for _ in range(iters // multistep):
-            mod.run_steps(next_group(), multistep, stacked=True)
+            g = next_group()
+            d0 = time.perf_counter()
+            mod.run_steps(g, multistep, stacked=True)
+            dispatch_s += time.perf_counter() - d0
         mod.sync()
         dt = time.perf_counter() - t0
     else:
@@ -321,10 +372,14 @@ def main():
         mod.update()
         mod.sync()
 
+        dispatch_s = 0.0
         t0 = time.perf_counter()
         for _ in range(iters):
-            mod.forward_backward(next_batch())
+            b = next_batch()
+            d0 = time.perf_counter()
+            mod.forward_backward(b)
             mod.update()
+            dispatch_s += time.perf_counter() - d0
         mod.sync()
         dt = time.perf_counter() - t0
 
@@ -341,6 +396,7 @@ def main():
 
     vs = img_s / BASELINE_IMG_S if num_layers == 50 else 0.0
     mem = mx.memory_stats(ctx)
+    cache_info = mx.executor.cache_stats()
     _emit({
         "metric": f"resnet{num_layers}_train_throughput_{platform}"
                   f"_b{batch}_{dtype}_{layout.lower()}"
@@ -359,6 +415,15 @@ def main():
         "layout": layout,
         "stem": stem,
         "multistep": multistep,
+        # steady-state per-step host overhead: host time inside the
+        # dispatch calls / optimizer steps. On async backends this is
+        # the framework+dispatch cost a step pays before the device
+        # can run ahead (compile amortization target, exec_cache).
+        "dispatch_overhead_us": round(dispatch_s / iters * 1e6, 1),
+        "exec_cache": {
+            k: cache_info[k]
+            for k in ("hits", "misses", "traces", "evictions")
+        },
         "platform": platform,
         "device_kind": getattr(dev, "device_kind", ""),
         "peak_hbm_bytes": int(mem.get("peak_bytes_in_use", 0)),

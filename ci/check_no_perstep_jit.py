@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Guard against per-step retracing: no `jax.jit(...)` call may appear
+inside the hot per-step methods of the executor/module layer.
+
+Compiled programs must be constructed once (lazily, inside
+exec_cache.CompiledGraph or at bind time) and only CALLED from the
+per-step paths — a `jax.jit` inside forward/backward/update would
+rebuild the traced callable every step and silently throw away the
+dispatch amortization the exec cache exists to provide. Pure-AST
+check, no imports of the framework, so it runs anywhere.
+"""
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+# files whose per-step methods are dispatch-hot
+FILES = sorted(
+    [REPO / "mxnet_tpu" / "executor.py"]
+    + list((REPO / "mxnet_tpu" / "module").glob("*.py"))
+)
+
+# method names that run once per training/inference step
+HOT = {
+    "forward", "backward", "update", "forward_backward",
+    "update_metric", "get_outputs", "get_input_grads", "run_steps",
+}
+
+
+def _is_jit_call(node):
+    """True for jax.jit(...) / jit(...) / functools-free aliases."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "jit":
+        return True
+    if isinstance(f, ast.Name) and f.id == "jit":
+        return True
+    return False
+
+
+def check(path):
+    tree = ast.parse(path.read_text(), filename=str(path))
+    bad = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if fn.name not in HOT:
+            continue
+        for node in ast.walk(fn):
+            if _is_jit_call(node):
+                bad.append((path, fn.name, node.lineno))
+    return bad
+
+
+def main():
+    bad = []
+    for path in FILES:
+        bad.extend(check(path))
+    if bad:
+        for path, fn, line in bad:
+            rel = path.relative_to(REPO)
+            print(f"{rel}:{line}: jax.jit call inside per-step "
+                  f"method {fn}() — construct the jit once in "
+                  f"exec_cache.CompiledGraph and only call it here")
+        return 1
+    print(f"check_no_perstep_jit: OK "
+          f"({len(FILES)} files, hot methods: {len(HOT)})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,6 +29,7 @@ from . import autograd
 from . import symbol
 from . import symbol as sym
 from .symbol import Variable, Group, AttrScope
+from . import exec_cache
 from . import executor
 from .executor import Executor
 from . import initializer

@@ -1,0 +1,279 @@
+"""Process-wide compiled-computation cache — the CachedOp analog.
+
+The reference amortizes graph setup through CachedOp and shared
+executors (src/executor/graph_executor.cc bucketing reuse via
+shared_exec); here the expensive artifact is the traced jax program:
+every distinct Python closure handed to `jax.jit` is a fresh trace +
+XLA compile on first call. This module keys ONE compiled program
+(`CompiledGraph`) by a canonical signature of the bound graph so that
+two executors bound to the same symbol + shapes share the same jit'd
+callables — rebinding, `Executor.reshape` back to a seen shape, and
+`BucketingModule` bucket revisits perform zero retraces.
+
+Cache key (see `Executor._cache_key`): the symbol's structural plan
+(topo-sorted op name + normalized params + input wiring + node names +
+ctx-group tags), the group2ctx device map, input/aux shapes and dtypes,
+grad_req, grad_names, and the memory-mirror flag. Train/eval mode is
+NOT in the key: each entry holds one lazily-built jit per mode, so an
+eval-only bind never pays the train trace (and vice versa).
+
+Knobs:
+  MXNET_EXEC_CACHE=0        disable (every bind builds a private program)
+  MXNET_EXEC_CACHE_SIZE=N   LRU bound on retained entries (default 64)
+
+Stats are surfaced via `cache_stats()` (re-exported as
+`mxnet_tpu.executor.cache_stats`) and merged into the profiler dump.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import warnings
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+
+_DEFAULT_CAPACITY = 64
+
+_lock = threading.RLock()
+_table: "OrderedDict[tuple, CompiledGraph]" = OrderedDict()
+_stats = {
+    "hits": 0,          # bind served from the table (or shared_exec)
+    "misses": 0,        # bind had to build a new CompiledGraph
+    "traces": 0,        # CompiledGraph constructions (== misses)
+    "evictions": 0,     # entries dropped by the LRU bound
+    "shared_hits": 0,   # hits resolved through an explicit shared_exec
+    "jit_builds": 0,    # lazy per-mode jax.jit closures constructed
+    "graph_replays": 0, # Python executions of a run_graph body
+                        # (jax retraces + eval_shape abstract passes)
+}
+
+
+def _enabled():
+    # registered in mxnet_tpu.utils (docs/env_vars.md is generated
+    # from there); read raw here to stay import-light + tolerate "off"
+    return os.environ.get("MXNET_EXEC_CACHE", "1").lower() not in (
+        "0", "false", "off")
+
+
+def capacity():
+    try:
+        return max(1, int(os.environ.get("MXNET_EXEC_CACHE_SIZE",
+                                         _DEFAULT_CAPACITY)))
+    except ValueError:
+        return _DEFAULT_CAPACITY
+
+
+def cache_stats():
+    """Snapshot of cache counters plus current size/capacity."""
+    with _lock:
+        out = dict(_stats)
+        out["size"] = len(_table)
+        out["capacity"] = capacity()
+        out["enabled"] = _enabled()
+        return out
+
+
+def reset_stats():
+    with _lock:
+        for k in _stats:
+            _stats[k] = 0
+
+
+def clear():
+    """Drop all cached programs (live executors keep their references)."""
+    with _lock:
+        _table.clear()
+
+
+def note_graph_replay():
+    with _lock:
+        _stats["graph_replays"] += 1
+
+
+def _note_jit_build():
+    with _lock:
+        _stats["jit_builds"] += 1
+
+
+def count_shared_hit():
+    with _lock:
+        _stats["hits"] += 1
+        _stats["shared_hits"] += 1
+
+
+def lookup_or_build(key, builder):
+    """Return the cached CompiledGraph for `key`, building (and
+    LRU-inserting) it with `builder()` on a miss. Building happens under
+    the lock: it is pure Python closure construction — the actual jax
+    trace is deferred to the first call of each jit."""
+    with _lock:
+        if _enabled():
+            entry = _table.get(key)
+            if entry is not None:
+                _stats["hits"] += 1
+                _table.move_to_end(key)
+                return entry
+        _stats["misses"] += 1
+        _stats["traces"] += 1
+        entry = builder()
+        if _enabled():
+            _table[key] = entry
+            cap = capacity()
+            while len(_table) > cap:
+                _table.popitem(last=False)
+                _stats["evictions"] += 1
+        return entry
+
+
+_donation_effective = None
+
+
+def donation_effective():
+    """Whether donate_argnums actually invalidates input buffers on this
+    backend (probed once). On backends without donation support, copies
+    made "because the buffer will be donated" are pure waste — callers
+    use this to skip them."""
+    global _donation_effective
+    if _donation_effective is None:
+        try:
+            x = jnp.zeros((2,), jnp.float32)
+            f = jax.jit(lambda a: a + 1.0, donate_argnums=(0,))
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                jax.block_until_ready(f(x))
+            _donation_effective = bool(
+                getattr(x, "is_deleted", lambda: True)())
+        except Exception:
+            _donation_effective = True  # conservative: copy
+    return _donation_effective
+
+
+class CompiledGraph:
+    """One traced graph program, shared by every executor whose bind
+    signature matches. Holds the pure `run_graph` plus per-mode jits
+    built lazily on first use — binding an eval-only executor never
+    constructs the train-step program."""
+
+    __slots__ = ("run_graph", "plan", "var_names", "aux_set",
+                 "grad_names", "mirror", "_jit_fwd", "_jit_train",
+                 "_head_shapes", "_default_ones", "_build_lock")
+
+    def __init__(self, run_graph, plan, var_names, aux_set, grad_names,
+                 mirror):
+        self.run_graph = run_graph
+        self.plan = plan
+        self.var_names = var_names
+        self.aux_set = aux_set
+        self.grad_names = list(grad_names)
+        self.mirror = mirror
+        self._jit_fwd = {}
+        self._jit_train = None
+        self._head_shapes = None
+        self._default_ones = None
+        self._build_lock = threading.Lock()
+
+    # ------------------------------------------------------- programs
+    def jit_fwd(self, is_train):
+        mode = bool(is_train)
+        fn = self._jit_fwd.get(mode)
+        if fn is None:
+            with self._build_lock:
+                fn = self._jit_fwd.get(mode)
+                if fn is None:
+                    run = self.run_graph
+
+                    def fwd(a, x, r, _run=run, _m=mode):
+                        return _run(a, x, r, _m)
+
+                    fn = self._jit_fwd[mode] = jax.jit(fwd)
+                    _note_jit_build()
+        return fn
+
+    def jit_train_step(self):
+        fn = self._jit_train
+        if fn is None:
+            with self._build_lock:
+                fn = self._jit_train
+                if fn is None:
+                    fn = self._jit_train = self._build_train_step()
+                    _note_jit_build()
+        return fn
+
+    def _build_train_step(self):
+        run_graph = self.run_graph
+        grad_names = list(self.grad_names)
+        mirror = self.mirror
+
+        def train_step(arg_vals, aux_vals, rng, head_grads):
+            grad_vals = {k: arg_vals[k] for k in grad_names}
+            others = {
+                k: v for k, v in arg_vals.items() if k not in grad_vals
+            }
+
+            def f(gv):
+                outs, aux_upd = run_graph(
+                    {**others, **gv}, aux_vals, rng, True
+                )
+                return outs, aux_upd
+
+            if mirror:
+                f = jax.checkpoint(f)
+            outs, vjp_fn, aux_upd = jax.vjp(f, grad_vals, has_aux=True)
+            (grads,) = vjp_fn(head_grads)
+            return outs, grads, aux_upd
+
+        # Donation (the PlanMemory/inplace analog): head_grads are
+        # consumed by the vjp and never reused — donate them where the
+        # backend honors it. arg/aux buffers CANNOT be donated here: on
+        # the eager path they are the user-visible NDArrays of
+        # arg_dict/grad_dict (the caller may read them after forward).
+        donate = (3,) if donation_effective() else ()
+        return jax.jit(train_step, donate_argnums=donate)
+
+    # ----------------------------------------------------- head grads
+    # Both caches are keyed by the CALL's input shapes, not computed
+    # once per entry: the same jit serves multiple runtime shapes (a
+    # trailing partial batch replaces a device's data buffer with a
+    # shorter one), and head shapes must follow the actual inputs.
+    @staticmethod
+    def _input_sig(arg_vals, aux_vals):
+        return (
+            tuple(sorted((k, tuple(v.shape)) for k, v in
+                         arg_vals.items())),
+            tuple(sorted((k, tuple(v.shape)) for k, v in
+                         aux_vals.items())),
+        )
+
+    def head_shapes(self, arg_vals, aux_vals, rng):
+        sig = self._input_sig(arg_vals, aux_vals)
+        cache = self._head_shapes
+        if cache is None:
+            cache = self._head_shapes = {}
+        shapes = cache.get(sig)
+        if shapes is None:
+            run = self.run_graph
+            out = jax.eval_shape(
+                lambda a, x, r: run(a, x, r, True)[0],
+                arg_vals, aux_vals, rng,
+            )
+            shapes = cache[sig] = [
+                (tuple(s.shape), s.dtype) for s in out
+            ]
+        return shapes
+
+    def default_head_grads(self, arg_vals, aux_vals, rng):
+        """Ones head gradients, reusing the cached buffers whenever the
+        previous step did not donate them away (on donation-free
+        backends this is a zero-allocation path)."""
+        sig = self._input_sig(arg_vals, aux_vals)
+        shapes = self.head_shapes(arg_vals, aux_vals, rng)
+        cache = self._default_ones
+        if cache is None:
+            cache = self._default_ones = {}
+        ones = cache.get(sig)
+        if ones is None or any(
+                getattr(o, "is_deleted", lambda: False)() for o in ones):
+            ones = cache[sig] = [jnp.ones(s, d) for s, d in shapes]
+        return ones

@@ -25,9 +25,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import exec_cache as _exec_cache
 from . import profiler as _profiler
 from . import random as _random
 from .base import MXNetError
+from .exec_cache import cache_stats  # noqa: F401  (public API)
 from .ndarray import NDArray
 from .symbol import _topo
 
@@ -91,10 +93,50 @@ class Executor:
         # symbolic Dropout/rrelu reproducibly
         self._rng = _random.next_key()
 
-        self._build()
+        self._build(shared_exec)
 
     # ----------------------------------------------------------- build
-    def _build(self):
+    def _build(self, shared_exec=None):
+        """Resolve this bind to a CompiledGraph: an exec_cache lookup
+        keyed by the canonical graph signature + shapes/dtypes/grad
+        config. A shared_exec with a matching signature short-circuits
+        the table (the reference's shared-executor bind); otherwise a
+        hit shares the previously traced program and a miss traces a
+        new one."""
+        import os as _os
+
+        mirror = _os.environ.get(
+            "MXNET_BACKWARD_DO_MIRROR", "0") not in ("0", "", "false")
+        self._cache_key = (
+            self._symbol.structure_key(),
+            tuple(sorted(
+                (g, repr(c)) for g, c in self._group2ctx.items())),
+            tuple((n, tuple(self.arg_dict[n].shape),
+                   str(self.arg_dict[n].dtype))
+                  for n in self._arg_names),
+            tuple((n, tuple(self.aux_dict[n].shape),
+                   str(self.aux_dict[n].dtype))
+                  for n in self._aux_names),
+            tuple((n, self._grad_req.get(n, "null"))
+                  for n in self._arg_names),
+            tuple(self._grad_names),
+            mirror,
+        )
+        if (shared_exec is not None
+                and getattr(shared_exec, "_cache_key", None)
+                == self._cache_key
+                and getattr(shared_exec, "_compiled", None) is not None):
+            self._compiled = shared_exec._compiled
+            _exec_cache.count_shared_hit()
+            return
+        self._compiled = _exec_cache.lookup_or_build(
+            self._cache_key, self._trace_graph)
+
+    def _trace_graph(self):
+        """Build the pure run_graph program + node plan for this bind's
+        signature (cache-miss path). No jax tracing happens here — each
+        per-mode jit is constructed lazily by CompiledGraph and traces
+        on its first call."""
         sym = self._symbol
         nodes = _topo(sym._outputs)
         node_ids = {id(n): i for i, n in enumerate(nodes)}
@@ -132,6 +174,7 @@ class Executor:
         aux_set = set(self._aux_names)
 
         def run_graph(arg_vals, aux_vals, rng, is_train):
+            _exec_cache.note_graph_replay()
             env = {}
             for nid, name in var_names.items():
                 env[(nid, 0)] = (
@@ -165,53 +208,40 @@ class Executor:
             outs = [env[k] for k in heads]
             return outs, aux_updates
 
-        self._run_graph = run_graph
-        self._plan = plan
-        self._var_names = var_names
-        self._aux_set = aux_set
-        self._jit_fwd = {
-            True: jax.jit(lambda a, x, r: run_graph(a, x, r, True)),
-            False: jax.jit(lambda a, x, r: run_graph(a, x, r, False)),
-        }
-
-        grad_names = list(self._grad_names)
         # memory mirror: rematerialize forward activations in backward
         # instead of keeping them — jax.checkpoint is the analog of the
         # reference's MXNET_BACKWARD_DO_MIRROR / memonger (trades ~10%
         # speed for much smaller activation memory,
-        # example/image-classification/README.md:352-359)
-        import os as _os
-
-        mirror = _os.environ.get("MXNET_BACKWARD_DO_MIRROR", "0") not in (
-            "0", "", "false",
+        # example/image-classification/README.md:352-359). Full
+        # in-place donation of params+state lives on the fused train
+        # step (parallel/dp_step.py), which owns its buffers.
+        return _exec_cache.CompiledGraph(
+            run_graph, plan, var_names, aux_set,
+            grad_names=self._grad_names, mirror=self._cache_key[-1],
         )
 
-        def train_step(arg_vals, aux_vals, rng, head_grads):
-            grad_vals = {k: arg_vals[k] for k in grad_names}
-            others = {
-                k: v for k, v in arg_vals.items() if k not in grad_vals
-            }
+    # Compiled-program views (shared via exec_cache; the underscore
+    # names are the pre-cache attribute surface other layers use —
+    # pipeline_module, dp_step, tests).
+    @property
+    def _run_graph(self):
+        return self._compiled.run_graph
 
-            def f(gv):
-                outs, aux_upd = run_graph(
-                    {**others, **gv}, aux_vals, rng, True
-                )
-                return outs, aux_upd
+    @property
+    def _plan(self):
+        return self._compiled.plan
 
-            if mirror:
-                f = jax.checkpoint(f)
-            outs, vjp_fn, aux_upd = jax.vjp(f, grad_vals, has_aux=True)
-            (grads,) = vjp_fn(head_grads)
-            return outs, grads, aux_upd
+    @property
+    def _var_names(self):
+        return self._compiled.var_names
 
-        # Donation (the PlanMemory/inplace analog): head_grads are
-        # consumed by the vjp and never reused — donate them. arg/aux
-        # buffers CANNOT be donated here: on the eager path they are the
-        # user-visible NDArrays of arg_dict/grad_dict (reference
-        # executor semantics — the caller may read them after forward).
-        # Full in-place donation of params+state lives on the fused
-        # train step (parallel/dp_step.py), which owns its buffers.
-        self._jit_train_step = jax.jit(train_step, donate_argnums=(3,))
+    @property
+    def _aux_set(self):
+        return self._compiled.aux_set
+
+    @property
+    def _jit_train_step(self):
+        return self._compiled.jit_train_step()
 
     # --------------------------------------------------------- running
     def _gather_inputs(self):
@@ -243,12 +273,12 @@ class Executor:
                 head_grads = self._default_head_grads(
                     arg_vals, aux_vals, rng
                 )
-                outs, grads, aux_upd = self._jit_train_step(
+                outs, grads, aux_upd = self._compiled.jit_train_step()(
                     arg_vals, aux_vals, rng, head_grads
                 )
                 self._cached_grads = grads
             else:
-                outs, aux_upd = self._jit_fwd[bool(is_train)](
+                outs, aux_upd = self._compiled.jit_fwd(is_train)(
                     arg_vals, aux_vals, rng
                 )
         self._last_inputs = (arg_vals, aux_vals, rng)
@@ -292,15 +322,9 @@ class Executor:
                 )
 
     def _default_head_grads(self, arg_vals, aux_vals, rng):
-        if not hasattr(self, "_head_shapes"):
-            shapes = jax.eval_shape(
-                lambda a, x, r: self._run_graph(a, x, r, True)[0],
-                arg_vals, aux_vals, rng,
-            )
-            self._head_shapes = [
-                (tuple(s.shape), s.dtype) for s in shapes
-            ]
-        return [jnp.ones(s, d) for s, d in self._head_shapes]
+        # ones-buffers are cached on the shared CompiledGraph and only
+        # reallocated when the previous step actually donated them away
+        return self._compiled.default_head_grads(arg_vals, aux_vals, rng)
 
     def backward(self, out_grads=None):
         if not self._grad_names:
@@ -310,11 +334,16 @@ class Executor:
                 raise MXNetError("backward called before forward")
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
-            # copies: the train-step jit donates its head-grad buffers,
-            # which must not invalidate the caller's NDArrays
-            head_grads = [jnp.copy(g._data) for g in out_grads]
+            # the train-step jit donates its head-grad buffers only on
+            # backends where donation is real — copy just there, so the
+            # caller's NDArrays stay valid without paying a copy on
+            # donation-free backends
+            if _exec_cache.donation_effective():
+                head_grads = [jnp.copy(g._data) for g in out_grads]
+            else:
+                head_grads = [g._data for g in out_grads]
             arg_vals, aux_vals, rng = self._last_inputs
-            _, grads, _ = self._jit_train_step(
+            _, grads, _ = self._compiled.jit_train_step()(
                 arg_vals, aux_vals, rng, head_grads
             )
         else:
@@ -366,10 +395,14 @@ class Executor:
                 new_args[name] = nd.zeros(shape, ctx=self._ctx,
                                           dtype=cur.dtype)
         new_grads = {}
-        for name in self.grad_dict:
-            idx = self._arg_names.index(name)
-            shape = arg_shapes[idx]
-            cur = self.grad_dict[name]
+        for name, cur in self.grad_dict.items():
+            if name not in self._arg_names:
+                # a grad buffer for a name the symbol does not take
+                # (user-supplied extras) — carry it over untouched
+                # instead of crashing on .index()
+                new_grads[name] = cur
+                continue
+            shape = arg_shapes[self._arg_names.index(name)]
             if tuple(cur.shape) == tuple(shape):
                 new_grads[name] = cur
             else:
@@ -383,9 +416,12 @@ class Executor:
             else:
                 new_aux[name] = nd.zeros(shape, ctx=self._ctx,
                                          dtype=cur.dtype)
+        # shared_exec=self: a reshape back to previously-seen shapes
+        # resolves in the exec_cache (or directly against this
+        # executor) with zero retraces
         return Executor(self._symbol, self._ctx, new_args, new_grads,
                         self._grad_req, new_aux,
-                        group2ctx=self._group2ctx)
+                        group2ctx=self._group2ctx, shared_exec=self)
 
     def release_arrays(self):
         """Drop all buffer references (args/grads/auxs/outputs), keeping

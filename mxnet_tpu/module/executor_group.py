@@ -450,9 +450,12 @@ class DataParallelExecutorGroup(object):
     def _bind_ith_exec(self, i, data_shapes, label_shapes, shared_group):
         """Bind executor i, sharing memory with shared_group's executor i
         (reference executor_group.py:537-620). XLA owns buffer placement,
-        so "sharing the memory pool" reduces to sharing parameter
-        NDArrays (shape-equal args) with the shared executor; non-param
-        inputs and their grads draw from the per-executor pool."""
+        so "sharing the memory pool" reduces to sharing parameter (and
+        parameter-grad) NDArrays with the shared executor; non-param
+        inputs and their grads draw from the per-executor pool. The
+        shared_exec also rides into Executor itself, where a matching
+        bind signature shares the shared executor's compiled program
+        through exec_cache (zero retraces)."""
         shared_exec = None if shared_group is None else shared_group.execs[i]
         context = self.contexts[i]
         pool = self.shared_data_arrays[i]
@@ -468,6 +471,18 @@ class DataParallelExecutorGroup(object):
             assert arr.shape == shape and arr.dtype == dtype
             return arr
 
+        def param_grad_array(name, shape, dtype):
+            # params are shared with shared_exec, so their grad buffers
+            # are too (shapes are bucket-invariant): buckets overwrite
+            # one gradient pool instead of each allocating its own —
+            # the reference's shared-pool bind for gradients
+            if shared_exec is not None:
+                arr = shared_exec.grad_dict.get(name)
+                if arr is not None and arr.shape == shape \
+                        and arr.dtype == dtype:
+                    return arr
+            return nd.zeros(shape, context, dtype=dtype)
+
         for name, (shape, dtype) in arg_specs.items():
             is_param = name in self.param_names
             args[name] = (
@@ -475,7 +490,7 @@ class DataParallelExecutorGroup(object):
                 else self._pool_array(pool, name, shape, dtype, context))
             if self.grad_req[name] != "null":
                 grads[name] = (
-                    nd.zeros(shape, context, dtype=dtype) if is_param
+                    param_grad_array(name, shape, dtype) if is_param
                     else self._pool_array(pool, "grad of " + name,
                                           shape, dtype, context))
 

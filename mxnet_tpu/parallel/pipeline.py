@@ -19,8 +19,20 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # older jax keeps shard_map under experimental
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
+
+if hasattr(jax.lax, "pcast"):
+    def _pcast_varying(x, axis_name):
+        return jax.lax.pcast(x, (axis_name,), to="varying")
+else:
+    # older jax: shard_map has no varying-axis tracking, every
+    # per-device value is implicitly varying — identity is exact
+    def _pcast_varying(x, axis_name):
+        return x
 
 
 def _stage_apply(fn, params, x, stage_idx):
@@ -48,9 +60,9 @@ def pipeline_apply(fn, stage_params, microbatches, mesh,
         ticks = s + m - 1
         x_shape = mb.shape[1:]
         buf = jnp.zeros(x_shape, mb.dtype)  # activation held here
-        buf = jax.lax.pcast(buf, (axis_name,), to="varying")
+        buf = _pcast_varying(buf, axis_name)
         outs = jnp.zeros((m,) + x_shape, mb.dtype)
-        outs = jax.lax.pcast(outs, (axis_name,), to="varying")
+        outs = _pcast_varying(outs, axis_name)
 
         def tick(t, carry):
             buf, outs = carry
@@ -162,9 +174,9 @@ def pipeline_apply_hetero(stage_fns, flat_params, flat_auxs,
         a_local = auxs[0]    # (Amax,)
         ticks = s + m - 1
         buf = jnp.zeros((emax,), jnp.float32)
-        buf = jax.lax.pcast(buf, (axis_name,), to="varying")
+        buf = _pcast_varying(buf, axis_name)
         outs = jnp.zeros((m,) + last_shape, out_dtype)
-        outs = jax.lax.pcast(outs, (axis_name,), to="varying")
+        outs = _pcast_varying(outs, axis_name)
         a_var = a_local  # sharded input: already axis-varying
 
         def make_branch(si):
@@ -205,9 +217,9 @@ def pipeline_apply_hetero(stage_fns, flat_params, flat_auxs,
                     buf, a, mb_idx = args
                     flat, a2, y = b(buf, a, mb_idx)
                     if y is None:
-                        y = jax.lax.pcast(
+                        y = _pcast_varying(
                             jnp.zeros(last_shape, out_dtype),
-                            (axis_name,), to="varying")
+                            axis_name)
                     return flat, a2, y
                 return f
 
@@ -237,10 +249,17 @@ def pipeline_apply_hetero(stage_fns, flat_params, flat_auxs,
         )
         return outs, a_var[None]
 
+    kwargs = {}
+    if not hasattr(jax.lax, "pcast"):
+        # without pcast the replication checker cannot see that every
+        # lax.switch branch is uniformly device-varying; disable it
+        # (the modern checker validates this same program via pcast)
+        kwargs["check_rep"] = False
     fn_sharded = shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(P(axis_name), P(axis_name), P()),
         out_specs=(P(), P(axis_name)),
+        **kwargs,
     )
     return fn_sharded(flat_params, flat_auxs, microbatches)

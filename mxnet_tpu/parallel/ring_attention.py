@@ -25,7 +25,10 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # older jax keeps shard_map under experimental
+    from jax.experimental.shard_map import shard_map
 
 NEG_INF = -1e30
 
@@ -39,9 +42,13 @@ def _ring_attention_shard(q, k, v, *, axis_name, causal, scale):
     qh = q.transpose(0, 2, 1, 3)  # (B, H, Tq, D)
 
     # pcast: mark the accumulators as device-varying along the ring axis
-    # so the fori_loop carry types match the (varying) body outputs
+    # so the fori_loop carry types match the (varying) body outputs.
+    # Older jax has no varying-axis tracking (every per-device value is
+    # implicitly varying) — identity there.
     def _varying(x):
-        return jax.lax.pcast(x, (axis_name,), to="varying")
+        if hasattr(jax.lax, "pcast"):
+            return jax.lax.pcast(x, (axis_name,), to="varying")
+        return x
 
     o0 = _varying(jnp.zeros((b, h, t_local, d), jnp.float32))
     m0 = _varying(jnp.full((b, h, t_local), NEG_INF, jnp.float32))

@@ -135,16 +135,31 @@ def _collect_device_events(trace_dir):
     return out
 
 
+def exec_cache_stats():
+    """Counters of the process-wide compiled-computation cache
+    (exec_cache): hits/misses/traces/evictions + size. Exposed here so
+    profiling workflows read dispatch amortization next to the
+    timeline; also embedded in every dump_profile output."""
+    from .exec_cache import cache_stats
+
+    return cache_stats()
+
+
 def dump_profile(device_trace_dir=None):
     """Write collected events as ONE Chrome trace-event JSON (the
     reference emits a single unified trace, src/engine/profiler.cc:134):
     host-side framework events on pid 0, and — when a jax device
     capture ran — the XLA device timeline merged in under offset
-    pids."""
+    pids. Top-level `execCacheStats` carries the compiled-computation
+    cache counters (chrome://tracing ignores unknown keys)."""
     with _lock:
         events = list(_events)
         _events.clear()
     trace = {"traceEvents": [], "displayTimeUnit": "ms"}
+    try:
+        trace["execCacheStats"] = exec_cache_stats()
+    except Exception:
+        pass
     for name, cat, b, e in events:
         trace["traceEvents"].append({
             "name": name, "cat": cat, "ph": "B",

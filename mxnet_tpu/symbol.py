@@ -318,6 +318,34 @@ class Symbol:
                      for h in self._outputs]
         return arg_types, out_types, aux_types
 
+    # ------------------------------------------------------ canonical form
+    def structure_key(self):
+        """Canonical, hashable signature of the graph structure: the
+        topo-sorted node list (op name, node name, normalized params,
+        ctx-group tag, input wiring as topo indices) plus the head
+        wiring. Two symbols with equal keys lower to the same
+        computation, so executors bound to them (with equal shapes /
+        dtypes / grad config) can share one compiled program — the
+        exec_cache key's graph component."""
+        nodes = _topo(self._outputs)
+        idx = {id(n): i for i, n in enumerate(nodes)}
+        entries = []
+        for n in nodes:
+            if n.is_variable:
+                entries.append((
+                    "null", n.name, bool(n.is_aux),
+                    n._extra_attrs.get("__ctx_group__"),
+                ))
+            else:
+                entries.append((
+                    n.op.name, n.name,
+                    _canon(n.op.normalize_params(n.attrs)),
+                    n._extra_attrs.get("__ctx_group__"),
+                    tuple((idx[id(src)], i) for src, i in n.inputs),
+                ))
+        heads = tuple((idx[id(n)], i) for n, i in self._outputs)
+        return (tuple(entries), heads)
+
     # ------------------------------------------------------- serialization
     def tojson(self):
         nodes = _topo(self._outputs)
@@ -446,6 +474,30 @@ class Symbol:
             ins = ", ".join(f"{src.name}[{i}]" for src, i in n.inputs)
             lines.append(f"{kind} {n.name}({ins})")
         return "\n".join(lines)
+
+
+def _canon(value):
+    """Hashable canonical form of an op-param value. Containers become
+    tuples of canonical items; np.dtype becomes its name; hashable
+    leaves (including functions — identity-hashed, and kept strongly
+    referenced by the cache key so their id cannot be recycled) pass
+    through unchanged."""
+    if isinstance(value, dict):
+        return tuple(sorted(
+            (str(k), _canon(v)) for k, v in value.items()))
+    if isinstance(value, (list, tuple)):
+        return tuple(_canon(v) for v in value)
+    if isinstance(value, (set, frozenset)):
+        return tuple(sorted(repr(_canon(v)) for v in value))
+    if isinstance(value, np.dtype):
+        return value.name
+    if isinstance(value, np.ndarray):
+        return (value.dtype.name, value.shape, value.tobytes())
+    try:
+        hash(value)
+        return value
+    except TypeError:
+        return repr(value)
 
 
 def _key(head):

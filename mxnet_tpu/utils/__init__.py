@@ -133,3 +133,16 @@ register_env(
     "(jax.checkpoint) — the reference's memory-mirror/memonger "
     "(README.md:352-359): ~10% slower, much less activation memory",
 )
+register_env(
+    "MXNET_EXEC_CACHE", bool, True,
+    "process-wide compiled-computation cache (exec_cache, the CachedOp "
+    "analog): executors bound to the same graph signature + shapes "
+    "share one traced program. 0 disables sharing — every bind builds "
+    "a private program (docs/faq.md).",
+)
+register_env(
+    "MXNET_EXEC_CACHE_SIZE", int, 64,
+    "LRU bound on retained exec_cache entries; raise it when cycling "
+    "more distinct bucket/shape signatures than this. Stats: "
+    "mxnet_tpu.executor.cache_stats().",
+)

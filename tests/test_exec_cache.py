@@ -1,0 +1,197 @@
+"""Process-wide compiled-computation cache (exec_cache, the CachedOp
+analog): rebinding an identical (symbol, shapes, grad config) shares one
+traced program; BucketingModule bucket revisits trace nothing; distinct
+signatures get distinct entries; the LRU bound (MXNET_EXEC_CACHE_SIZE)
+evicts and retraces on re-entry."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import exec_cache
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache(monkeypatch):
+    """Each test sees an empty cache with zeroed counters and the
+    default knobs (no ambient disable/size override)."""
+    monkeypatch.delenv("MXNET_EXEC_CACHE", raising=False)
+    monkeypatch.delenv("MXNET_EXEC_CACHE_SIZE", raising=False)
+    monkeypatch.delenv("MXNET_BACKWARD_DO_MIRROR", raising=False)
+    exec_cache.clear()
+    exec_cache.reset_stats()
+    yield
+    exec_cache.clear()
+    exec_cache.reset_stats()
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=5, name="fc")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def _gen(key, vocab=17, d=8, classes=3):
+    """Bucketed net: Embedding + length-independent mean pooling."""
+    data = mx.sym.Variable("data")
+    emb = mx.sym.Embedding(data, input_dim=vocab, output_dim=d,
+                           name="emb")
+    pooled = mx.sym.mean(emb, axis=1)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(pooled, num_hidden=classes, name="fc"),
+        name="softmax")
+    return net, ("data",), ("softmax_label",)
+
+
+def test_rebind_same_signature_traces_once():
+    net = _mlp()
+    e1 = net.simple_bind(mx.cpu(), data=(4, 3))
+    s = exec_cache.cache_stats()
+    assert s["misses"] == 1 and s["traces"] == 1, s
+
+    # a second bind of the SAME symbol + shapes + grad config is a pure
+    # cache hit — zero retraces (acceptance criterion: rebind == hit)
+    e2 = net.simple_bind(mx.cpu(), data=(4, 3))
+    s = exec_cache.cache_stats()
+    assert s["traces"] == 1 and s["hits"] == 1, s
+    assert e1._compiled is e2._compiled
+
+    # and the shared entry computes the same thing through both binds
+    x = np.random.RandomState(0).rand(4, 3).astype("float32")
+    e1.forward(is_train=False, data=mx.nd.array(x))
+    e2.forward(is_train=False, data=mx.nd.array(x))
+    np.testing.assert_allclose(e1.outputs[0].asnumpy(),
+                               e2.outputs[0].asnumpy())
+
+
+def test_structurally_equal_symbol_rebuilt_from_scratch_hits():
+    """The key is the canonical graph signature, not Python object
+    identity: reconstructing the same graph hits the same entry."""
+    _mlp().simple_bind(mx.cpu(), data=(4, 3))
+    _mlp().simple_bind(mx.cpu(), data=(4, 3))
+    s = exec_cache.cache_stats()
+    assert s["traces"] == 1 and s["hits"] == 1, s
+
+
+def test_bucketing_revisits_trace_nothing():
+    bm = mx.mod.BucketingModule(_gen, default_bucket_key=9)
+    bm.bind(data_shapes=[("data", (8, 9))],
+            label_shapes=[("softmax_label", (8,))])
+    np.random.seed(3)
+    bm.init_params(mx.initializer.Xavier())
+
+    def batch(T):
+        rs = np.random.RandomState(T)
+        return mx.io.DataBatch(
+            data=[mx.nd.array(rs.randint(0, 17, (8, T))
+                              .astype("float32"))],
+            label=[mx.nd.array(rs.randint(0, 3, 8)
+                               .astype("float32"))],
+            bucket_key=T, provide_data=[("data", (8, T))],
+            provide_label=[("softmax_label", (8,))])
+
+    # two full cycles over three buckets
+    for _ in range(2):
+        for T in (4, 6, 9):
+            bm.forward(batch(T))
+            bm.backward()
+    s = exec_cache.cache_stats()
+    # exactly one trace per distinct bucket signature, none on revisit
+    assert s["traces"] == 3, s
+    assert s["misses"] == 3, s
+
+    # a third cycle stays trace-free
+    for T in (4, 6, 9):
+        bm.forward(batch(T))
+    s2 = exec_cache.cache_stats()
+    assert s2["traces"] == 3 and s2["misses"] == 3, s2
+
+
+def test_distinct_signatures_get_distinct_entries():
+    net = _mlp()
+    net.simple_bind(mx.cpu(), data=(4, 3))
+    # different input shape -> different entry
+    net.simple_bind(mx.cpu(), data=(2, 3))
+    # different grad_req -> different entry (same shapes)
+    net.simple_bind(mx.cpu(), grad_req="null", data=(4, 3))
+    s = exec_cache.cache_stats()
+    assert s["misses"] == 3 and s["hits"] == 0 and s["size"] == 3, s
+
+    # different op params at identical shapes/names -> different entry
+    data = mx.sym.Variable("data")
+    for act in ("relu", "tanh"):
+        mx.sym.Activation(data, act_type=act, name="act").simple_bind(
+            mx.cpu(), data=(4, 3))
+    s = exec_cache.cache_stats()
+    assert s["misses"] == 5 and s["hits"] == 0, s
+
+
+def test_lru_eviction_respects_env_cap(monkeypatch):
+    monkeypatch.setenv("MXNET_EXEC_CACHE_SIZE", "2")
+    net = _mlp()
+    net.simple_bind(mx.cpu(), data=(2, 3))
+    net.simple_bind(mx.cpu(), data=(3, 3))
+    net.simple_bind(mx.cpu(), data=(4, 3))
+    s = exec_cache.cache_stats()
+    assert s["size"] == 2 and s["evictions"] == 1 and s["traces"] == 3, s
+
+    # (2, 3) was the LRU entry and is gone: binding it again retraces
+    # and evicts the next-oldest (3, 3)
+    net.simple_bind(mx.cpu(), data=(2, 3))
+    s = exec_cache.cache_stats()
+    assert s["traces"] == 4 and s["evictions"] == 2, s
+
+    # (4, 3) survived as most-recently-used
+    net.simple_bind(mx.cpu(), data=(4, 3))
+    assert exec_cache.cache_stats()["hits"] == 1
+
+
+def test_cache_disable_env(monkeypatch):
+    monkeypatch.setenv("MXNET_EXEC_CACHE", "0")
+    net = _mlp()
+    net.simple_bind(mx.cpu(), data=(4, 3))
+    net.simple_bind(mx.cpu(), data=(4, 3))
+    s = exec_cache.cache_stats()
+    assert s["traces"] == 2 and s["hits"] == 0 and s["size"] == 0, s
+
+
+def test_reshape_roundtrip_is_trace_free():
+    net = _mlp()
+    e1 = net.simple_bind(mx.cpu(), data=(4, 3))
+    e2 = e1.reshape(data=(2, 3))            # new signature: one trace
+    assert exec_cache.cache_stats()["traces"] == 2
+    e3 = e2.reshape(data=(4, 3))            # back to a seen signature
+    s = exec_cache.cache_stats()
+    assert s["traces"] == 2 and s["hits"] >= 1, s
+    assert e3._compiled is e1._compiled
+    x = np.ones((4, 3), dtype="float32")
+    e3.forward(is_train=False, data=mx.nd.array(x))
+    assert e3.outputs[0].shape == (4, 5)
+
+
+def test_reshape_with_extra_grad_buffer_does_not_crash():
+    """grad_dict may carry user-supplied buffers for names the symbol
+    does not take as arguments; reshape must carry them over instead of
+    crashing on list.index()."""
+    net = _mlp()
+    shapes, _, _ = net.infer_shape(data=(4, 3))
+    names = net.list_arguments()
+    args = {n: mx.nd.zeros(s) for n, s in zip(names, shapes)}
+    grads = {n: mx.nd.zeros(s) for n, s in zip(names, shapes)}
+    extra = mx.nd.zeros((7,))
+    grads["not_an_argument"] = extra
+    exe = net.bind(mx.cpu(), args=args, args_grad=grads,
+                   grad_req={n: "write" for n in names})
+    out = exe.reshape(data=(2, 3))
+    assert out.grad_dict["not_an_argument"] is extra
+    assert out.arg_dict["data"].shape == (2, 3)
+
+
+def test_shared_exec_short_circuits_table():
+    net = _mlp()
+    e1 = net.simple_bind(mx.cpu(), data=(4, 3))
+    base = exec_cache.cache_stats()
+    e2 = net.simple_bind(mx.cpu(), data=(4, 3), shared_exec=e1)
+    s = exec_cache.cache_stats()
+    assert e2._compiled is e1._compiled
+    assert s["shared_hits"] == base["shared_hits"] + 1
+    assert s["traces"] == base["traces"]

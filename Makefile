@@ -10,7 +10,7 @@ CPUENV  := JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS=
 XLA8    := XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: all test nightly examples lint libs predict perl docs dryrun \
-	cache-check clean
+	cache-check serving-check clean
 
 all: libs test
 
@@ -63,6 +63,10 @@ docs:
 # executor-cache tier: static no-jit-in-per-step guard + cache tests
 cache-check:
 	$(CPUENV) bash ci/check_exec_cache.sh
+
+# serving tier: test suite + dynamic-batching >=2x / zero-retrace gate
+serving-check:
+	$(CPUENV) bash ci/check_serving.sh
 
 # multi-chip sharding dryrun (DP / SP+TP / PP / EP) on 8 virtual devices
 dryrun:

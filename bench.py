@@ -165,6 +165,103 @@ def _synth_recordio(n, classes, side=(280, 320)):
     return path + ".rec"
 
 
+def _serving_bench(platform):
+    """BENCH_MODE=serving: dynamic-batching throughput.
+
+    Ragged traffic (3 distinct request lengths) through a
+    serving.ModelServer versus the SAME requests through a looped
+    single-request Predictor that is already pre-warmed at every
+    bucket shape — the strongest fair baseline (it never retraces
+    either; the delta is pure batching). Gate (ci/check_serving.sh):
+    >=2x and zero steady-state traces."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import exec_cache, serving
+
+    n_requests = int(os.environ.get("BENCH_SERVING_REQUESTS", "240"))
+    max_batch = int(os.environ.get("BENCH_SERVING_MAX_BATCH", "8"))
+    vocab, embed, classes = 1000, 32, 16
+    lengths = (6, 12, 24)       # ragged mix
+    buckets = (8, 16, 32)       # geometric length grid
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.Embedding(data, input_dim=vocab, output_dim=embed,
+                           name="embed")
+    net = mx.sym.mean(net, axis=1)
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc")
+    shapes, _, _ = net.infer_shape(data=(1, buckets[-1]))
+    rs = np.random.RandomState(0)
+    params = {n: mx.nd.array(rs.normal(0, 0.1, s).astype("float32"))
+              for n, s in zip(net.list_arguments(), shapes)
+              if n != "data"}
+    reqs = [rs.randint(0, vocab,
+                       size=(int(rs.choice(lengths)),)).astype("int32")
+            for _ in range(n_requests)]
+
+    # ---- baseline: single-request loop over pre-warmed bucket preds
+    base = mx.Predictor(net.tojson(), params,
+                        {"data": (1, buckets[-1])},
+                        input_dtypes={"data": "int32"})
+    by_len = {L: base.reshaped({"data": (1, L)}) for L in buckets}
+    for L, p in by_len.items():
+        p.set_input("data", np.zeros((1, L), np.int32))
+        p.forward()
+        p.get_output()
+    t0 = time.perf_counter()
+    for ids in reqs:
+        L = serving.pick_bucket(len(ids), buckets)
+        padded = np.zeros((1, L), np.int32)
+        padded[0, : len(ids)] = ids
+        p = by_len[L]
+        p.set_input("data", padded)
+        p.forward()
+        p.get_output()
+    single_rps = n_requests / (time.perf_counter() - t0)
+
+    # ---- serving path: submit everything, collect futures
+    server = serving.ModelServer(max_batch=max_batch,
+                                 max_wait_us=2000,
+                                 queue_cap=max(4096, n_requests))
+    model = server.load("bench", net.tojson(), params,
+                        input_specs={"data": ("L",)},
+                        input_dtypes={"data": "int32"},
+                        length_buckets=buckets)
+    traces0 = exec_cache.cache_stats()["traces"]
+    t0 = time.perf_counter()
+    futs = [server.submit("bench", {"data": ids}) for ids in reqs]
+    for f in futs:
+        f.result(timeout=120)
+    dt = time.perf_counter() - t0
+    traces_added = exec_cache.cache_stats()["traces"] - traces0
+    rps = n_requests / dt
+    snap = model.stats.snapshot()
+    server.stop()
+
+    cache_info = exec_cache.cache_stats()
+    _emit({
+        "metric": f"serving_throughput_{platform}"
+                  f"_b{max_batch}_len{'-'.join(map(str, lengths))}",
+        "value": round(rps, 2),
+        "unit": "req/s",
+        "vs_single": round(rps / single_rps, 3) if single_rps else 0.0,
+        "single_req_s": round(single_rps, 2),
+        "p50_ms": snap["p50_ms"],
+        "p99_ms": snap["p99_ms"],
+        "batch_fill": snap["batch_fill"],
+        "padding_waste": snap["padding_waste"],
+        "batches": snap["batches"],
+        "traces_added": traces_added,
+        "traces_since_warmup": snap["traces_since_warmup"],
+        "requests": n_requests,
+        "exec_cache": {
+            k: cache_info[k]
+            for k in ("hits", "misses", "traces", "evictions")
+        },
+        "platform": platform,
+    })
+
+
 def main():
     # BENCH_XLA_FLAGS: extra XLA flags for A/B capture runs (e.g.
     # "--xla_tpu_enable_latency_hiding_scheduler=true"); appended
@@ -212,6 +309,9 @@ def main():
                           5.0)
     except Exception:
         pass
+
+    if os.environ.get("BENCH_MODE", "train") == "serving":
+        return _serving_bench(jax.devices()[0].platform)
 
     import jax.numpy as jnp
     import numpy as np

@@ -6,6 +6,13 @@ sharding over the ICI/DCN device mesh replaces the ps-lite parameter
 server. See SURVEY.md at the repo root for the full blueprint.
 """
 
+from . import _dist_bootstrap
+
+# join the launcher's coordination service BEFORE any submodule can
+# create the jax backend — on CPU the gloo collectives only attach at
+# client construction (see _dist_bootstrap docstring)
+_dist_bootstrap.maybe_init_distributed()
+
 from . import base
 from .base import MXNetError
 from .context import (
@@ -54,6 +61,7 @@ from .feed_forward import FeedForward
 from . import rtc
 from . import predictor
 from .predictor import Predictor
+from . import serving
 from . import module
 from . import module as mod
 from . import parallel

@@ -1,0 +1,85 @@
+"""Early jax.distributed bootstrap — MUST run before the jax backend
+exists.
+
+The reference initializes ps-lite from DMLC_* env vars the moment the
+first KVStore is created (kvstore_dist.h:37 InitPSEnv); the jax analog
+is stricter: `jax.distributed.initialize` attaches the coordination
+client (and, on CPU, the gloo cross-process collectives) to the backend
+*at backend-creation time*. Importing mxnet_tpu touches jax.devices()
+almost immediately, so the launcher env vars (MXNET_TPU_COORDINATOR /
+MXNET_TPU_NUM_WORKERS / MXNET_TPU_WORKER_ID, set by tools/launch.py)
+are consumed here, at the very top of the package import, before any
+submodule can instantiate the backend.
+
+CPU backend note: XLA's CPU client has no native cross-process
+collectives ("Multiprocess computations aren't implemented on the CPU
+backend") unless a collectives implementation is attached at client
+construction. When the worker is pinned to CPU we request gloo — the
+threaded TCP fallback jax ships for exactly this single-host
+multi-process CI pattern. `cpu_collectives_available()` reports whether
+that wiring succeeded so callers can skip (with an explicit reason)
+the genuinely unsupported cases instead of failing mid-collective.
+"""
+from __future__ import annotations
+
+import os
+
+_initialized = False
+_cpu_collectives = None  # None = unknown, True/False once probed
+
+
+def _want_cpu_backend():
+    plats = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    return plats in ("cpu",) or plats.startswith("cpu,")
+
+
+def launcher_env():
+    """(coordinator, num_workers, worker_id) from the launcher env, or
+    None when not running under tools/launch.py (or an MPI runtime)."""
+    coord = os.environ.get("MXNET_TPU_COORDINATOR")
+    n = os.environ.get("MXNET_TPU_NUM_WORKERS")
+    wid = os.environ.get("MXNET_TPU_WORKER_ID")
+    if wid is None and os.environ.get("MXNET_TPU_WORKER_ID_FROM_MPI"):
+        # mpi launcher: rank comes from the MPI runtime
+        wid = os.environ.get("OMPI_COMM_WORLD_RANK") or \
+            os.environ.get("PMI_RANK")
+    if coord and n and wid is not None:
+        return coord, int(n), int(wid)
+    return None
+
+
+def maybe_init_distributed():
+    """Initialize jax.distributed from launcher env vars. No-ops when
+    absent or already initialized. Safe to call late (KVStore creation)
+    — the import-time call has already done the work by then."""
+    global _initialized, _cpu_collectives
+    if _initialized:
+        return
+    env = launcher_env()
+    if env is None:
+        return
+    coord, n, wid = env
+    import jax
+
+    if _want_cpu_backend():
+        try:
+            jax.config.update(
+                "jax_cpu_collectives_implementation", "gloo")
+            _cpu_collectives = True
+        except Exception:
+            _cpu_collectives = False
+    jax.distributed.initialize(
+        coordinator_address=coord, num_processes=n, process_id=wid,
+    )
+    _initialized = True
+
+
+def cpu_collectives_available():
+    """Whether cross-process XLA computations work on this process's
+    CPU backend (gloo attached at client construction). True on
+    non-CPU backends (TPU/GPU collectives are native)."""
+    if not _want_cpu_backend():
+        return True
+    if _cpu_collectives is not None:
+        return _cpu_collectives
+    return False

@@ -49,19 +49,54 @@ def _client():
     return distributed.global_state.client
 
 
-def _try_get_bytes(key):
-    """None when the key is absent (the client raises NOT_FOUND)."""
+def _try_get_bytes(key, timeout_ms=200):
+    """None when the key is absent. Newer jaxlib exposes a true
+    non-blocking probe (key_value_try_get_bytes); this jaxlib
+    (<=0.4.36) only has the blocking get, so absence costs a short
+    DEADLINE_EXCEEDED wait — the hot polling paths avoid per-key
+    probes entirely via `_dir_get_bytes`."""
+    cl = _client()
+    fn = getattr(cl, "key_value_try_get_bytes", None)
+    if fn is not None:
+        try:
+            return fn(key)
+        except Exception:
+            return None
     try:
-        return _client().key_value_try_get_bytes(key)
+        return cl.blocking_key_value_get_bytes(key, timeout_ms)
     except Exception:
         return None
 
 
-def _try_get(key):
+def _try_get(key, timeout_ms=200):
+    cl = _client()
+    fn = getattr(cl, "key_value_try_get", None)
+    if fn is not None:
+        try:
+            return fn(key)
+        except Exception:
+            return None
     try:
-        return _client().key_value_try_get(key)
+        return cl.blocking_key_value_get(key, timeout_ms)
     except Exception:
         return None
+
+
+def _dir_get_bytes(prefix):
+    """All (full_key, blob) pairs under `prefix` in ONE coordination-
+    service round trip — the server polls gradients with this instead
+    of probing every (key, rank, seq) cell individually."""
+    try:
+        return list(_client().key_value_dir_get_bytes(prefix))
+    except Exception:
+        return []
+
+
+def _dir_get(prefix):
+    try:
+        return list(_client().key_value_dir_get(prefix))
+    except Exception:
+        return []
 
 
 def _delete(key):
@@ -106,18 +141,26 @@ class KVStoreDistAsync(KVStoreTPU):
 
     # --------------------------------------------------------- lifecycle
     def _start_heartbeat(self):
+        def beat_once():
+            try:
+                _client().key_value_set(
+                    f"ps/hb/{self._rank}", str(time.time()),
+                    allow_overwrite=True)
+            except TypeError:
+                _client().key_value_set(
+                    f"ps/hb/{self._rank}", str(time.time()))
+            except Exception:
+                pass
+
+        # first beat lands synchronously: init()'s startup barrier then
+        # guarantees every rank's heartbeat is visible before any rank
+        # can ask get_num_dead_node
+        beat_once()
+
         def beat():
             while not self._stop.is_set():
-                try:
-                    _client().key_value_set(
-                        f"ps/hb/{self._rank}", str(time.time()),
-                        allow_overwrite=True)
-                except TypeError:
-                    _client().key_value_set(
-                        f"ps/hb/{self._rank}", str(time.time()))
-                except Exception:
-                    pass
                 self._stop.wait(_HB_INTERVAL)
+                beat_once()
 
         self._hb_thread = threading.Thread(
             target=beat, name="kv_heartbeat", daemon=True)
@@ -137,23 +180,33 @@ class KVStoreDistAsync(KVStoreTPU):
 
         def serve():
             while not self._stop.is_set():
+                # ONE dir scan per cycle picks up every pending push;
+                # per-(key, rank) seq ordering is enforced locally so a
+                # worker's updates apply in the order it issued them
+                # (async across workers, FIFO within one)
+                arrived = {}
+                for full_key, blob in _dir_get_bytes("ps/g/"):
+                    tail = full_key.split("ps/g/", 1)[-1]
+                    arrived[tail] = (full_key, blob)
                 progressed = False
                 for k in list(self._store):
                     for r in range(self._nproc):
-                        s = self._applied.get((k, r), 0)
-                        blob = _try_get_bytes(f"ps/g/{k}/{r}/{s}")
-                        if blob is None:
-                            continue
-                        grad = nd_array(_loads(blob))
-                        if self._updater is not None:
-                            self._updater(
-                                _str_key(k), grad, self._store[k])
-                        else:
-                            grad.copyto(self._store[k])
-                        self._publish(k)
-                        _delete(f"ps/g/{k}/{r}/{s}")
-                        self._applied[(k, r)] = s + 1
-                        progressed = True
+                        while True:
+                            s = self._applied.get((k, r), 0)
+                            hit = arrived.get(f"{k}/{r}/{s}")
+                            if hit is None:
+                                break
+                            full_key, blob = hit
+                            grad = nd_array(_loads(blob))
+                            if self._updater is not None:
+                                self._updater(
+                                    _str_key(k), grad, self._store[k])
+                            else:
+                                grad.copyto(self._store[k])
+                            self._publish(k)
+                            _delete(full_key)
+                            self._applied[(k, r)] = s + 1
+                            progressed = True
                 if not progressed:
                     time.sleep(_POLL)
 
@@ -240,10 +293,16 @@ class KVStoreDistAsync(KVStoreTPU):
         is older than `timeout` seconds (or missing after startup)."""
         if self._nproc == 1:
             return 0
+        beats = {}
+        for full_key, ts in _dir_get("ps/hb/"):
+            try:
+                beats[int(full_key.rsplit("/", 1)[-1])] = float(ts)
+            except ValueError:
+                pass
         now = time.time()
         dead = 0
         for r in range(self._nproc):
-            ts = _try_get(f"ps/hb/{r}")
-            if ts is None or now - float(ts) > timeout:
+            ts = beats.get(r)
+            if ts is None or now - ts > timeout:
                 dead += 1
         return dead

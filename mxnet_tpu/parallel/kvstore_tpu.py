@@ -41,33 +41,10 @@ from ..base import MXNetError
 from ..kvstore import KVStore, _ctype_key_value, _str_key
 from ..ndarray import NDArray
 
-_dist_initialized = False
-
-
-def maybe_init_distributed():
-    """Initialize jax.distributed from launcher env vars (the analog of
-    ps-lite's InitPSEnv from DMLC_* env vars, kvstore_dist.h:37):
-    MXNET_TPU_COORDINATOR, MXNET_TPU_NUM_WORKERS, MXNET_TPU_WORKER_ID —
-    set by tools/launch.py. No-ops when absent or already initialized."""
-    global _dist_initialized
-    if _dist_initialized:
-        return
-    import os
-
-    coord = os.environ.get("MXNET_TPU_COORDINATOR")
-    n = os.environ.get("MXNET_TPU_NUM_WORKERS")
-    wid = os.environ.get("MXNET_TPU_WORKER_ID")
-    if wid is None and os.environ.get("MXNET_TPU_WORKER_ID_FROM_MPI"):
-        # mpi launcher: rank comes from the MPI runtime
-        wid = os.environ.get("OMPI_COMM_WORLD_RANK") or \
-            os.environ.get("PMI_RANK")
-    if coord and n and wid is not None:
-        jax.distributed.initialize(
-            coordinator_address=coord,
-            num_processes=int(n),
-            process_id=int(wid),
-        )
-        _dist_initialized = True
+# The actual init lives in _dist_bootstrap (it must run at package
+# import, before the jax backend exists — on CPU the gloo collectives
+# attach at client construction). Kept as a re-export for callers.
+from .._dist_bootstrap import maybe_init_distributed  # noqa: F401
 
 
 class KVStoreTPU(KVStore):

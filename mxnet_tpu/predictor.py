@@ -23,7 +23,8 @@ class Predictor(object):
     executor (c_predict_api.cc MXPredCreatePartialOut)."""
 
     def __init__(self, symbol_json, param_data, input_shapes, ctx=None,
-                 output_names=None, dev_type="cpu", dev_id=0):
+                 output_names=None, dev_type="cpu", dev_id=0,
+                 input_dtypes=None):
         if ctx is None:
             ctx = cpu(dev_id)
         self._ctx = ctx
@@ -59,6 +60,9 @@ class Predictor(object):
         self._arg_params = arg_params
         self._aux_params = aux_params
         self._input_shapes = dict(input_shapes)
+        self._input_dtypes = {
+            k: np.dtype(v) for k, v in (input_dtypes or {}).items()
+        }
         self._bind()
 
     def _bind(self):
@@ -69,7 +73,9 @@ class Predictor(object):
         args = {}
         for name, shape in zip(symbol.list_arguments(), arg_shapes):
             if name in self._input_shapes:
-                args[name] = nd.zeros(shape, ctx=self._ctx)
+                args[name] = nd.zeros(
+                    shape, ctx=self._ctx,
+                    dtype=self._input_dtypes.get(name, np.float32))
             elif name in self._arg_params:
                 args[name] = self._arg_params[name].copyto(self._ctx) \
                     if hasattr(self._arg_params[name], "copyto") \
@@ -105,10 +111,14 @@ class Predictor(object):
         )
 
     def set_input(self, name, data):
-        """MXPredSetInput."""
+        """MXPredSetInput. The write takes the BOUND buffer's dtype —
+        an int32-bound input (embedding indices, token ids; see
+        `input_dtypes`) must not round-trip through float32, which
+        silently corrupts ids above 2^24."""
         if name not in self._input_shapes:
             raise MXNetError(f"{name!r} is not an input")
-        self._exec.arg_dict[name][:] = np.asarray(data, np.float32)
+        buf = self._exec.arg_dict[name]
+        buf[:] = np.asarray(data, dtype=buf.dtype)
 
     def forward(self):
         """MXPredForward."""
@@ -143,6 +153,7 @@ class Predictor(object):
         p._arg_params = self._arg_params
         p._aux_params = self._aux_params
         p._input_shapes = dict(new_input_shapes)
+        p._input_dtypes = dict(self._input_dtypes)
         p._bind()
         return p
 

@@ -145,19 +145,35 @@ def exec_cache_stats():
     return cache_stats()
 
 
+def serving_stats():
+    """Per-served-model counters of the serving tier (qps, queue depth,
+    batch fill, padding waste, latency percentiles, retrace guard) —
+    mxnet_tpu.serving.stats; embedded in every dump_profile output."""
+    from .serving.stats import serving_stats as _ss
+
+    return _ss()
+
+
 def dump_profile(device_trace_dir=None):
     """Write collected events as ONE Chrome trace-event JSON (the
     reference emits a single unified trace, src/engine/profiler.cc:134):
     host-side framework events on pid 0, and — when a jax device
     capture ran — the XLA device timeline merged in under offset
     pids. Top-level `execCacheStats` carries the compiled-computation
-    cache counters (chrome://tracing ignores unknown keys)."""
+    cache counters and `servingStats` the per-model serving counters
+    (chrome://tracing ignores unknown keys)."""
     with _lock:
         events = list(_events)
         _events.clear()
     trace = {"traceEvents": [], "displayTimeUnit": "ms"}
     try:
         trace["execCacheStats"] = exec_cache_stats()
+    except Exception:
+        pass
+    try:
+        stats = serving_stats()
+        if stats:
+            trace["servingStats"] = stats
     except Exception:
         pass
     for name, cat, b, e in events:

@@ -1,0 +1,38 @@
+"""mxnet_tpu.serving — dynamic-batching inference server layer.
+
+Turns the one-request-at-a-time `Predictor` into a throughput surface:
+requests are bucketed into a small grid of padded (batch, length)
+shapes so the whole service runs on a handful of exec_cache'd compiled
+programs — zero steady-state retraces — with bounded-queue
+backpressure, per-request deadlines, and multi-model/version routing.
+
+    from mxnet_tpu import serving
+    server = serving.ModelServer()
+    server.load("clf", symbol_json, params,
+                input_specs={"data": ("L",)},
+                input_dtypes={"data": "int32"},
+                length_buckets=(16, 32, 64))     # warmup pre-traces all
+    out = server.predict("clf", {"data": token_ids})   # sync
+    fut = server.submit("clf", {"data": token_ids})    # async Future
+
+Modules: batcher (queue + bucketing + flush policy), server
+(ModelServer front door), registry (multi-model + warmup), stats
+(qps/latency/fill/padding counters -> mx.profiler dumps), config
+(MXNET_SERVING_* env knobs). Guide: docs/serving.md.
+"""
+from . import batcher, config, registry, server, stats
+from .batcher import (BucketSpec, DynamicBatcher, DeadlineExceededError,
+                      ServerBusyError, ServerClosedError, ServingError,
+                      default_batch_buckets, pick_bucket)
+from .registry import ModelRegistry, ServedModel
+from .server import ModelServer
+from .stats import ServingStats, reset_serving_stats, serving_stats
+
+__all__ = [
+    "BucketSpec", "DynamicBatcher", "DeadlineExceededError",
+    "ModelRegistry", "ModelServer", "ServedModel", "ServerBusyError",
+    "ServerClosedError", "ServingError", "ServingStats",
+    "batcher", "config", "default_batch_buckets", "pick_bucket",
+    "registry", "reset_serving_stats", "server", "serving_stats",
+    "stats",
+]

@@ -1,0 +1,299 @@
+"""Dynamic batcher: bounded request queue + shape bucketing + padding.
+
+The throughput problem this solves: `Predictor.forward` is one XLA
+dispatch per request, and every *distinct* request shape is a fresh
+trace + compile. Serving traffic is ragged (token sequences of every
+length), so naive serving either retraces constantly or runs batch=1
+forever. The fix, following the shape-bucketing insight of Ragged
+Paged Attention (PAPERS.md): quantize the request space into a small
+grid of (batch, length) buckets, pad every request up to its bucket,
+and run the whole service on that handful of pre-traced programs —
+the exec_cache then guarantees zero steady-state retraces. Padding is
+sliced off per-request on the way out.
+
+Flush policy (the classic dynamic-batching tradeoff): a bucket's
+pending group is dispatched when it reaches `max_batch` (throughput
+bound) or when its oldest request has waited `max_wait_us`
+(latency bound). Admission is fast-fail: a full queue raises
+`ServerBusyError` immediately — backpressure the client can act on,
+instead of unbounded buffering (`MXNET_SERVING_QUEUE_CAP`).
+
+Knobs (env defaults, overridable per server — utils/__init__.py):
+  MXNET_SERVING_MAX_BATCH       largest batch bucket (default 8)
+  MXNET_SERVING_MAX_WAIT_US     flush deadline for a partial batch
+  MXNET_SERVING_QUEUE_CAP       bounded-queue admission limit
+  MXNET_SERVING_BUCKETS         batch buckets, e.g. "1,2,4,8"
+  MXNET_SERVING_LENGTH_BUCKETS  ragged-axis buckets, e.g. "16,32,64"
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from ..base import MXNetError
+
+
+class ServingError(MXNetError):
+    """Base class of serving-layer errors."""
+
+
+class ServerBusyError(ServingError):
+    """Admission control: the bounded request queue is full. Fast-fail
+    backpressure — retry with jitter or shed load upstream."""
+
+
+class DeadlineExceededError(ServingError):
+    """The request's deadline passed before its batch executed."""
+
+
+class ServerClosedError(ServingError):
+    """The server/batcher is shut down."""
+
+
+def _parse_buckets(raw):
+    vals = sorted({int(v) for v in raw.split(",") if v.strip()})
+    if not vals or any(v <= 0 for v in vals):
+        raise ServingError(f"invalid bucket list {raw!r}")
+    return tuple(vals)
+
+
+def pick_bucket(value, buckets):
+    """Smallest bucket >= value; raises when value exceeds the grid."""
+    for b in buckets:
+        if value <= b:
+            return b
+    raise ServingError(
+        f"size {value} exceeds largest configured bucket {buckets[-1]}")
+
+
+def default_batch_buckets(max_batch):
+    """Powers of two up to max_batch (inclusive): each bucket is one
+    compiled program, so the grid stays logarithmic in max_batch."""
+    out = []
+    b = 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return tuple(out)
+
+
+class BucketSpec:
+    """The (batch, length) bucket grid one served model runs on.
+
+    `input_specs` gives each input's PER-REQUEST shape, with the ragged
+    axis spelled as the string "L" (at most one per input, leading axis
+    by convention): {"data": ("L",)} for token ids, {"image": (3, 32,
+    32)} for fixed shapes. Models with no ragged axis ignore
+    `length_buckets` (a single pseudo-bucket of 0 keys the grid).
+    """
+
+    def __init__(self, input_specs, batch_buckets, length_buckets=None,
+                 pad_value=0.0):
+        self.input_specs = {k: tuple(v) for k, v in input_specs.items()}
+        self.batch_buckets = tuple(sorted(set(batch_buckets)))
+        self.ragged = any(
+            "L" in spec for spec in self.input_specs.values())
+        for spec in self.input_specs.values():
+            if spec.count("L") > 1:
+                raise ServingError(
+                    f"at most one ragged axis per input: {spec}")
+        if self.ragged and not length_buckets:
+            raise ServingError(
+                "input_specs declare a ragged axis 'L' but no "
+                "length_buckets were configured")
+        self.length_buckets = (
+            tuple(sorted(set(length_buckets))) if self.ragged else (0,))
+        self.pad_value = pad_value
+
+    @property
+    def max_batch(self):
+        return self.batch_buckets[-1]
+
+    def all_buckets(self):
+        """Every (batch, length) cell — the complete compiled-program
+        grid a registry warmup must pre-trace."""
+        return [(b, lb) for lb in self.length_buckets
+                for b in self.batch_buckets]
+
+    def input_shapes(self, batch, length):
+        """Concrete Predictor input_shapes for one grid cell."""
+        out = {}
+        for name, spec in self.input_specs.items():
+            out[name] = (batch,) + tuple(
+                length if d == "L" else d for d in spec)
+        return out
+
+    # ----------------------------------------------------- per request
+    def request_length(self, inputs):
+        """The ragged extent of one request (validates that every
+        ragged input agrees); 0 for fixed-shape services."""
+        if not self.ragged:
+            for name, spec in self.input_specs.items():
+                arr = inputs[name]
+                if tuple(arr.shape) != spec:
+                    raise ServingError(
+                        f"input {name!r}: got shape {tuple(arr.shape)}, "
+                        f"spec is {spec}")
+            return 0
+        length = None
+        for name, spec in self.input_specs.items():
+            arr = inputs[name]
+            if len(arr.shape) != len(spec):
+                raise ServingError(
+                    f"input {name!r}: rank {len(arr.shape)} != "
+                    f"spec rank {len(spec)}")
+            for dim, d in zip(arr.shape, spec):
+                if d == "L":
+                    if length is not None and dim != length:
+                        raise ServingError(
+                            f"ragged axes disagree across inputs "
+                            f"({length} vs {dim})")
+                    length = dim
+                elif dim != d:
+                    raise ServingError(
+                        f"input {name!r}: fixed dim {dim} != {d}")
+        return int(length)
+
+    def length_bucket(self, length):
+        return pick_bucket(length, self.length_buckets) \
+            if self.ragged else 0
+
+    # ------------------------------------------------------- assembly
+    def assemble(self, requests):
+        """Stack + pad a same-length-bucket group into one feed dict of
+        shape (batch_bucket, ...length_bucket...). Returns (feed,
+        batch_bucket, length_bucket, real_elems, padded_elems)."""
+        n = len(requests)
+        batch = pick_bucket(n, self.batch_buckets)
+        lb = requests[0].bucket
+        feed = {}
+        real = padded = 0
+        for name, spec in self.input_specs.items():
+            shape = self.input_shapes(batch, lb)[name]
+            first = requests[0].inputs[name]
+            buf = np.full(shape, self.pad_value,
+                          dtype=np.asarray(first).dtype)
+            for i, r in enumerate(requests):
+                arr = np.asarray(r.inputs[name])
+                buf[(i,) + tuple(slice(0, d) for d in arr.shape)] = arr
+                real += arr.size
+            padded += buf.size
+            feed[name] = buf
+        return feed, batch, lb, real, padded
+
+    def disassemble(self, outputs, requests, length_bucket):
+        """Per-request output slices: always drop the padded batch
+        rows; additionally slice axis 1 back to the request's true
+        length when it spans the padded length bucket (elementwise /
+        per-position outputs). Feature axes that merely coincide with
+        the bucket size are the documented limitation — configure
+        non-colliding length buckets for such models."""
+        per_req = []
+        for r in requests:
+            outs = []
+            for out in outputs:
+                row = out[r.row]
+                if (self.ragged and row.ndim >= 1
+                        and row.shape[0] == length_bucket
+                        and r.length < length_bucket):
+                    row = row[:r.length]
+                outs.append(row)
+            per_req.append(outs)
+        return per_req
+
+
+class _Request:
+    __slots__ = ("inputs", "future", "t_enqueue", "deadline", "length",
+                 "bucket", "row")
+
+    def __init__(self, inputs, future, deadline, length, bucket):
+        self.inputs = inputs
+        self.future = future
+        self.t_enqueue = time.monotonic()
+        self.deadline = deadline      # absolute monotonic, or None
+        self.length = length
+        self.bucket = bucket
+        self.row = None               # batch row, set at assembly
+
+
+class DynamicBatcher:
+    """Bounded multi-bucket FIFO with the max-batch / max-wait flush
+    policy. One producer side (submit threads) and one consumer side
+    (the model's worker thread) rendezvous on a single condition
+    variable; all waiting happens in the consumer."""
+
+    def __init__(self, spec, max_wait_us, queue_cap):
+        self.spec = spec
+        self.max_wait_s = max_wait_us / 1e6
+        self.queue_cap = int(queue_cap)
+        self._cond = threading.Condition()
+        self._pending = {lb: [] for lb in spec.length_buckets}
+        self._count = 0
+        self._closed = False
+
+    def depth(self):
+        with self._cond:
+            return self._count
+
+    def put(self, request):
+        with self._cond:
+            if self._closed:
+                raise ServerClosedError("batcher is shut down")
+            if self._count >= self.queue_cap:
+                raise ServerBusyError(
+                    f"request queue full ({self.queue_cap}); "
+                    "retry with backoff")
+            self._pending[request.bucket].append(request)
+            self._count += 1
+            self._cond.notify()
+
+    def close(self):
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def _ready_group(self, now):
+        """The flush decision. Returns (bucket, requests) or (None,
+        wait_s): a full group flushes immediately; otherwise the group
+        holding the OLDEST request flushes once that request has aged
+        past max_wait (partial batch, latency bound)."""
+        oldest_t, oldest_lb = None, None
+        for lb, group in self._pending.items():
+            if len(group) >= self.spec.max_batch:
+                return lb, None
+            if group and (oldest_t is None
+                          or group[0].t_enqueue < oldest_t):
+                oldest_t, oldest_lb = group[0].t_enqueue, lb
+        if oldest_lb is None:
+            return None, None          # nothing pending: block
+        age = now - oldest_t
+        if age >= self.max_wait_s or self._closed:
+            return oldest_lb, None     # drain on close
+        return None, self.max_wait_s - age
+
+    def next_batch(self, poll_s=0.1):
+        """Block until a group is ready (or the batcher is closed and
+        drained). Returns a list of requests, or None when closed+empty
+        or nothing arrived within poll_s."""
+        with self._cond:
+            deadline = time.monotonic() + poll_s
+            while True:
+                now = time.monotonic()
+                lb, wait = self._ready_group(now)
+                if lb is not None:
+                    group = self._pending[lb]
+                    take = group[:self.spec.max_batch]
+                    self._pending[lb] = group[self.spec.max_batch:]
+                    self._count -= len(take)
+                    return take
+                if self._closed and self._count == 0:
+                    return None
+                if wait is None:       # empty: bounded idle wait
+                    if now >= deadline:
+                        return None
+                    self._cond.wait(min(poll_s, deadline - now))
+                else:                  # partial batch aging toward flush
+                    self._cond.wait(wait)

@@ -1,0 +1,32 @@
+"""Env-knob resolution for the serving tier (registered in
+mxnet_tpu.utils so `describe_env()`/docs/env_vars.md cover them).
+
+Resolution order everywhere: explicit constructor argument > MXNET_*
+env var > built-in default.
+"""
+from __future__ import annotations
+
+from .. import utils
+from .batcher import _parse_buckets
+
+
+def max_batch():
+    return utils.getenv("MXNET_SERVING_MAX_BATCH")
+
+
+def max_wait_us():
+    return utils.getenv("MXNET_SERVING_MAX_WAIT_US")
+
+
+def queue_cap():
+    return utils.getenv("MXNET_SERVING_QUEUE_CAP")
+
+
+def batch_buckets():
+    raw = utils.getenv("MXNET_SERVING_BUCKETS")
+    return _parse_buckets(raw) if raw else None
+
+
+def length_buckets():
+    raw = utils.getenv("MXNET_SERVING_LENGTH_BUCKETS")
+    return _parse_buckets(raw) if raw else None

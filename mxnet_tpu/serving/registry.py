@@ -1,0 +1,182 @@
+"""Multi-model registry: (name, version) -> Predictor-backed handle.
+
+One process serves many models (the reference's deploy story is one
+Predictor per embedded app; a serving tier multiplexes). Each
+`ServedModel` owns a grid of bucket-bound Predictors that all SHARE
+one loaded parameter set (`Predictor.reshaped` aliases weights, the
+MXPredReshape semantics) and — through the exec_cache — share traced
+programs with any other executor bound to the same signature.
+
+Warmup is the load-time contract: `ServedModel.warmup()` runs one
+forward through EVERY (batch, length) bucket, forcing the trace + XLA
+compile of each grid cell before the model is marked ready. First user
+requests then never pay compile latency, and steady-state serving adds
+zero new traces (stats.traces_since_warmup proves it).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..predictor import Predictor
+from .batcher import BucketSpec, ServingError, default_batch_buckets
+from .stats import ServingStats, _register, _unregister
+
+
+class ServedModel:
+    """One loaded model version: bucket grid + predictors + stats."""
+
+    def __init__(self, name, version, predictor, spec):
+        self.name = name
+        self.version = int(version)
+        self.spec = spec
+        self.stats = ServingStats()
+        self._base = predictor
+        self._by_bucket = {}
+        self._lock = threading.Lock()
+        self._warm = False
+
+    @property
+    def key(self):
+        return f"{self.name}:{self.version}"
+
+    def predictor_for(self, batch, length):
+        """The bucket's bound Predictor (bind-on-first-touch; warmup
+        touches every cell so serving never binds on the hot path)."""
+        cell = (batch, length)
+        with self._lock:
+            pred = self._by_bucket.get(cell)
+            if pred is None:
+                shapes = self.spec.input_shapes(batch, length)
+                pred = self._base.reshaped(shapes)
+                self._by_bucket[cell] = pred
+        return pred
+
+    def warmup(self):
+        """Pre-trace every bucket: one zero-batch forward per grid
+        cell. Idempotent."""
+        if self._warm:
+            return self
+        for batch, length in self.spec.all_buckets():
+            pred = self.predictor_for(batch, length)
+            for name, shape in self.spec.input_shapes(
+                    batch, length).items():
+                dtype = pred._input_dtypes.get(name, np.float32)
+                pred.set_input(name, np.zeros(shape, dtype=dtype))
+            pred.forward()
+            # materialize: the jit traces on first call, the compile
+            # finishes before get_output returns
+            for i in range(pred.num_outputs):
+                pred.get_output(i)
+        self._warm = True
+        self.stats.mark_warmup_done()
+        return self
+
+    def infer(self, feed, batch, length):
+        """Run one assembled batch; returns the raw padded outputs."""
+        pred = self.predictor_for(batch, length)
+        for name, arr in feed.items():
+            pred.set_input(name, arr)
+        pred.forward()
+        return [pred.get_output(i) for i in range(pred.num_outputs)]
+
+
+class ModelRegistry:
+    """name -> {version -> ServedModel}; lookups default to the latest
+    version (the classic serving-registry convention)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._models: "dict[str, dict[int, ServedModel]]" = {}
+
+    def load(self, name, symbol_json, param_data, input_specs,
+             version=1, ctx=None, input_dtypes=None, output_names=None,
+             batch_buckets=None, length_buckets=None, max_batch=None,
+             pad_value=0.0, warmup=True):
+        """Load + (by default) warm one model version.
+
+        input_specs: per-request shapes with the ragged axis as "L"
+        (batcher.BucketSpec). The largest (batch, length) cell binds
+        the base Predictor; every other cell is a `reshaped` view
+        sharing its parameters."""
+        from . import config as _cfg
+
+        if max_batch is None:
+            max_batch = _cfg.max_batch()
+        if batch_buckets is None:
+            batch_buckets = _cfg.batch_buckets() or \
+                default_batch_buckets(max_batch)
+        if length_buckets is None:
+            length_buckets = _cfg.length_buckets()
+        spec = BucketSpec(input_specs, batch_buckets,
+                          length_buckets=length_buckets,
+                          pad_value=pad_value)
+        base_shapes = spec.input_shapes(spec.batch_buckets[-1],
+                                        spec.length_buckets[-1])
+        predictor = Predictor(
+            symbol_json, param_data, base_shapes, ctx=ctx,
+            output_names=output_names, input_dtypes=input_dtypes)
+        model = ServedModel(name, version, predictor, spec)
+        with self._lock:
+            versions = self._models.setdefault(name, {})
+            if version in versions:
+                raise ServingError(
+                    f"model {name!r} version {version} already loaded")
+            versions[version] = model
+        if warmup:
+            model.warmup()
+        _register(model.key, model.stats)
+        return model
+
+    def load_checkpoint(self, name, prefix, epoch, input_specs,
+                        **kwargs):
+        """Serve a `save_checkpoint` artifact: `prefix-symbol.json` +
+        `prefix-%04d.params` (model.load_checkpoint layout)."""
+        from .. import ndarray as nd
+
+        with open(f"{prefix}-symbol.json") as f:
+            symbol_json = f.read()
+        params = nd.load(f"{prefix}-{epoch:04d}.params")
+        return self.load(name, symbol_json, params, input_specs,
+                         **kwargs)
+
+    def get(self, name, version=None):
+        with self._lock:
+            versions = self._models.get(name)
+            if not versions:
+                raise ServingError(f"model {name!r} is not loaded")
+            if version is None:
+                version = max(versions)
+            model = versions.get(int(version))
+            if model is None:
+                raise ServingError(
+                    f"model {name!r} has no version {version} "
+                    f"(loaded: {sorted(versions)})")
+            return model
+
+    def unload(self, name, version=None):
+        with self._lock:
+            versions = self._models.get(name)
+            if not versions:
+                raise ServingError(f"model {name!r} is not loaded")
+            if version is None:
+                removed, self._models[name] = dict(versions), {}
+            else:
+                if int(version) not in versions:
+                    raise ServingError(
+                        f"model {name!r} has no version {version}")
+                removed = {int(version): versions.pop(int(version))}
+            if not self._models[name]:
+                del self._models[name]
+        for model in removed.values():
+            _unregister(model.key)
+        return list(removed.values())
+
+    def models(self):
+        """[(name, version), ...] of every loaded model."""
+        with self._lock:
+            return sorted(
+                (name, v)
+                for name, versions in self._models.items()
+                for v in versions)

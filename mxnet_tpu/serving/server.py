@@ -1,0 +1,224 @@
+"""ModelServer: the serving front door — admission, batching workers,
+deadlines, sync + async APIs.
+
+Request lifecycle:
+
+  submit() ── admission ──> DynamicBatcher.put ──> per-model worker
+     │         (queue cap ->   (bounded FIFO per     thread: flush ->
+     │          ServerBusyError) length bucket)      pad/stack ->
+     │                                               Predictor.forward
+     └── returns concurrent.futures.Future <──────── unpad + set_result
+
+One worker thread per model keeps each bucket-Predictor single-
+threaded (an Executor is not concurrency-safe) while XLA releases the
+GIL during compute, so submit threads keep feeding the queue under a
+running batch. `predict()` is submit().result() — the sync
+convenience. Deadlines are checked at admission (fast-fail an already-
+dead request) and again at flush time (a request whose deadline passed
+while queued raises DeadlineExceededError instead of wasting a batch
+slot).
+
+Shutdown: `stop()` closes admission, drains pending groups through the
+workers (drain=True, default) or fails them with ServerClosedError
+(drain=False), then joins the threads. Context-manager friendly.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+
+from .batcher import (DynamicBatcher, DeadlineExceededError,
+                      ServerClosedError, _Request)
+from .registry import ModelRegistry
+from . import config as _cfg
+
+
+class _ModelLane:
+    """One model's batcher + worker thread."""
+
+    def __init__(self, model, max_wait_us, queue_cap):
+        self.model = model
+        self.batcher = DynamicBatcher(model.spec, max_wait_us,
+                                      queue_cap)
+        model.stats._queue_depth_fn = self.batcher.depth
+        self.thread = None
+
+    def start(self, loop):
+        self.thread = threading.Thread(
+            target=loop, args=(self,),
+            name=f"serving-{self.model.key}", daemon=True)
+        self.thread.start()
+
+
+class ModelServer:
+    """Dynamic-batching inference server over a ModelRegistry."""
+
+    def __init__(self, registry=None, max_batch=None, max_wait_us=None,
+                 queue_cap=None):
+        self.registry = registry or ModelRegistry()
+        self._max_batch = max_batch
+        self._max_wait_us = (max_wait_us if max_wait_us is not None
+                             else _cfg.max_wait_us())
+        self._queue_cap = (queue_cap if queue_cap is not None
+                           else _cfg.queue_cap())
+        self._lanes = {}
+        self._lock = threading.Lock()
+        self._closed = False
+
+    # ------------------------------------------------------ model mgmt
+    def load(self, name, symbol_json, param_data, input_specs,
+             **kwargs):
+        """Registry load + lane start: the model is ready for traffic
+        (warmed: every bucket pre-traced) when this returns."""
+        kwargs.setdefault("max_batch", self._max_batch)
+        model = self.registry.load(name, symbol_json, param_data,
+                                   input_specs, **kwargs)
+        self._start_lane(model)
+        return model
+
+    def load_checkpoint(self, name, prefix, epoch, input_specs,
+                        **kwargs):
+        kwargs.setdefault("max_batch", self._max_batch)
+        model = self.registry.load_checkpoint(name, prefix, epoch,
+                                              input_specs, **kwargs)
+        self._start_lane(model)
+        return model
+
+    def serve(self, model):
+        """Attach a lane to an already-registered ServedModel (for a
+        registry shared across servers)."""
+        self._start_lane(model)
+        return model
+
+    def _start_lane(self, model):
+        with self._lock:
+            if self._closed:
+                raise ServerClosedError("server is stopped")
+            if model.key in self._lanes:
+                return
+            lane = _ModelLane(model, self._max_wait_us,
+                              self._queue_cap)
+            self._lanes[model.key] = lane
+        lane.start(self._worker_loop)
+
+    def unload(self, name, version=None):
+        removed = self.registry.unload(name, version=version)
+        for model in removed:
+            with self._lock:
+                lane = self._lanes.pop(model.key, None)
+            if lane is not None:
+                lane.batcher.close()
+                lane.thread.join(timeout=30)
+        return removed
+
+    # ------------------------------------------------------- data path
+    def submit(self, name, inputs, version=None, deadline_ms=None):
+        """Async inference: returns a Future of the request's output
+        list (one numpy array per model output, padding sliced off).
+        Raises ServerBusyError synchronously when the queue is full."""
+        model = self.registry.get(name, version=version)
+        with self._lock:
+            lane = self._lanes.get(model.key)
+            closed = self._closed
+        if lane is None or closed:
+            raise ServerClosedError(
+                f"no active lane for {model.key} (server stopped or "
+                "model not served)")
+        stats = model.stats
+        stats.note_submitted()
+        length = model.spec.request_length(inputs)
+        bucket = model.spec.length_bucket(length)
+        deadline = (time.monotonic() + deadline_ms / 1e3
+                    if deadline_ms is not None else None)
+        fut = Future()
+        req = _Request(inputs, fut, deadline, length, bucket)
+        try:
+            lane.batcher.put(req)
+        except Exception as exc:
+            stats.note_rejected()
+            raise exc
+        return fut
+
+    def predict(self, name, inputs, version=None, deadline_ms=None,
+                timeout=None):
+        """Sync inference (the Predictor.forward ergonomics, batched
+        under the hood)."""
+        fut = self.submit(name, inputs, version=version,
+                          deadline_ms=deadline_ms)
+        return fut.result(timeout=timeout)
+
+    # ---------------------------------------------------------- worker
+    def _worker_loop(self, lane):
+        model, batcher = lane.model, lane.batcher
+        spec, stats = model.spec, model.stats
+        while True:
+            group = batcher.next_batch()
+            if group is None:
+                if batcher._closed and batcher.depth() == 0:
+                    return
+                continue
+            now = time.monotonic()
+            live = []
+            for r in group:
+                if r.deadline is not None and now > r.deadline:
+                    stats.note_expired()
+                    r.future.set_exception(DeadlineExceededError(
+                        "deadline passed while queued "
+                        f"(waited {(now - r.t_enqueue) * 1e3:.1f} ms)"))
+                else:
+                    live.append(r)
+            if not live:
+                continue
+            for row, r in enumerate(live):
+                r.row = row
+            try:
+                feed, batch, lb, real, padded = spec.assemble(live)
+                outs = model.infer(feed, batch, lb)
+                per_req = spec.disassemble(outs, live, lb)
+            except Exception as exc:
+                stats.note_failed(len(live))
+                for r in live:
+                    if not r.future.set_running_or_notify_cancel():
+                        continue
+                    r.future.set_exception(exc)
+                continue
+            stats.note_batch(len(live), batch,
+                             real_elems=real, padded_elems=padded)
+            done = time.monotonic()
+            for r, outputs in zip(live, per_req):
+                stats.note_completed(done - r.t_enqueue, now=done)
+                if r.future.set_running_or_notify_cancel():
+                    r.future.set_result(outputs)
+
+    # -------------------------------------------------------- lifecycle
+    def stop(self, drain=True, timeout=30):
+        """Close admission and shut the workers down. drain=True lets
+        queued requests complete; drain=False fails them fast."""
+        with self._lock:
+            self._closed = True
+            lanes = list(self._lanes.values())
+        for lane in lanes:
+            if not drain:
+                # fail pending before the worker can flush them
+                with lane.batcher._cond:
+                    pending = [r for g in
+                               lane.batcher._pending.values()
+                               for r in g]
+                    for g in lane.batcher._pending.values():
+                        g.clear()
+                    lane.batcher._count = 0
+                for r in pending:
+                    r.future.set_exception(
+                        ServerClosedError("server stopped"))
+            lane.batcher.close()
+        for lane in lanes:
+            if lane.thread is not None:
+                lane.thread.join(timeout=timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -1,0 +1,172 @@
+"""Per-model serving counters — the observability plane of
+`mxnet_tpu.serving`.
+
+The exec_cache precedent (exec_cache.cache_stats -> profiler
+`execCacheStats`) extends to the serving tier: every `ServedModel`
+owns one `ServingStats`, registered in a module-level table so
+`serving_stats()` can snapshot the whole process, and
+`mx.profiler.dump_profile` embeds the same snapshot as a top-level
+`servingStats` key (chrome://tracing ignores unknown keys).
+
+What is counted and why:
+  qps / completed        sustained load (10 s sliding window)
+  queue_depth            backlog the flush policy is working against
+  batch_fill             live requests / padded batch slots — how much
+                         of each compiled program's batch dimension did
+                         real work
+  padding_waste          padded elements that carried no request data /
+                         total padded elements — the cost of shape
+                         bucketing (cf. Ragged Paged Attention's metric)
+  p50/p95/p99_ms         end-to-end request latency (enqueue -> result)
+  traces_since_warmup    compiled-program constructions after warmup —
+                         MUST stay 0 in steady state (the whole point
+                         of bucketing into pre-traced shapes)
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+_registry_lock = threading.Lock()
+_registry: "dict[str, ServingStats]" = {}
+
+_QPS_WINDOW_S = 10.0
+_LATENCY_KEEP = 2048
+
+
+def _register(key, stats):
+    with _registry_lock:
+        _registry[key] = stats
+
+
+def _unregister(key):
+    with _registry_lock:
+        _registry.pop(key, None)
+
+
+def serving_stats():
+    """Snapshot of every live served model: {\"name:version\": {...}}."""
+    with _registry_lock:
+        items = list(_registry.items())
+    return {key: st.snapshot() for key, st in items}
+
+
+def reset_serving_stats():
+    with _registry_lock:
+        items = list(_registry.values())
+    for st in items:
+        st.reset()
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+class ServingStats:
+    """Counters for one served model. All mutation happens under one
+    lock; the hot-path cost is a few integer adds per request/batch."""
+
+    def __init__(self, queue_depth_fn=None):
+        self._lock = threading.Lock()
+        self._queue_depth_fn = queue_depth_fn
+        self.reset()
+
+    def reset(self):
+        with self._lock:
+            self.submitted = 0
+            self.completed = 0
+            self.failed = 0
+            self.rejected = 0      # queue-full fast-fails
+            self.expired = 0       # deadline passed before execution
+            self.batches = 0
+            self.batch_slots = 0   # sum of padded batch sizes
+            self.batch_live = 0    # sum of live requests per batch
+            self.padded_elems = 0  # total elements dispatched
+            self.real_elems = 0    # elements carrying request data
+            self.traces_at_warmup = None
+            self._latencies = deque(maxlen=_LATENCY_KEEP)
+            self._done_times = deque(maxlen=8192)
+
+    # ------------------------------------------------------ recording
+    def note_submitted(self):
+        with self._lock:
+            self.submitted += 1
+
+    def note_rejected(self):
+        with self._lock:
+            self.rejected += 1
+
+    def note_expired(self, n=1):
+        with self._lock:
+            self.expired += n
+
+    def note_failed(self, n=1):
+        with self._lock:
+            self.failed += n
+
+    def note_batch(self, live, slots, real_elems, padded_elems):
+        with self._lock:
+            self.batches += 1
+            self.batch_live += live
+            self.batch_slots += slots
+            self.real_elems += real_elems
+            self.padded_elems += padded_elems
+
+    def note_completed(self, latency_s, n=1, now=None):
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self.completed += n
+            self._latencies.append(latency_s)
+            self._done_times.append((now, n))
+
+    def mark_warmup_done(self):
+        """Record the exec-cache trace floor: anything above this in
+        steady state is a retrace the bucketing failed to prevent."""
+        from ..exec_cache import cache_stats
+
+        with self._lock:
+            self.traces_at_warmup = cache_stats()["traces"]
+
+    # ------------------------------------------------------- snapshot
+    def snapshot(self):
+        from ..exec_cache import cache_stats
+
+        traces_now = cache_stats()["traces"]
+        now = time.monotonic()
+        with self._lock:
+            lat = sorted(self._latencies)
+            recent = sum(
+                n for t, n in self._done_times
+                if now - t <= _QPS_WINDOW_S)
+            out = {
+                "submitted": self.submitted,
+                "completed": self.completed,
+                "failed": self.failed,
+                "rejected": self.rejected,
+                "expired": self.expired,
+                "batches": self.batches,
+                "qps": round(recent / _QPS_WINDOW_S, 3),
+                "batch_fill": round(
+                    self.batch_live / self.batch_slots, 4)
+                if self.batch_slots else 0.0,
+                "padding_waste": round(
+                    1.0 - self.real_elems / self.padded_elems, 4)
+                if self.padded_elems else 0.0,
+                "p50_ms": round(_percentile(lat, 0.50) * 1e3, 3),
+                "p95_ms": round(_percentile(lat, 0.95) * 1e3, 3),
+                "p99_ms": round(_percentile(lat, 0.99) * 1e3, 3),
+                "traces_since_warmup": (
+                    traces_now - self.traces_at_warmup
+                    if self.traces_at_warmup is not None else None),
+            }
+        try:
+            out["queue_depth"] = (
+                self._queue_depth_fn() if self._queue_depth_fn else 0)
+        except Exception:
+            out["queue_depth"] = 0
+        return out

@@ -141,6 +141,34 @@ register_env(
     "a private program (docs/faq.md).",
 )
 register_env(
+    "MXNET_SERVING_MAX_BATCH", int, 8,
+    "serving: largest batch bucket of the dynamic batcher — one "
+    "compiled program per (batch, length) bucket; a bucket group "
+    "flushes the moment it reaches this size (mxnet_tpu.serving).",
+)
+register_env(
+    "MXNET_SERVING_MAX_WAIT_US", int, 2000,
+    "serving: max microseconds a partial batch waits for co-riders "
+    "before flushing — the latency bound of the batching tradeoff.",
+)
+register_env(
+    "MXNET_SERVING_QUEUE_CAP", int, 256,
+    "serving: bounded request-queue admission limit per model; a full "
+    "queue fast-fails submits with ServerBusyError (backpressure) "
+    "instead of buffering unboundedly.",
+)
+register_env(
+    "MXNET_SERVING_BUCKETS", str, "",
+    "serving: comma-separated batch buckets (e.g. '1,2,4,8') "
+    "overriding the powers-of-two default grid up to MAX_BATCH.",
+)
+register_env(
+    "MXNET_SERVING_LENGTH_BUCKETS", str, "",
+    "serving: comma-separated ragged-axis buckets (e.g. '16,32,64') "
+    "for models whose input_specs declare an 'L' axis; requests pad "
+    "up to the nearest bucket (docs/serving.md).",
+)
+register_env(
     "MXNET_EXEC_CACHE_SIZE", int, 64,
     "LRU bound on retained exec_cache entries; raise it when cycling "
     "more distinct bucket/shape signatures than this. Stats: "

@@ -59,6 +59,7 @@ EXAMPLES = {
     "rnn_time_major/rnn_time_major.py": [],
     "python_howto/howto_walkthrough.py": [],
     "module_api/module_walkthrough.py": [],
+    "serving/serve_checkpoint.py": ["--requests", "30"],
 }
 
 

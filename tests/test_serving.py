@@ -1,0 +1,336 @@
+"""Serving tier (mxnet_tpu.serving): bucket selection + padding
+round-trip, max-batch vs max-wait flush, queue-full backpressure,
+deadline expiry, multi-model registry isolation, and the retrace
+guarantee — steady-state serving adds ZERO compiled-program traces
+(the whole point of mapping ragged traffic into a pre-warmed bucket
+grid)."""
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import exec_cache, serving
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    for var in ("MXNET_SERVING_MAX_BATCH", "MXNET_SERVING_MAX_WAIT_US",
+                "MXNET_SERVING_QUEUE_CAP", "MXNET_SERVING_BUCKETS",
+                "MXNET_SERVING_LENGTH_BUCKETS"):
+        monkeypatch.delenv(var, raising=False)
+    # drop stats of models from earlier tests (nothing unloads them)
+    serving.stats._registry.clear()
+    yield
+
+
+def _params_for(net, **input_shapes):
+    shapes, _, _ = net.infer_shape(**input_shapes)
+    rs = np.random.RandomState(7)
+    return {
+        n: mx.nd.array(rs.uniform(-1, 1, s).astype("float32"))
+        for n, s in zip(net.list_arguments(), shapes)
+        if n not in input_shapes
+    }
+
+
+def _token_net(vocab=64, d=8, classes=4):
+    data = mx.sym.Variable("data")
+    emb = mx.sym.Embedding(data, input_dim=vocab, output_dim=d,
+                           name="emb")
+    return mx.sym.FullyConnected(
+        mx.sym.mean(emb, axis=1), num_hidden=classes, name="fc")
+
+
+def _elementwise_net():
+    """Per-position output (B, L): padding the tail cannot perturb the
+    valid prefix, so the round-trip is exactly checkable."""
+    return mx.sym.Variable("data") * 2.0 + 1.0
+
+
+# ---------------------------------------------------------- bucketing
+def test_pick_bucket_and_defaults():
+    assert serving.pick_bucket(3, (1, 2, 4, 8)) == 4
+    assert serving.pick_bucket(8, (1, 2, 4, 8)) == 8
+    with pytest.raises(serving.ServingError):
+        serving.pick_bucket(9, (1, 2, 4, 8))
+    assert serving.default_batch_buckets(8) == (1, 2, 4, 8)
+    assert serving.default_batch_buckets(6) == (1, 2, 4, 6)
+    assert serving.default_batch_buckets(1) == (1,)
+
+
+def test_bucket_spec_shapes_and_validation():
+    spec = serving.BucketSpec({"data": ("L",), "mask": ("L", 3)},
+                              batch_buckets=(1, 4),
+                              length_buckets=(8, 16))
+    assert spec.input_shapes(4, 8) == {"data": (4, 8),
+                                       "mask": (4, 8, 3)}
+    assert len(spec.all_buckets()) == 4
+    n = spec.request_length({"data": np.zeros(5),
+                             "mask": np.zeros((5, 3))})
+    assert n == 5 and spec.length_bucket(n) == 8
+    with pytest.raises(serving.ServingError):
+        spec.request_length({"data": np.zeros(5),
+                             "mask": np.zeros((6, 3))})
+    # ragged spec without length buckets is a config error
+    with pytest.raises(serving.ServingError):
+        serving.BucketSpec({"data": ("L",)}, batch_buckets=(1,))
+
+
+def test_padding_round_trip_exact():
+    """Ragged requests map into (batch, length) buckets and come back
+    sliced to their true shapes with exact values."""
+    net = _elementwise_net()
+    srv = serving.ModelServer(max_batch=4, max_wait_us=3000)
+    srv.load("ew", net.tojson(), _params_for(net, data=(1, 16)),
+             input_specs={"data": ("L",)}, length_buckets=(4, 8, 16))
+    rs = np.random.RandomState(0)
+    lengths = [2, 3, 4, 5, 7, 8, 11, 16, 1]
+    xs = [rs.uniform(-1, 1, (n,)).astype("float32") for n in lengths]
+    futs = [srv.submit("ew", {"data": x}) for x in xs]
+    for x, fut in zip(xs, futs):
+        (out,) = fut.result(timeout=10)
+        assert out.shape == x.shape, (out.shape, x.shape)
+        np.testing.assert_allclose(out, x * 2.0 + 1.0, rtol=1e-6)
+    srv.stop()
+
+
+def test_oversize_request_rejected():
+    net = _elementwise_net()
+    with serving.ModelServer(max_batch=2, max_wait_us=1000) as srv:
+        srv.load("ew", net.tojson(), _params_for(net, data=(1, 8)),
+                 input_specs={"data": ("L",)}, length_buckets=(8,))
+        with pytest.raises(serving.ServingError):
+            srv.submit("ew", {"data": np.zeros(9, np.float32)})
+
+
+# ------------------------------------------------------- flush policy
+def test_flush_on_max_batch_not_wait():
+    """With a huge max_wait, a group flushes the instant it fills —
+    one full batch, not four timeouts."""
+    net = _token_net()
+    srv = serving.ModelServer(max_batch=4, max_wait_us=30_000_000)
+    m = srv.load("clf", net.tojson(), _params_for(net, data=(1, 8)),
+                 input_specs={"data": ("L",)},
+                 input_dtypes={"data": "int32"}, length_buckets=(8,))
+    t0 = time.monotonic()
+    futs = [srv.submit("clf",
+                       {"data": np.ones(8, np.int32)})
+            for _ in range(4)]
+    for f in futs:
+        f.result(timeout=10)
+    assert time.monotonic() - t0 < 10.0
+    snap = m.stats.snapshot()
+    assert snap["batches"] == 1 and snap["batch_fill"] == 1.0, snap
+    srv.stop()
+
+
+def test_flush_on_max_wait_partial_batch():
+    """A lone request must not wait for co-riders forever: the
+    max-wait bound flushes a partial (padded) batch."""
+    net = _token_net()
+    srv = serving.ModelServer(max_batch=8, max_wait_us=20_000)
+    m = srv.load("clf", net.tojson(), _params_for(net, data=(1, 8)),
+                 input_specs={"data": ("L",)},
+                 input_dtypes={"data": "int32"}, length_buckets=(8,))
+    (out,) = srv.predict("clf", {"data": np.ones(5, np.int32)},
+                         timeout=10)
+    assert out.shape == (4,)
+    snap = m.stats.snapshot()
+    assert snap["batches"] == 1, snap
+    # length 5 padded to the 8-bucket: 3/8 of dispatched elems are pad
+    assert snap["padding_waste"] == pytest.approx(3 / 8), snap
+    srv.stop()
+
+
+# ------------------------------------------------------- backpressure
+def test_queue_full_fast_fails():
+    """Admission control: cap 2, worker starved of a full batch by a
+    huge max_wait — the third submit must raise ServerBusyError
+    immediately instead of buffering."""
+    net = _token_net()
+    srv = serving.ModelServer(max_batch=8, max_wait_us=30_000_000,
+                              queue_cap=2)
+    m = srv.load("clf", net.tojson(), _params_for(net, data=(1, 8)),
+                 input_specs={"data": ("L",)},
+                 input_dtypes={"data": "int32"}, length_buckets=(8,))
+    x = {"data": np.ones(8, np.int32)}
+    f1, f2 = srv.submit("clf", x), srv.submit("clf", x)
+    with pytest.raises(serving.ServerBusyError):
+        srv.submit("clf", x)
+    assert m.stats.snapshot()["rejected"] == 1
+    # drain on stop: queued work still completes
+    srv.stop(drain=True)
+    assert f1.result(timeout=10) and f2.result(timeout=10)
+
+
+def test_stop_without_drain_fails_pending():
+    net = _token_net()
+    srv = serving.ModelServer(max_batch=8, max_wait_us=30_000_000)
+    srv.load("clf", net.tojson(), _params_for(net, data=(1, 8)),
+             input_specs={"data": ("L",)},
+             input_dtypes={"data": "int32"}, length_buckets=(8,))
+    fut = srv.submit("clf", {"data": np.ones(8, np.int32)})
+    srv.stop(drain=False)
+    with pytest.raises(serving.ServerClosedError):
+        fut.result(timeout=10)
+    with pytest.raises(serving.ServerClosedError):
+        srv.submit("clf", {"data": np.ones(8, np.int32)})
+
+
+# ----------------------------------------------------------- deadlines
+def test_deadline_expiry():
+    """A request whose deadline passes while queued raises
+    DeadlineExceededError at flush instead of occupying a batch."""
+    net = _token_net()
+    srv = serving.ModelServer(max_batch=8, max_wait_us=300_000)
+    m = srv.load("clf", net.tojson(), _params_for(net, data=(1, 8)),
+                 input_specs={"data": ("L",)},
+                 input_dtypes={"data": "int32"}, length_buckets=(8,))
+    fut = srv.submit("clf", {"data": np.ones(8, np.int32)},
+                     deadline_ms=1)
+    with pytest.raises(serving.DeadlineExceededError):
+        fut.result(timeout=10)
+    assert m.stats.snapshot()["expired"] == 1
+    # a deadline-free request on the same lane still completes
+    assert srv.predict("clf", {"data": np.ones(8, np.int32)},
+                       timeout=10)
+    srv.stop()
+
+
+# --------------------------------------------------------- multi-model
+def test_multi_model_registry_isolation():
+    """Two models + two versions: requests route to the right weights
+    and each model keeps its own counters."""
+    net = _elementwise_net()
+    tok = _token_net()
+    srv = serving.ModelServer(max_batch=2, max_wait_us=3000)
+    srv.load("ew", net.tojson(), _params_for(net, data=(1, 8)),
+             input_specs={"data": ("L",)}, length_buckets=(8,))
+    srv.load("clf", tok.tojson(), _params_for(tok, data=(1, 8)),
+             input_specs={"data": ("L",)},
+             input_dtypes={"data": "int32"}, length_buckets=(8,))
+    # second version of "ew" with DIFFERENT semantics (x*2+1 vs x+1 is
+    # not expressible with shared params — reuse net but version=2)
+    srv.load("ew", net.tojson(), _params_for(net, data=(1, 8)),
+             input_specs={"data": ("L",)}, length_buckets=(8,),
+             version=2)
+    assert srv.registry.models() == [("clf", 1), ("ew", 1), ("ew", 2)]
+
+    x = np.arange(4, dtype=np.float32)
+    (out,) = srv.predict("ew", {"data": x}, timeout=10)  # -> latest (2)
+    np.testing.assert_allclose(out, x * 2 + 1, rtol=1e-6)
+    (out1,) = srv.predict("ew", {"data": x}, version=1, timeout=10)
+    np.testing.assert_allclose(out1, x * 2 + 1, rtol=1e-6)
+    (cls,) = srv.predict("clf", {"data": np.ones(5, np.int32)},
+                         timeout=10)
+    assert cls.shape == (4,)
+
+    stats = serving.serving_stats()
+    assert stats["ew:2"]["completed"] == 1
+    assert stats["ew:1"]["completed"] == 1
+    assert stats["clf:1"]["completed"] == 1
+    with pytest.raises(serving.ServingError):
+        srv.registry.get("nope")
+    with pytest.raises(serving.ServingError):
+        srv.registry.get("ew", version=9)
+    srv.unload("ew", version=2)
+    assert srv.registry.models() == [("clf", 1), ("ew", 1)]
+    assert "ew:2" not in serving.serving_stats()
+    # v1 still serves after v2 unload
+    assert srv.predict("ew", {"data": x}, timeout=10)
+    srv.stop()
+
+
+# ------------------------------------------------------ retrace guard
+def test_steady_state_serving_adds_zero_traces():
+    """Acceptance criterion: after warmup, ragged traffic across >= 3
+    distinct request lengths adds NO compiled-program traces and NO
+    lazy jit builds — every request runs on a pre-traced bucket."""
+    net = _token_net()
+    srv = serving.ModelServer(max_batch=4, max_wait_us=2000)
+    m = srv.load("clf", net.tojson(), _params_for(net, data=(1, 16)),
+                 input_specs={"data": ("L",)},
+                 input_dtypes={"data": "int32"},
+                 length_buckets=(4, 8, 16))
+    base = exec_cache.cache_stats()
+    rs = np.random.RandomState(1)
+    futs = [srv.submit(
+        "clf", {"data": rs.randint(0, 64, (n,)).astype("int32")})
+        for _ in range(10) for n in (3, 7, 13)]
+    for f in futs:
+        f.result(timeout=20)
+    now = exec_cache.cache_stats()
+    assert now["traces"] == base["traces"], (base, now)
+    assert now["jit_builds"] == base["jit_builds"], (base, now)
+    snap = m.stats.snapshot()
+    assert snap["traces_since_warmup"] == 0, snap
+    assert snap["completed"] == 30
+    srv.stop()
+
+
+def test_serving_stats_in_profiler_dump(tmp_path):
+    """servingStats rides every profiler dump next to execCacheStats
+    (the exec_cache precedent)."""
+    import json
+
+    net = _elementwise_net()
+    srv = serving.ModelServer(max_batch=2, max_wait_us=2000)
+    srv.load("ew", net.tojson(), _params_for(net, data=(1, 4)),
+             input_specs={"data": ("L",)}, length_buckets=(4,))
+    srv.predict("ew", {"data": np.ones(3, np.float32)}, timeout=10)
+    out = tmp_path / "prof.json"
+    mx.profiler.profiler_set_config(filename=str(out))
+    mx.profiler.profiler_set_state("run")
+    mx.profiler.profiler_set_state("stop")
+    with open(out) as f:
+        trace = json.load(f)
+    assert "servingStats" in trace
+    assert trace["servingStats"]["ew:1"]["completed"] >= 1
+    srv.stop()
+
+
+# ------------------------------------------------- env knob resolution
+def test_env_knobs_resolve(monkeypatch):
+    monkeypatch.setenv("MXNET_SERVING_MAX_BATCH", "2")
+    monkeypatch.setenv("MXNET_SERVING_MAX_WAIT_US", "1234")
+    monkeypatch.setenv("MXNET_SERVING_QUEUE_CAP", "5")
+    monkeypatch.setenv("MXNET_SERVING_BUCKETS", "1,2")
+    monkeypatch.setenv("MXNET_SERVING_LENGTH_BUCKETS", "8,16")
+    net = _elementwise_net()
+    srv = serving.ModelServer()
+    m = srv.load("ew", net.tojson(), _params_for(net, data=(1, 16)),
+                 input_specs={"data": ("L",)})
+    assert srv._max_wait_us == 1234 and srv._queue_cap == 5
+    assert m.spec.batch_buckets == (1, 2)
+    assert m.spec.length_buckets == (8, 16)
+    assert sorted(m._by_bucket) == [(1, 8), (1, 16), (2, 8), (2, 16)]
+    srv.stop()
+
+
+# ----------------------------------------- predictor dtype regression
+def test_predictor_set_input_respects_bound_dtype():
+    """Regression (serving satellite): set_input forced float32,
+    silently corrupting integer inputs — ids above 2^24 lose exactness
+    in float32. The bound buffer's dtype now wins."""
+    net = _token_net()
+    params = _params_for(net, data=(2, 3))
+    p = mx.Predictor(net.tojson(), params, {"data": (2, 3)},
+                     input_dtypes={"data": "int32"})
+    big = 2 ** 24 + 1   # == 16777217; float32 rounds it to 16777216
+    ids = np.array([[big, 1, 2], [3, big + 2, 5]], dtype=np.int64)
+    p.set_input("data", ids)
+    got = p._exec.arg_dict["data"].asnumpy()
+    assert got.dtype == np.int32
+    np.testing.assert_array_equal(got, ids)  # exact, not float-rounded
+    p.set_input("data", ids % 64)            # back in the vocab range
+    p.forward()
+    assert p.get_output().shape == (2, 4)
+    # reshaped views keep the dtype contract
+    p2 = p.reshaped({"data": (1, 3)})
+    p2.set_input("data", ids[:1] % 64)
+    assert p2._exec.arg_dict["data"].asnumpy().dtype == np.int32
+    # default binding stays float32 (reference behavior)
+    q = mx.Predictor(net.tojson(), params, {"data": (2, 3)})
+    q.set_input("data", np.zeros((2, 3)))
+    assert q._exec.arg_dict["data"].asnumpy().dtype == np.float32

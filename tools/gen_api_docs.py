@@ -35,6 +35,7 @@ MODULES = [
     ("mxnet_tpu.random", "seeded RNG"),
     ("mxnet_tpu.model", "checkpoints + FeedForward"),
     ("mxnet_tpu.fault", "failure detection / auto-resume"),
+    ("mxnet_tpu.serving", "dynamic-batching inference server"),
     ("mxnet_tpu.visualization", "network plots/summaries"),
     ("mxnet_tpu.models", "model zoo builders"),
     ("mxnet_tpu.parallel", "mesh/sharding primitives"),

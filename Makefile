@@ -10,7 +10,7 @@ CPUENV  := JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS=
 XLA8    := XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: all test nightly examples lint libs predict perl docs dryrun \
-	cache-check serving-check clean
+	cache-check serving-check sync-check clean
 
 all: libs test
 
@@ -67,6 +67,11 @@ cache-check:
 # serving tier: test suite + dynamic-batching >=2x / zero-retrace gate
 serving-check:
 	$(CPUENV) bash ci/check_serving.sh
+
+# pipelined-loop tier: the steady-state fit loop performs blocking
+# fetches only at log intervals, never per step
+sync-check:
+	$(CPUENV) $(PY) ci/check_no_perstep_sync.py
 
 # multi-chip sharding dryrun (DP / SP+TP / PP / EP) on 8 virtual devices
 dryrun:

@@ -134,6 +134,12 @@ def _emit(record):
     sys.stdout.flush()
 
 
+def _host_sync_snapshot():
+    from mxnet_tpu import profiler
+
+    return profiler.host_sync_stats()
+
+
 def _synth_recordio(n, classes, side=(280, 320)):
     """ImageNet-shaped .rec of natural-entropy synthetic JPEGs (smooth
     fields + mild noise — realistic decode cost, unlike pure noise)."""
@@ -260,6 +266,88 @@ def _serving_bench(platform):
         },
         "platform": platform,
     })
+
+
+def _fit_pipeline_probe(platform):
+    """A/B the pipelined fit loop against the synchronous loop it
+    replaced: device-resident metrics + dispatch-ahead (defaults) vs
+    MXNET_DEVICE_METRICS=0 + MXNET_DISPATCH_AHEAD=0, on a small MLP
+    through the real Module.fit path.
+
+    Protocol: one warmup fit populates the exec/jit caches so neither
+    variant pays compile; each variant then trains 3 epochs and reports
+    its best steady-state epoch. The speedup reflects host/device
+    OVERLAP, so expect ~1.0 on a single-core host (nothing to overlap
+    with — the invariant that matters there is fit_blocking_fetches ==
+    fit_log_intervals + 1) and >1 with real async headroom (multi-core
+    CPU, and above all the TPU tunnel where a blocking fetch costs a
+    round-trip). Skipped on accelerators unless BENCH_FIT=1 so chip
+    benches stay fast."""
+    if platform != "cpu" and os.environ.get("BENCH_FIT", "0") != "1":
+        return {}
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import profiler as _prof
+
+    batch, steps, frequent = 32, 30, 10
+
+    d = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(d, num_hidden=512, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=512, name="fc2")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=8, name="fc3")
+    net = mx.sym.SoftmaxOutput(h, name="softmax")
+
+    rng = np.random.RandomState(11)
+    x = rng.rand(batch * steps, 128).astype("float32")
+    y = rng.randint(0, 8, size=(batch * steps,)).astype("float32")
+
+    def run(epochs=3):
+        it = mx.io.NDArrayIter(x, y, batch_size=batch, shuffle=False)
+        mod = mx.mod.Module(
+            net, context=[mx.cpu() if platform == "cpu" else mx.tpu()])
+        marks, snaps = [], []
+
+        def epoch_cb(epoch, sym, arg, aux):
+            marks.append(time.perf_counter())
+            snaps.append(_prof.host_sync_stats())
+
+        mx.random.seed(0)
+        t0 = time.perf_counter()
+        mod.fit(it, num_epoch=epochs,
+                batch_end_callback=mx.callback.Speedometer(
+                    batch, frequent),
+                epoch_end_callback=epoch_cb,
+                optimizer_params=(("learning_rate", 0.05),))
+        if epochs == 1:
+            return None, None, None
+        spans = [b - a for a, b in zip([t0] + marks[:-1], marks)]
+        rate = batch * steps / min(spans[1:])  # best steady epoch
+        fetches = (snaps[-1]["blocking_fetches"]
+                   - snaps[-2]["blocking_fetches"])
+        return rate, fetches, snaps[-1]["steps_in_flight_peak"]
+
+    run(epochs=1)  # warm the exec cache + metric jits for BOTH arms
+    os.environ["MXNET_DEVICE_METRICS"] = "0"
+    os.environ["MXNET_DISPATCH_AHEAD"] = "0"
+    try:
+        sync_rate, _sync_fetches, _ = run()
+    finally:
+        del os.environ["MXNET_DEVICE_METRICS"]
+        del os.environ["MXNET_DISPATCH_AHEAD"]
+    pipe_rate, pipe_fetches, peak = run()
+    return {
+        "fit_pipelined_img_s": round(pipe_rate, 2),
+        "fit_synced_img_s": round(sync_rate, 2),
+        "fit_pipeline_speedup": round(
+            pipe_rate / max(sync_rate, 1e-9), 3),
+        # steady-state epoch: should equal log intervals + epoch drain
+        "fit_blocking_fetches": pipe_fetches,
+        "fit_log_intervals": steps // frequent,
+        "steps_in_flight": peak,
+    }
 
 
 def main():
@@ -457,6 +545,7 @@ def main():
         # dispatch calls (data staging excluded): on async backends
         # this is the steady-state per-step host/framework overhead
         dispatch_s = 0.0
+        sync0 = _host_sync_snapshot()
         t0 = time.perf_counter()
         for _ in range(iters // multistep):
             g = next_group()
@@ -473,6 +562,7 @@ def main():
         mod.sync()
 
         dispatch_s = 0.0
+        sync0 = _host_sync_snapshot()
         t0 = time.perf_counter()
         for _ in range(iters):
             b = next_batch()
@@ -482,6 +572,12 @@ def main():
             dispatch_s += time.perf_counter() - d0
         mod.sync()
         dt = time.perf_counter() - t0
+
+    # blocking fetches the timed loop itself performed (0 on the
+    # synthetic path: the loop body never pulls a value to host)
+    host_sync_count = (_host_sync_snapshot()["blocking_fetches"]
+                       - sync0["blocking_fetches"])
+    fit_probe = _fit_pipeline_probe(platform)
 
     img_s = batch * iters / dt
     from mxnet_tpu.utils.flops import count_flops
@@ -520,6 +616,11 @@ def main():
         # the framework+dispatch cost a step pays before the device
         # can run ahead (compile amortization target, exec_cache).
         "dispatch_overhead_us": round(dispatch_s / iters * 1e6, 1),
+        # hostSyncStats: blocking fetches inside the timed loop, plus
+        # the pipelined-fit A/B (fit_* keys; steps_in_flight is the
+        # dispatch-ahead window's high-water mark during that fit)
+        "host_sync_count": host_sync_count,
+        **fit_probe,
         "exec_cache": {
             k: cache_info[k]
             for k in ("hits", "misses", "traces", "evictions")

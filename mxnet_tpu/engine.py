@@ -94,6 +94,9 @@ class ThreadedEngine(object):
         )
 
     def wait_for_all(self):
+        from . import profiler as _profiler
+
+        _profiler.count_host_sync("blocking_waits")
         self._lib.eng_wait_all(self._h)
         # eng_wait_all returns only after every op's completion count
         # was decremented, which the C worker does AFTER the callback

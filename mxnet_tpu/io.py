@@ -14,6 +14,7 @@ import gzip
 import os
 import struct
 import threading
+import time
 
 import numpy as np
 
@@ -176,6 +177,7 @@ class PrefetchingIter(DataIter):
         self.n_iter = len(iters)
         assert self.n_iter > 0
         self.iters = iters
+        self._closed = False
         self.rename_data = rename_data
         self.rename_label = rename_label
         self.batch_size = self.provide_data[0][1][0]
@@ -207,12 +209,41 @@ class PrefetchingIter(DataIter):
             thread.daemon = True
             thread.start()
 
-    def __del__(self):
+    def close(self, timeout=5.0):
+        """Shut the producer threads down. Idempotent; safe to call
+        from __del__, reset(final=True), or context-manager exit. The
+        shutdown flag is cleared BEFORE the wake-up events so a
+        producer that wakes sees it and exits, and join is bounded —
+        a producer wedged inside its inner iterator can no longer
+        hang interpreter exit (threads are daemonic)."""
+        if getattr(self, "_closed", False):
+            return
+        self._closed = True
         self.started = False
-        for e in self.data_taken:
+        for e in getattr(self, "data_taken", []):
             e.set()
-        for thread in self.prefetch_threads:
-            thread.join()
+        deadline = time.monotonic() + timeout
+        for thread, event in zip(getattr(self, "prefetch_threads", []),
+                                 self.data_taken):
+            while thread.is_alive() and time.monotonic() < deadline:
+                # a producer that was mid-fetch when the flag flipped
+                # clears data_taken on its way around the loop —
+                # re-signal until it observes started=False and exits
+                event.set()
+                thread.join(0.05)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     @property
     def provide_data(self):
@@ -248,7 +279,15 @@ class PrefetchingIter(DataIter):
             [],
         )
 
-    def reset(self):
+    def reset(self, final=False):
+        """Rewind the inner iterators; reset(final=True) instead shuts
+        the prefetcher down for good (epoch-loop drivers that know this
+        was the last pass release the producer threads here)."""
+        if final:
+            self.close()
+            return
+        if self._closed:
+            raise MXNetError("PrefetchingIter is closed")
         for e in self.data_ready:
             e.wait()
         for i in self.iters:
@@ -259,6 +298,8 @@ class PrefetchingIter(DataIter):
             e.set()
 
     def iter_next(self):
+        if self._closed:
+            return False
         for e in self.data_ready:
             e.wait()
         if self.next_batch[0] is None:

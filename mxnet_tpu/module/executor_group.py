@@ -15,6 +15,7 @@ import logging
 
 import numpy as np
 
+from .. import metric as _metric
 from .. import ndarray as nd
 from ..base import MXNetError
 from ..io import DataDesc
@@ -403,7 +404,7 @@ class DataParallelExecutorGroup(object):
                     labels_slice.append(label_my_slice)
                 else:
                     labels_slice.append(label)
-            eval_metric.update(labels_slice, texec.outputs)
+            _metric.update_auto(eval_metric, labels_slice, texec.outputs)
 
     def _infer_ith(self, data_shapes, label_shapes):
         """Name-keyed shape/dtype maps for one executor's bind (the

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import autograd as _autograd
+from . import profiler as _profiler
 from . import random as _random
 from .base import MXNetError, _auto_name
 from .context import Context, cpu, current_context, default_context, gpu, tpu
@@ -139,14 +140,17 @@ class NDArray:
 
     # ----------------------------------------------------------- sync
     def wait_to_read(self):
+        _profiler.count_host_sync("blocking_waits")
         jax.block_until_ready(self._data)
 
     def wait_to_write(self):
+        _profiler.count_host_sync("blocking_waits")
         jax.block_until_ready(self._data)
 
     def asnumpy(self):
         # fresh writable copy, matching the reference's D2H copy semantics
         # (device_get can return a read-only view of the device buffer)
+        _profiler.count_host_sync("blocking_fetches")
         return np.array(jax.device_get(self._data))
 
     def asscalar(self):

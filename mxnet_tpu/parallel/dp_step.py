@@ -625,6 +625,7 @@ class FusedTrainStep:
         block_until_ready — remote-dispatch backends (the axon TPU
         tunnel) acknowledge enqueue, not completion, so only a value
         round-trip is a true barrier."""
+        _profiler.count_host_sync("blocking_waits")
         jax.block_until_ready(self.params)
         if self.params:
             leaf = next(iter(self.params.values()))

@@ -26,6 +26,64 @@ _events = []
 _lock = threading.Lock()
 _t0 = time.perf_counter()
 
+# ---- host<->device sync accounting (hostSyncStats) ----------------
+# The pipelined training loop's invariant is "zero per-step blocking
+# syncs"; these counters make it measurable (and CI-enforceable, see
+# ci/check_no_perstep_sync.py). Incremented from the few chokepoints
+# every sync funnels through: NDArray.asnumpy (blocking_fetches),
+# NDArray.wait_to_read / engine.wait_for_all / FusedTrainStep.sync
+# (blocking_waits), EvalMetric drain (metric_fetches), and the
+# dispatch-ahead window in BaseModule.fit (dispatch_stalls /
+# steps_in_flight_peak).
+_sync_lock = threading.Lock()
+_SYNC_KEYS = (
+    "blocking_fetches", "blocking_waits", "metric_fetches",
+    "dispatch_stalls", "stall_time_us", "steps_in_flight_peak",
+)
+_sync_stats = {k: 0 for k in _SYNC_KEYS}
+
+# a wait shorter than this was already complete — dispatch kept ahead,
+# nothing stalled
+_STALL_THRESHOLD_S = 1e-4
+
+
+def count_host_sync(kind, n=1):
+    """Count a host<->device sync point of the given kind
+    ('blocking_fetches' | 'blocking_waits' | 'metric_fetches')."""
+    with _sync_lock:
+        _sync_stats[kind] += n
+
+
+def note_dispatch_stall(seconds):
+    """Record one dispatch-window wait; counts as a stall only when the
+    fenced step was genuinely unfinished."""
+    with _sync_lock:
+        _sync_stats["stall_time_us"] += seconds * 1e6
+        if seconds > _STALL_THRESHOLD_S:
+            _sync_stats["dispatch_stalls"] += 1
+
+
+def note_steps_in_flight(n):
+    """Track the high-water mark of in-flight dispatched steps."""
+    with _sync_lock:
+        if n > _sync_stats["steps_in_flight_peak"]:
+            _sync_stats["steps_in_flight_peak"] = n
+
+
+def host_sync_stats():
+    """Snapshot of the sync counters (embedded in dump_profile as
+    `hostSyncStats` next to execCacheStats/servingStats)."""
+    with _sync_lock:
+        out = dict(_sync_stats)
+    out["stall_time_us"] = round(out["stall_time_us"], 1)
+    return out
+
+
+def reset_host_sync_stats():
+    with _sync_lock:
+        for k in _SYNC_KEYS:
+            _sync_stats[k] = 0
+
 
 def profiler_set_config(mode="symbolic", filename="profile.json"):
     """Configure profiler output (reference profiler.py:10
@@ -176,6 +234,7 @@ def dump_profile(device_trace_dir=None):
             trace["servingStats"] = stats
     except Exception:
         pass
+    trace["hostSyncStats"] = host_sync_stats()
     for name, cat, b, e in events:
         trace["traceEvents"].append({
             "name": name, "cat": cat, "ph": "B",

@@ -169,6 +169,21 @@ register_env(
     "up to the nearest bucket (docs/serving.md).",
 )
 register_env(
+    "MXNET_DISPATCH_AHEAD", int, 2,
+    "max in-flight training steps the fit loop keeps dispatched ahead "
+    "of the device (module/base_module.py): batch N+1 is staged while "
+    "step N runs. Each in-flight step holds its batch + activations in "
+    "HBM — lower it if training OOMs; 0 blocks on every step "
+    "(synchronous, the pre-pipelined behavior).",
+)
+register_env(
+    "MXNET_DEVICE_METRICS", bool, True,
+    "accumulate EvalMetric sums/counts as device scalars, fetched only "
+    "when get() runs (log intervals + epoch end) instead of one "
+    "blocking asnumpy per batch (metric.py update_device). 0 forces "
+    "the host update() path for every metric.",
+)
+register_env(
     "MXNET_EXEC_CACHE_SIZE", int, 64,
     "LRU bound on retained exec_cache entries; raise it when cycling "
     "more distinct bucket/shape signatures than this. Stats: "

@@ -1,0 +1,118 @@
+"""Dispatch-ahead stepping (_DispatchWindow in BaseModule.fit).
+
+The window bounds in-flight steps to MXNET_DISPATCH_AHEAD and drains at
+epoch boundaries, so memory stays bounded while the host runs ahead of
+the device. Pipelining must be an execution-order change only: final
+parameters are identical for any window size, including K=0
+(synchronous).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+from mxnet_tpu.module.base_module import _DispatchWindow
+
+
+def _fence(value):
+    import jax.numpy as jnp
+    return jnp.asarray(value)
+
+
+class TestDispatchWindow:
+    def test_bounds_in_flight(self):
+        w = _DispatchWindow(3)
+        for i in range(10):
+            w.admit(_fence(i))
+            assert len(w._fences) <= 3
+        w.drain()
+        assert not w._fences
+
+    def test_k_zero_is_synchronous(self):
+        w = _DispatchWindow(0)
+        for i in range(5):
+            w.admit(_fence(i))
+            assert not w._fences  # every fence waited on immediately
+
+    def test_none_fence_ignored(self):
+        w = _DispatchWindow(2)
+        w.admit(None)
+        assert not w._fences
+
+    def test_peak_gauge(self):
+        profiler.reset_host_sync_stats()
+        w = _DispatchWindow(4)
+        for i in range(6):
+            w.admit(_fence(i))
+        peak = profiler.host_sync_stats()["steps_in_flight_peak"]
+        assert peak == 4
+        w.drain()
+
+
+def _mlp():
+    d = mx.sym.Variable("data")
+    h = mx.sym.Activation(
+        mx.sym.FullyConnected(d, num_hidden=16, name="fc1"),
+        act_type="relu")
+    return mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, num_hidden=4, name="fc2"),
+        name="softmax")
+
+
+def _fit(k, epochs=2, monkeypatch=None):
+    monkeypatch.setenv("MXNET_DISPATCH_AHEAD", str(k))
+    rng = np.random.RandomState(21)
+    x = rng.rand(64, 10).astype(np.float32)
+    y = rng.randint(0, 4, size=(64,)).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=8, shuffle=False)
+    mod = mx.mod.Module(_mlp(), context=[mx.cpu()])
+    mx.random.seed(7)
+    profiler.reset_host_sync_stats()
+    mod.fit(it, num_epoch=epochs,
+            optimizer_params={"learning_rate": 0.1})
+    stats = profiler.host_sync_stats()
+    args, _ = mod.get_params()
+    return {k2: v.asnumpy() for k2, v in args.items()}, stats
+
+
+def test_fit_params_identical_across_window_sizes(monkeypatch):
+    params_k0, stats_k0 = _fit(0, monkeypatch=monkeypatch)
+    params_k3, stats_k3 = _fit(3, monkeypatch=monkeypatch)
+    assert params_k0.keys() == params_k3.keys()
+    for name in params_k0:
+        assert np.array_equal(params_k0[name], params_k3[name]), name
+    # K=0 never holds a step in flight; K=3 is bounded by 3
+    assert stats_k0["steps_in_flight_peak"] == 0
+    assert 1 <= stats_k3["steps_in_flight_peak"] <= 3
+
+
+def test_fit_peak_respects_env_bound(monkeypatch):
+    _, stats = _fit(1, monkeypatch=monkeypatch)
+    assert stats["steps_in_flight_peak"] <= 1
+
+
+def test_fit_steady_state_fetches_bounded(monkeypatch):
+    """With device metrics on and no per-batch callback, an epoch costs
+    one metric drain (epoch-end get), not one fetch per step."""
+    monkeypatch.setenv("MXNET_DISPATCH_AHEAD", "2")
+    rng = np.random.RandomState(22)
+    x = rng.rand(240, 10).astype(np.float32)
+    y = rng.randint(0, 4, size=(240,)).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=8, shuffle=False)  # 30 steps
+    mod = mx.mod.Module(_mlp(), context=[mx.cpu()])
+    mx.random.seed(7)
+
+    deltas = []
+    last = [None]
+
+    def on_epoch(epoch, sym, arg, aux):
+        s = profiler.host_sync_stats()["blocking_fetches"]
+        if last[0] is not None:
+            deltas.append(s - last[0])
+        last[0] = s
+
+    profiler.reset_host_sync_stats()
+    mod.fit(it, num_epoch=3, epoch_end_callback=on_epoch,
+            optimizer_params={"learning_rate": 0.1})
+    # steady-state epochs: far fewer fetches than the 30 steps each
+    assert deltas and all(d <= 4 for d in deltas), deltas

@@ -72,6 +72,34 @@ def test_prefetching_iter():
     assert len(list(pre)) == 4
 
 
+def test_prefetching_iter_close():
+    data = np.random.rand(16, 2).astype(np.float32)
+    base = NDArrayIter(data, None, batch_size=4)
+    pre = PrefetchingIter(base)
+    assert len(list(pre)) == 4
+    pre.close()
+    pre.close()  # idempotent
+    for t in pre.prefetch_threads:
+        assert not t.is_alive()
+    assert not pre.iter_next()  # closed iterator is exhausted, no hang
+
+
+def test_prefetching_iter_reset_final_and_ctx_manager():
+    data = np.random.rand(16, 2).astype(np.float32)
+    with PrefetchingIter(NDArrayIter(data, None, batch_size=4)) as pre:
+        assert len(list(pre)) == 4
+        pre.reset()
+        assert len(list(pre)) == 4
+    for t in pre.prefetch_threads:
+        assert not t.is_alive()
+
+    pre2 = PrefetchingIter(NDArrayIter(data, None, batch_size=4))
+    next(pre2)
+    pre2.reset(final=True)  # mid-epoch final reset must not hang
+    for t in pre2.prefetch_threads:
+        assert not t.is_alive()
+
+
 def test_csviter():
     with tempfile.TemporaryDirectory() as d:
         data_path = os.path.join(d, "data.csv")

@@ -10,7 +10,7 @@ CPUENV  := JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS=
 XLA8    := XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: all test nightly examples lint libs predict perl docs dryrun \
-	cache-check serving-check sync-check clean
+	cache-check serving-check sync-check data-check clean
 
 all: libs test
 
@@ -72,6 +72,12 @@ serving-check:
 # fetches only at log intervals, never per step
 sync-check:
 	$(CPUENV) $(PY) ci/check_no_perstep_sync.py
+
+# input-pipeline tier: steady-state fit over the mxnet_tpu.data stack
+# has zero input stalls with device prefetch on, and a run killed
+# mid-epoch auto-resumes with a bit-identical remaining batch stream
+data-check:
+	$(CPUENV) $(PY) ci/check_input_stall.py
 
 # multi-chip sharding dryrun (DP / SP+TP / PP / EP) on 8 virtual devices
 dryrun:

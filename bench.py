@@ -268,6 +268,92 @@ def _serving_bench(platform):
     })
 
 
+def _input_bench(platform):
+    """BENCH_MODE=input: throughput of the mxnet_tpu.data pipeline.
+
+    Trains an MLP through Module.fit fed by the full stack (sharded
+    loader + device prefetch) and A/Bs against the synchronous arm
+    (MXNET_DATA_DEVICE_PREFETCH=0, inline host->device staging).
+    Reports batches/s and bytes/s over the best steady-state epoch and
+    each arm's stall fraction — the prefetch arm should be ~0, the
+    sync arm 1.0 by construction (every inline-staged batch stalls)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import data as mxdata
+
+    batch = int(os.environ.get("BENCH_INPUT_BATCH", "32"))
+    steps = int(os.environ.get("BENCH_INPUT_STEPS", "30"))
+    features, classes, epochs = 64, 8, 3
+
+    d = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(d, num_hidden=512, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=512, name="fc2")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=classes, name="fc3")
+    net = mx.sym.SoftmaxOutput(h, name="softmax")
+
+    rng = np.random.RandomState(11)
+    x = rng.rand(batch * steps, features).astype("float32")
+    y = rng.randint(0, classes, size=(batch * steps,)).astype("float32")
+    ctx = mx.cpu() if platform == "cpu" else mx.tpu()
+
+    def run():
+        it = mxdata.make_pipeline(x, batch, label=y, seed=0, ctx=ctx,
+                                  shard_id=0, num_shards=1)
+        mod = mx.mod.Module(net, context=[ctx])
+        marks, snaps = [], []
+
+        def epoch_cb(epoch, sym, arg, aux):
+            marks.append(time.perf_counter())
+            snaps.append(mxdata.input_pipeline_stats())
+
+        mxdata.reset_input_pipeline_stats()
+        t0 = time.perf_counter()
+        try:
+            mod.fit(it, num_epoch=epochs, epoch_end_callback=epoch_cb,
+                    optimizer_params=(("learning_rate", 0.05),))
+        finally:
+            it.close()
+        spans = [b - a for a, b in zip([t0] + marks[:-1], marks)]
+        best = min(spans[1:])  # steady state: epoch 1 holds the compile
+        last, prev = snaps[-1], snaps[-2]
+        served = last["batches"] - prev["batches"]
+        return {
+            "batches_s": round(steps / best, 2),
+            "samples_s": round(batch * steps / best, 2),
+            "bytes_s": round(
+                (last["host_bytes"] - prev["host_bytes"]) / best, 1),
+            "stall_fraction": round(
+                (last["stall_count"] - prev["stall_count"])
+                / max(served, 1), 4),
+        }
+
+    prefetch = run()
+    os.environ["MXNET_DATA_DEVICE_PREFETCH"] = "0"
+    try:
+        sync = run()
+    finally:
+        del os.environ["MXNET_DATA_DEVICE_PREFETCH"]
+
+    _emit({
+        "metric": f"input_pipeline_throughput_{platform}_b{batch}",
+        "value": prefetch["batches_s"],
+        "unit": "batches/s",
+        "samples_s": prefetch["samples_s"],
+        "bytes_s": prefetch["bytes_s"],
+        "stall_fraction": prefetch["stall_fraction"],
+        "sync_batches_s": sync["batches_s"],
+        "sync_stall_fraction": sync["stall_fraction"],
+        "vs_sync": round(
+            prefetch["batches_s"] / max(sync["batches_s"], 1e-9), 3),
+        "batch": batch,
+        "steps_per_epoch": steps,
+        "platform": platform,
+    })
+
+
 def _fit_pipeline_probe(platform):
     """A/B the pipelined fit loop against the synchronous loop it
     replaced: device-resident metrics + dispatch-ahead (defaults) vs
@@ -400,6 +486,8 @@ def main():
 
     if os.environ.get("BENCH_MODE", "train") == "serving":
         return _serving_bench(jax.devices()[0].platform)
+    if os.environ.get("BENCH_MODE", "train") == "input":
+        return _input_bench(jax.devices()[0].platform)
 
     import jax.numpy as jnp
     import numpy as np

@@ -48,6 +48,7 @@ from . import callback
 from . import engine
 from . import io
 from . import recordio
+from . import data
 from . import image
 from . import image_det
 from . import native

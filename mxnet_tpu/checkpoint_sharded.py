@@ -49,9 +49,19 @@ def _tree(fs):
     }
 
 
-def save_sharded(mod, path):
+def _data_state_file(path):
+    # one state file PER PROCESS: each host's loader covers a different
+    # shard, so each checkpoints (and restores) its own position
+    return os.path.join(
+        path, f"data_state_p{jax.process_index()}.json")
+
+
+def save_sharded(mod, path, data_iter=None):
     """Write the module's fused params/auxs/optimizer state to `path`
-    (a directory); each process writes only its own shards."""
+    (a directory); each process writes only its own shards. When
+    `data_iter` speaks the resume protocol (mxnet_tpu.data), its
+    stream position rides along — one file per process — so the
+    checkpoint captures params AND input position at the same step."""
     import orbax.checkpoint as ocp
 
     fs = _fused(mod)
@@ -68,14 +78,20 @@ def save_sharded(mod, path):
 
         with open(os.path.join(path, "mxnet_tpu_meta.json"), "w") as f:
             json.dump(meta, f)
+    if data_iter is not None and hasattr(data_iter, "state_dict"):
+        from .data.state import save_state
+
+        save_state(data_iter, _data_state_file(path))
     return path
 
 
-def load_sharded(mod, path):
+def load_sharded(mod, path, data_iter=None):
     """Restore a save_sharded checkpoint into the module's fused step,
     re-placed under its CURRENT mesh/shardings (restore onto a
     different mesh layout than the save is supported — orbax reshards
-    on read)."""
+    on read). Pass the training `data_iter` to also rewind the input
+    stream to the checkpointed position (this process's own state
+    file; absent = iterator untouched)."""
     import json
 
     import orbax.checkpoint as ocp
@@ -115,4 +131,8 @@ def load_sharded(mod, path):
     mod._fused_dirty = True
     mod._fused_stale = False
     mod._params_dirty = True
+    if data_iter is not None and hasattr(data_iter, "load_state_dict"):
+        from .data.state import load_state
+
+        load_state(data_iter, _data_state_file(path))
     return meta

@@ -1,0 +1,44 @@
+"""mxnet_tpu.data — sharded, resumable, device-prefetching input pipeline.
+
+The training input tier (docs/data.md):
+
+    source (ArraySource / RecordSource / CSVSource)
+      -> ShardedSampler      which rows: epoch-keyed perm, per-host shard
+      -> DataLoader          multi-worker decode into bounded queues
+      -> DevicePrefetchIter  async device_put of the next K batches
+
+`make_pipeline` wires the stack with env-var defaults
+(MXNET_DATA_WORKERS / MXNET_DATA_QUEUE_CAP / MXNET_DATA_DEVICE_PREFETCH
+/ MXNET_DATA_SEED); every tier is also usable alone — DataLoader and
+DevicePrefetchIter are DataIters, drop-ins for Module.fit.
+"""
+from __future__ import annotations
+
+from .device_prefetch import DevicePrefetchIter
+from .loader import (ArraySource, CSVSource, DataLoader, DataPipelineError,
+                     DataSource, RecordSource, as_source)
+from .sampler import ShardedSampler, epoch_permutation
+from .state import is_resumable, load_state, read_state, save_state
+from .stats import input_pipeline_stats, reset_input_pipeline_stats
+
+__all__ = [
+    "ArraySource", "CSVSource", "DataLoader", "DataPipelineError",
+    "DataSource", "DevicePrefetchIter", "RecordSource", "ShardedSampler",
+    "as_source", "epoch_permutation", "input_pipeline_stats",
+    "is_resumable", "load_state", "make_pipeline", "read_state",
+    "reset_input_pipeline_stats", "save_state",
+]
+
+
+def make_pipeline(data, batch_size, label=None, ctx=None, seed=None,
+                  num_workers=None, queue_cap=None, prefetch=None,
+                  shard_id=None, num_shards=None, shuffle=True):
+    """The full stack in one call: source -> sharded loader -> device
+    prefetch. Returns a DataIter ready for Module.fit; pass
+    `prefetch=0` (or MXNET_DATA_DEVICE_PREFETCH=0) for the synchronous
+    host-only path."""
+    loader = DataLoader(
+        data, batch_size, label=label, seed=seed,
+        num_workers=num_workers, queue_cap=queue_cap,
+        shard_id=shard_id, num_shards=num_shards, shuffle=shuffle)
+    return DevicePrefetchIter(loader, ctx=ctx, prefetch=prefetch)

@@ -1,0 +1,293 @@
+"""Device-side prefetch: stage the next K batches onto the accelerator
+so the steady-state training step never blocks on host data.
+
+The host loader (loader.py) overlaps DECODE with compute; this tier
+additionally overlaps the host->device COPY: a stager thread pulls
+host batches and `jax.device_put`s them ahead of the consumer, keeping
+up to `MXNET_DATA_DEVICE_PREFETCH` batches resident (double-buffered at
+the default of 2 — one being consumed, one landing). device_put is
+async (it enqueues a transfer and returns), so by the time `fit` asks
+for batch N+1 its bytes are already on (or streaming into) the device
+while step N runs — composing with the dispatch-ahead window
+(module/base_module.py _DispatchWindow): the window keeps the COMPUTE
+ahead, this keeps the DATA ahead, and the step dispatch in between
+touches only resident arrays.
+
+`MXNET_DATA_DEVICE_PREFETCH=0` degenerates to the synchronous path
+(pull + device_put inline in next()) — the A/B arm the stall counters
+are gated against (ci/check_input_stall.py): synchronously staged
+batches were by definition not resident when asked for, so every one
+counts as a stall; with prefetch on, a steady-state epoch must count
+zero (the first batch after a reset is warmup, not a stall).
+
+Resume: `state_dict()` reports the CONSUMED position, not the staged
+one — batches the stager pulled ahead but never handed out are not
+"seen", so a checkpoint-restore replays exactly the unconsumed tail.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+import jax
+
+from ..context import default_context
+from ..io import DataBatch, DataIter
+from ..ndarray import NDArray
+from . import stats as _stats
+from .loader import DataPipelineError
+
+
+class DevicePrefetchIter(DataIter):
+    """Wrap a DataIter/DataLoader; yield DataBatches whose arrays are
+    already device-resident. DataIter drop-in (Module.fit consumes it
+    unchanged); forwards the resume protocol (set_epoch/state_dict/
+    load_state_dict) when the inner iterator supports it."""
+
+    def __init__(self, data_iter, ctx=None, prefetch=None):
+        from .. import utils as _utils
+
+        super().__init__(getattr(data_iter, "batch_size", 0))
+        self._inner = data_iter
+        self._ctx = ctx if ctx is not None else default_context()
+        self._k = int(prefetch if prefetch is not None
+                      else _utils.getenv("MXNET_DATA_DEVICE_PREFETCH"))
+        self._cond = threading.Condition()
+        self._staged = collections.deque()
+        self._exhausted = False
+        self._error = None
+        self._warmup = self._k + 1
+        self._consumed = 0
+        self._closed = False
+        self._stop = threading.Event()
+        self._thread = None
+        if self._k > 0:
+            self._start()
+
+    # ------------------------------------------------------------ stager
+    def _fetch_inner(self):
+        """One host batch as (data_arrays, label_arrays, provide_data,
+        provide_label) — raw numpy from a DataLoader, NDArray payloads
+        from any other DataIter."""
+        if hasattr(self._inner, "_pop_raw"):
+            data, label = self._inner._pop_raw()
+            return (data, label, self._inner.provide_data,
+                    self._inner.provide_label)
+        batch = self._inner.next()
+        return (batch.data, batch.label or [],
+                batch.provide_data or self.provide_data,
+                batch.provide_label or self.provide_label)
+
+    def _to_device(self, arrays):
+        dev = self._ctx.jax_device()
+        out = []
+        for a in arrays:
+            val = a._data if isinstance(a, NDArray) else a
+            out.append(NDArray(jax.device_put(val, dev), ctx=self._ctx))
+        return out
+
+    def _stage_loop(self, stop_evt):
+        try:
+            while not stop_evt.is_set():
+                with self._cond:
+                    while (len(self._staged) >= self._k
+                           and not stop_evt.is_set()):
+                        self._cond.wait(0.05)
+                if stop_evt.is_set():
+                    return
+                try:
+                    data, label, pd, pl = self._fetch_inner()
+                except StopIteration:
+                    with self._cond:
+                        self._exhausted = True
+                        self._cond.notify_all()
+                    return
+                batch = DataBatch(
+                    data=self._to_device(data),
+                    label=self._to_device(label),
+                    pad=0, index=None,
+                    provide_data=pd, provide_label=pl)
+                with self._cond:
+                    if stop_evt.is_set():
+                        return
+                    self._staged.append(batch)
+                    _stats.note_depth(len(self._staged))
+                    self._cond.notify_all()
+        except Exception as exc:  # noqa: BLE001 — surfaced in next()
+            with self._cond:
+                self._error = exc
+                self._cond.notify_all()
+
+    def _start(self, fill_timeout=10.0):
+        self._stop = threading.Event()
+        self._exhausted = False
+        self._error = None
+        self._staged.clear()
+        self._thread = threading.Thread(
+            target=self._stage_loop, args=(self._stop,), daemon=True)
+        self._thread.start()
+        # pre-fill barrier: don't hand control back until K batches are
+        # resident. reset()/init are already sync points (fit drains the
+        # dispatch window at every epoch boundary), so blocking here is
+        # free — and it means the consumer's epoch-start sprint lands on
+        # staged batches instead of racing a cold pipeline.
+        deadline = time.monotonic() + fill_timeout
+        with self._cond:
+            while (len(self._staged) < self._k and not self._exhausted
+                   and self._error is None
+                   and time.monotonic() < deadline):
+                self._cond.wait(0.05)
+
+    def _halt(self, timeout=5.0):
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        self._staged.clear()
+        self._exhausted = False
+        self._error = None
+
+    # ---------------------------------------------------------- consumer
+    def next(self):
+        if self._closed:
+            raise DataPipelineError("DevicePrefetchIter is closed")
+        if self._k <= 0:
+            return self._next_sync()
+        t0 = time.perf_counter()
+        waited = False
+        with self._cond:
+            while (not self._staged and not self._exhausted
+                   and self._error is None):
+                waited = True
+                self._cond.wait(0.05)
+            if self._error is not None:
+                raise DataPipelineError(
+                    f"device-prefetch stager died: {self._error!r}"
+                ) from self._error
+            if not self._staged:
+                raise StopIteration
+            batch = self._staged.popleft()
+            self._cond.notify_all()  # room for the stager
+        # the first `prefetch`+1 batches after init/reset are pipeline
+        # fill: the deque starts empty, and fit's dispatch window lets
+        # the consumer sprint one batch past the staging depth before
+        # compute backpressure kicks in — not steady-state stalls
+        _stats.note_serve(time.perf_counter() - t0,
+                          stalled=waited and self._warmup == 0)
+        if self._warmup:
+            self._warmup -= 1
+        self._consumed += 1
+        return batch
+
+    def _next_sync(self):
+        """MXNET_DATA_DEVICE_PREFETCH=0: inline pull + device_put. The
+        data was not resident when asked for — every batch is a stall
+        by definition (the honest accounting the CI gate's sensitivity
+        arm relies on)."""
+        t0 = time.perf_counter()
+        data, label, pd, pl = self._fetch_inner()
+        batch = DataBatch(
+            data=self._to_device(data), label=self._to_device(label),
+            pad=0, index=None, provide_data=pd, provide_label=pl)
+        _stats.note_serve(time.perf_counter() - t0, stalled=True)
+        self._consumed += 1
+        return batch
+
+    def iter_next(self):
+        try:
+            self.current_batch = self.next()
+            return True
+        except StopIteration:
+            return False
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getpad(self):
+        return 0
+
+    # --------------------------------------------------- epoch + resume
+    @property
+    def epoch(self):
+        return getattr(self._inner, "epoch", None)
+
+    @property
+    def position(self):
+        """Batches CONSUMED this epoch (staged-ahead ones excluded)."""
+        return self._consumed
+
+    @property
+    def batches_per_epoch(self):
+        return getattr(self._inner, "batches_per_epoch", None)
+
+    def reset(self):
+        self._halt()
+        self._inner.reset()
+        self._consumed = 0
+        self._warmup = self._k + 1
+        if self._k > 0 and not self._closed:
+            self._start()
+
+    def set_epoch(self, epoch):
+        if not hasattr(self._inner, "set_epoch"):
+            return
+        if self.epoch == int(epoch):
+            return  # keep a mid-epoch resume position intact
+        self._halt()
+        self._inner.set_epoch(epoch)
+        self._consumed = 0
+        self._warmup = self._k + 1
+        if self._k > 0 and not self._closed:
+            self._start()
+
+    def state_dict(self):
+        state = dict(self._inner.state_dict())
+        # the stager runs ahead of the consumer: checkpoint what was
+        # HANDED OUT, so a restore replays exactly the unconsumed tail
+        state["position"] = self._consumed
+        return state
+
+    def load_state_dict(self, state):
+        self._halt()
+        self._inner.load_state_dict(state)
+        self._consumed = int(state["position"])
+        self._warmup = self._k + 1
+        if self._k > 0 and not self._closed:
+            self._start()
+
+    # --------------------------------------------------------- lifecycle
+    def close(self, timeout=5.0):
+        if self._closed:
+            return
+        self._closed = True
+        self._halt(timeout)
+        if hasattr(self._inner, "close"):
+            self._inner.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # ---------------------------------------------------------- DataIter
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label

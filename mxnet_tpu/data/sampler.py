@@ -1,0 +1,112 @@
+"""Deterministic epoch-keyed, per-host-sharded batch sampling.
+
+The multi-host input problem: every process must agree on ONE global
+sample order per epoch and take a disjoint slice of it, with no
+coordination traffic (a parameter-server-style shuffle service is a
+single point of failure and a startup sync). The counter-based-RNG
+solution: the epoch permutation is a pure function of `(seed, epoch)`
+via a Philox generator, so every process derives the identical global
+order independently, then takes its own contiguous shard from
+`(process_index, process_count)`. Resume needs no RNG state — replaying
+`(seed, epoch, position)` reproduces the exact remaining batch sequence
+bit-for-bit (state.py's contract).
+
+Shards are forced equal-length (the permutation tail `num_samples %
+num_shards` is dropped — at most `num_shards - 1` samples per epoch,
+and a different tail each epoch since the permutation changes), so all
+hosts run the same number of steps per epoch: on TPU a host finishing
+early would desync every collective.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+
+
+def epoch_permutation(seed, epoch, num_samples):
+    """The global sample order of one epoch: a pure function of
+    (seed, epoch) through a counter-based Philox stream, identical on
+    every host with zero coordination."""
+    rng = np.random.Generator(
+        np.random.Philox(key=[int(seed) & (2**64 - 1),
+                              int(epoch) & (2**64 - 1)]))
+    return rng.permutation(int(num_samples))
+
+
+def _default_shard():
+    """(shard_id, num_shards) of this process: jax.process_index /
+    process_count — the zero-config multihost default."""
+    import jax
+
+    return jax.process_index(), jax.process_count()
+
+
+class ShardedSampler(object):
+    """Epoch-keyed permutation sampling with per-host sharding.
+
+    `batch_indices(k)` is the k-th batch of this host's shard for the
+    current epoch; `set_epoch(e)` rekeys the permutation. Partial
+    final batches are dropped (`drop_last` semantics are forced: TPU
+    programs are shape-specialized, a ragged last batch would compile
+    a second program and desync multi-host step counts)."""
+
+    def __init__(self, num_samples, batch_size, seed=0, shard_id=None,
+                 num_shards=None, shuffle=True):
+        if shard_id is None or num_shards is None:
+            auto_id, auto_n = _default_shard()
+            shard_id = auto_id if shard_id is None else shard_id
+            num_shards = auto_n if num_shards is None else num_shards
+        if not (0 <= shard_id < num_shards):
+            raise MXNetError(
+                f"shard_id {shard_id} out of range for "
+                f"{num_shards} shards")
+        self.num_samples = int(num_samples)
+        self.batch_size = int(batch_size)
+        self.seed = int(seed)
+        self.shard_id = int(shard_id)
+        self.num_shards = int(num_shards)
+        self.shuffle = bool(shuffle)
+        self.shard_len = self.num_samples // self.num_shards
+        self.batches_per_epoch = self.shard_len // self.batch_size
+        if self.batches_per_epoch < 1:
+            raise MXNetError(
+                f"shard of {self.shard_len} samples "
+                f"({self.num_samples} over {self.num_shards} hosts) "
+                f"yields no full batch of {self.batch_size}")
+        self._epoch = None
+        self._shard = None
+        self.set_epoch(0)
+
+    @property
+    def epoch(self):
+        return self._epoch
+
+    def set_epoch(self, epoch):
+        """Re-key the permutation for `epoch` (no-op when unchanged)."""
+        epoch = int(epoch)
+        if epoch == self._epoch:
+            return
+        self._epoch = epoch
+        if self.shuffle:
+            perm = epoch_permutation(self.seed, epoch, self.num_samples)
+        else:
+            perm = np.arange(self.num_samples)
+        lo = self.shard_id * self.shard_len
+        self._shard = perm[lo: lo + self.shard_len]
+
+    def epoch_indices(self):
+        """This host's full shard for the current epoch (a copy)."""
+        return self._shard.copy()
+
+    def batch_indices(self, k):
+        """Sample indices of batch `k` (0-based) of the current epoch."""
+        if not 0 <= k < self.batches_per_epoch:
+            raise IndexError(
+                f"batch {k} out of range "
+                f"[0, {self.batches_per_epoch})")
+        lo = k * self.batch_size
+        return self._shard[lo: lo + self.batch_size]
+
+    def __len__(self):
+        return self.batches_per_epoch

@@ -1,0 +1,99 @@
+"""Input-pipeline counters — the observability plane of `mxnet_tpu.data`.
+
+Third member of the profiler's stats family (`execCacheStats`,
+`hostSyncStats`, `servingStats`): process-wide counters every loader and
+device-prefetch iterator reports into, snapshotted by
+`input_pipeline_stats()` and embedded in every `dump_profile` output as
+`inputPipelineStats`. The pipelined fit loop (PR 3) removed per-step
+host<->device sync, so the remaining way a TPU step can wait on the host
+is the input path — these counters make that wait measurable (and
+CI-enforceable, ci/check_input_stall.py).
+
+What is counted and why:
+  host_batches / host_bytes  batches/bytes the loader workers handed
+                             over — bytes_per_s is the host-side feed
+                             rate the device consumes
+  batches                    batches served to the training loop
+  stall_count                next() calls that found NO staged batch and
+                             had to block — with device prefetch on a
+                             steady-state epoch must report 0 (the first
+                             batch after a reset is warmup, not a stall);
+                             with prefetch off every batch is a stall by
+                             definition (data was never resident)
+  wait_time_us               total time next() spent blocked (includes
+                             warmup waits; wait_per_batch_us amortizes)
+  prefetch_depth_peak        high-water mark of device-staged batches —
+                             0 means prefetch never got ahead
+  epochs                     reset() count across all pipeline iterators
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+_lock = threading.Lock()
+
+_COUNTER_KEYS = (
+    "host_batches", "host_bytes", "batches", "stall_count",
+    "wait_time_us", "prefetch_depth_peak", "epochs",
+)
+_counters = {k: 0 for k in _COUNTER_KEYS}
+_t_first = None
+_t_last = None
+
+
+def note_host_batch(nbytes):
+    """One batch left a loader's host-side queue (worker -> consumer)."""
+    global _t_first, _t_last
+    now = time.monotonic()
+    with _lock:
+        _counters["host_batches"] += 1
+        _counters["host_bytes"] += int(nbytes)
+        if _t_first is None:
+            _t_first = now
+        _t_last = now
+
+
+def note_serve(wait_s, stalled):
+    """One batch served to the consumer; `stalled` when the consumer
+    found nothing staged and had to block for it."""
+    with _lock:
+        _counters["batches"] += 1
+        _counters["wait_time_us"] += wait_s * 1e6
+        if stalled:
+            _counters["stall_count"] += 1
+
+
+def note_depth(n):
+    with _lock:
+        if n > _counters["prefetch_depth_peak"]:
+            _counters["prefetch_depth_peak"] = n
+
+
+def note_epoch():
+    with _lock:
+        _counters["epochs"] += 1
+
+
+def input_pipeline_stats():
+    """Snapshot of the pipeline counters (embedded in dump_profile as
+    `inputPipelineStats` next to hostSyncStats/servingStats)."""
+    with _lock:
+        out = dict(_counters)
+        t_first, t_last = _t_first, _t_last
+    out["wait_time_us"] = round(out["wait_time_us"], 1)
+    out["wait_per_batch_us"] = round(
+        out["wait_time_us"] / out["batches"], 1) if out["batches"] else 0.0
+    span = (t_last - t_first) if (
+        t_first is not None and t_last is not None and t_last > t_first
+    ) else 0.0
+    out["bytes_per_s"] = round(out["host_bytes"] / span, 1) if span else 0.0
+    return out
+
+
+def reset_input_pipeline_stats():
+    global _t_first, _t_last
+    with _lock:
+        for k in _COUNTER_KEYS:
+            _counters[k] = 0
+        _t_first = _t_last = None

@@ -12,8 +12,15 @@ rebuild to close the gap with checkpoint-and-restart orchestration.
   continues where it left off. On multi-host, every process loads the
   same checkpoint so workers restart consistently (the is_recovery
   analog without a parameter server to re-join).
-- `FaultInjector` (env MXNET_TPU_FAULT_INJECT="epoch:N") kills training
-  at epoch N — the fault-injection harness used by the resume tests.
+- When `train_data` speaks the resume protocol (mxnet_tpu.data), the
+  data-stream position is ALSO durable: `<prefix>-data-state.json` is
+  atomically rewritten every batch, so a run killed mid-epoch resumes
+  at the exact batch it died on and replays the bit-identical
+  remaining sequence (docs/data.md resume contract; params still
+  restart from the last epoch boundary — they are per-epoch durable).
+- `FaultInjector` (env MXNET_TPU_FAULT_INJECT="epoch:N" or "step:N")
+  kills training at epoch N / global step N — the fault-injection
+  harness used by the resume tests and ci/check_input_stall.py.
 """
 from __future__ import annotations
 
@@ -39,29 +46,63 @@ def latest_checkpoint(prefix):
     return best
 
 
+def data_state_path(prefix):
+    """Where fit_auto_resume persists the input-stream position."""
+    return prefix + "-data-state.json"
+
+
 class FaultInjector(object):
     """Deterministic crash injection for resilience tests. Spec comes
-    from MXNET_TPU_FAULT_INJECT ('epoch:N'); fires once."""
+    from MXNET_TPU_FAULT_INJECT: 'epoch:N' fires after the checkpoint
+    of epoch N is durable; 'step:N' fires when the global batch
+    counter reaches N (mid-epoch — the hard resume case). Fires once."""
 
     def __init__(self, spec=None):
         self.spec = spec if spec is not None else os.environ.get(
             "MXNET_TPU_FAULT_INJECT", ""
         )
+        self._steps = 0
+
+    def _parse(self):
+        kind, _, val = self.spec.partition(":")
+        return kind, val
 
     def maybe_fail(self, epoch):
         if not self.spec:
             return
-        kind, _, val = self.spec.partition(":")
+        kind, val = self._parse()
         if kind == "epoch" and epoch == int(val):
             raise RuntimeError(
                 f"[fault-injection] simulated failure at epoch {epoch}"
             )
 
+    def note_step(self):
+        """One training batch completed; fires the 'step:N' spec when
+        the global counter reaches N. Call AFTER the batch's state is
+        durable — the resumed run must not re-see the batch that was
+        live when the fault hit."""
+        self._steps += 1
+        if not self.spec:
+            return
+        kind, val = self._parse()
+        if kind == "step" and self._steps == int(val):
+            raise RuntimeError(
+                f"[fault-injection] simulated failure at step "
+                f"{self._steps}"
+            )
+
 
 def fit_auto_resume(module, train_data, prefix, num_epoch,
-                    eval_data=None, fault_injector=None, **fit_kwargs):
+                    eval_data=None, fault_injector=None,
+                    data_state=True, **fit_kwargs):
     """Module.fit with per-epoch durable checkpoints and automatic
-    resume from the newest one. Returns the epoch training ended at."""
+    resume from the newest one. Returns the epoch training ended at.
+
+    `data_state=True` (default) additionally checkpoints the input
+    stream every batch when `train_data` has state_dict/load_state_dict
+    (mxnet_tpu.data loaders): on restart the loader is wound to the
+    exact (epoch, position) it died at BEFORE fit begins, so the
+    killed epoch's remaining batches replay bit-identically."""
     if fault_injector is None:
         fault_injector = FaultInjector()
     begin_epoch = 0
@@ -76,6 +117,42 @@ def fit_auto_resume(module, train_data, prefix, num_epoch,
         return begin_epoch
 
     injected = fault_injector
+    track_data = data_state and hasattr(train_data, "state_dict") \
+        and hasattr(train_data, "load_state_dict")
+    state_path = data_state_path(prefix)
+
+    if track_data:
+        from .data.state import read_state
+
+        st = read_state(state_path)
+        # only rewind to saved data state that is AHEAD of the param
+        # checkpoint we resume from — stale state from an older run
+        # (lower epoch) must not drag the stream backwards
+        if st is not None and int(st["epoch"]) >= begin_epoch:
+            train_data.load_state_dict(st)
+
+    batch_cbs = []
+    user_batch_cb = fit_kwargs.pop("batch_end_callback", None)
+    if user_batch_cb is not None:
+        batch_cbs.extend(user_batch_cb if isinstance(user_batch_cb, list)
+                         else [user_batch_cb])
+
+    if track_data:
+        from .data.state import save_state
+
+        def data_state_cb(param):
+            # durable BEFORE note_step can fire: a kill at step N
+            # leaves position N on disk, so the resume starts at
+            # batch N — never re-consuming nor skipping one
+            save_state(train_data, state_path)
+            injected.note_step()
+
+        batch_cbs.append(data_state_cb)
+    elif injected.spec.startswith("step"):
+        def step_cb(param):
+            injected.note_step()
+
+        batch_cbs.append(step_cb)
 
     def epoch_cb(epoch, symbol, arg, aux):
         _model.save_checkpoint(
@@ -89,6 +166,7 @@ def fit_auto_resume(module, train_data, prefix, num_epoch,
         arg_params=arg_params, aux_params=aux_params,
         allow_missing=False,
         epoch_end_callback=[epoch_cb],
+        batch_end_callback=batch_cbs or None,
         **fit_kwargs,
     )
     return num_epoch

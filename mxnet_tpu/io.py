@@ -374,32 +374,41 @@ def _init_data(data, allow_empty, default_name):
 
 class NDArrayIter(DataIter):
     """Iterate over in-memory numpy/NDArray data (reference io.py:322-466):
-    shuffle, pad/discard/roll_over last-batch handling."""
+    shuffle, pad/discard/roll_over last-batch handling.
+
+    `seed=` makes shuffled runs reproducible AND epoch-varied: the
+    shuffle order becomes a pure function of `(seed, epoch)` (the same
+    counter-based keying as data.sampler), re-derived on every
+    `reset()` so each epoch sees a fresh — but replayable — order.
+    Unseeded `shuffle=True` keeps the legacy behavior: one
+    process-global `np.random.shuffle` at construction, same order
+    every epoch."""
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
                  last_batch_handle="pad", data_name="data",
-                 label_name="softmax_label"):
+                 label_name="softmax_label", seed=None):
         super().__init__(batch_size)
         self.data = _init_data(data, allow_empty=False,
                                default_name=data_name)
         self.label = _init_data(label, allow_empty=True,
                                 default_name=label_name)
 
-        self.idx = np.arange(self.data[0][1].shape[0])
-        if shuffle:
-            np.random.shuffle(self.idx)
-            self.data = [
-                (k, v[self.idx]) for k, v in self.data
-            ]
-            self.label = [
-                (k, v[self.idx]) for k, v in self.label
-            ]
+        self.shuffle = bool(shuffle)
+        self.seed = None if seed is None else int(seed)
+        self._epoch = 0
+        n = self.data[0][1].shape[0]
+        self._num_rows = n
+        # discard: drop the ragged tail so every batch is full
+        self._trim = n - n % batch_size if (
+            last_batch_handle == "discard") else n
 
-        if last_batch_handle == "discard":
-            new_n = self.data[0][1].shape[0] - (
-                self.data[0][1].shape[0] % batch_size
-            )
-            self.idx = self.idx[:new_n]
+        self.idx = np.arange(n)
+        if self.shuffle:
+            if self.seed is None:
+                np.random.shuffle(self.idx)  # legacy: unseeded, one-shot
+            else:
+                self._reshuffle()
+        self.idx = self.idx[: self._trim]
 
         self.data_list = [x[1] for x in self.data] + [
             x[1] for x in self.label
@@ -411,6 +420,29 @@ class NDArrayIter(DataIter):
         self.cursor = -batch_size
         self.batch_size = batch_size
         self.last_batch_handle = last_batch_handle
+
+    def _reshuffle(self):
+        """Seeded shuffle: idx = permutation(seed, epoch) — the same
+        epoch-keyed Philox derivation as data.sampler, so the order is
+        reproducible across runs and hosts."""
+        from .data.sampler import epoch_permutation
+
+        self.idx = epoch_permutation(
+            self.seed, self._epoch, self._num_rows)[: self._trim]
+
+    @property
+    def epoch(self):
+        return self._epoch
+
+    def set_epoch(self, epoch):
+        """Pin the shuffle epoch (fit calls this each epoch); only
+        meaningful for seeded shuffles. No-op when already there."""
+        epoch = int(epoch)
+        if epoch == self._epoch:
+            return
+        self._epoch = epoch
+        if self.shuffle and self.seed is not None:
+            self._reshuffle()
 
     @property
     def provide_data(self):
@@ -432,6 +464,10 @@ class NDArrayIter(DataIter):
         self.cursor = -self.batch_size
 
     def reset(self):
+        if self.shuffle and self.seed is not None:
+            # epoch-keyed reshuffle: next epoch, fresh (replayable) order
+            self._epoch += 1
+            self._reshuffle()
         if (self.last_batch_handle == "roll_over"
                 and self.cursor > self.num_data):
             self.cursor = -self.batch_size + (self.cursor % self.num_data) % self.batch_size
@@ -452,20 +488,15 @@ class NDArrayIter(DataIter):
 
     def _getdata(self, data_source):
         assert self.cursor < self.num_data, "DataIter needs reset."
+        # gather through idx: the base arrays stay in storage order and
+        # a reshuffle only rewrites the (cheap) index vector
         if self.cursor + self.batch_size <= self.num_data:
-            return [
-                array(x[1][self.cursor: self.cursor + self.batch_size])
-                for x in data_source
-            ]
-        pad = self.batch_size - self.num_data + self.cursor
-        return [
-            array(
-                np.concatenate(
-                    (x[1][self.cursor:], x[1][:pad]), axis=0
-                )
-            )
-            for x in data_source
-        ]
+            rows = self.idx[self.cursor: self.cursor + self.batch_size]
+        else:
+            pad = self.batch_size - self.num_data + self.cursor
+            rows = np.concatenate(
+                (self.idx[self.cursor:], self.idx[:pad]), axis=0)
+        return [array(x[1][rows]) for x in data_source]
 
     def getdata(self):
         return self._getdata(self.data)

@@ -212,13 +212,25 @@ def serving_stats():
     return _ss()
 
 
+def input_pipeline_stats():
+    """Input-pipeline counters (wait-for-data per step, device-prefetch
+    queue depth, bytes/s, stall count) — mxnet_tpu.data.stats; embedded
+    in every dump_profile output. The "is my step waiting on input?"
+    answer: stall_count > 0 in steady state means the data tier, not
+    the device, bounds throughput (docs/faq.md)."""
+    from .data.stats import input_pipeline_stats as _ips
+
+    return _ips()
+
+
 def dump_profile(device_trace_dir=None):
     """Write collected events as ONE Chrome trace-event JSON (the
     reference emits a single unified trace, src/engine/profiler.cc:134):
     host-side framework events on pid 0, and — when a jax device
     capture ran — the XLA device timeline merged in under offset
     pids. Top-level `execCacheStats` carries the compiled-computation
-    cache counters and `servingStats` the per-model serving counters
+    cache counters, `servingStats` the per-model serving counters, and
+    `inputPipelineStats` the data-tier stall/throughput counters
     (chrome://tracing ignores unknown keys)."""
     with _lock:
         events = list(_events)
@@ -235,6 +247,10 @@ def dump_profile(device_trace_dir=None):
     except Exception:
         pass
     trace["hostSyncStats"] = host_sync_stats()
+    try:
+        trace["inputPipelineStats"] = input_pipeline_stats()
+    except Exception:
+        pass
     for name, cat, b, e in events:
         trace["traceEvents"].append({
             "name": name, "cat": cat, "ph": "B",

@@ -61,10 +61,25 @@ class MXRecordIO(object):
     def __del__(self):
         self.close()
 
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
     def close(self):
         if self.is_open and self.handle is not None:
             self.handle.close()
             self.is_open = False
+
+    def flush(self):
+        """Push written records to stable storage (fsync): a reader —
+        or a resumed run — sees every record written before the call
+        even if the writer is killed right after."""
+        if self.is_open and self.writable:
+            self.handle.flush()
+            os.fsync(self.handle.fileno())
 
     def reset(self):
         self.close()
@@ -150,11 +165,32 @@ class MXIndexedRecordIO(MXRecordIO):
                     self.idx[key] = int(line[1])
                     self.keys.append(key)
 
+    def _write_index(self):
+        """Crash-safe index write: tmp + fsync + atomic os.replace, so
+        a writer killed mid-flush leaves either the previous .idx or
+        the complete new one — never a torn/stale index pointing past
+        truncated data (the mid-epoch-resume story needs readers to
+        trust .idx unconditionally)."""
+        tmp = self.idx_path + ".tmp"
+        with open(tmp, "w") as fout:
+            for k in self.keys:
+                fout.write(f"{k}\t{self.idx[k]}\n")
+            fout.flush()
+            os.fsync(fout.fileno())
+        os.replace(tmp, self.idx_path)
+
+    def flush(self):
+        """Checkpoint the stream mid-run: data records hit stable
+        storage FIRST, then the index is atomically replaced — the
+        .idx never references bytes that aren't durably in the .rec."""
+        if self.is_open and self.writable:
+            super().flush()
+            self._write_index()
+
     def close(self):
         if self.is_open and self.writable:
-            with open(self.idx_path, "w") as fout:
-                for k in self.keys:
-                    fout.write(f"{k}\t{self.idx[k]}\n")
+            super().flush()
+            self._write_index()
         super().close()
 
     def seek(self, idx):

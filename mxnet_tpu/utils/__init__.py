@@ -184,6 +184,33 @@ register_env(
     "the host update() path for every metric.",
 )
 register_env(
+    "MXNET_DATA_WORKERS", int, 2,
+    "data: producer threads per DataLoader decoding batches into "
+    "bounded per-worker queues (mxnet_tpu.data). Batch order is "
+    "deterministic for ANY worker count — batch k always comes from "
+    "worker k % MXNET_DATA_WORKERS.",
+)
+register_env(
+    "MXNET_DATA_QUEUE_CAP", int, 4,
+    "data: max decoded batches each loader worker buffers; a producer "
+    "that runs ahead blocks (backpressure bounds host RAM no matter "
+    "how slow the consumer is).",
+)
+register_env(
+    "MXNET_DATA_DEVICE_PREFETCH", int, 2,
+    "data: batches DevicePrefetchIter keeps device-resident ahead of "
+    "the step (async device_put; 2 = double-buffered). 0 = synchronous "
+    "host->device copy inline in next() — every batch then counts as "
+    "an input stall (ci/check_input_stall.py's A/B arm).",
+)
+register_env(
+    "MXNET_DATA_SEED", int, 0,
+    "data: default shuffle seed of ShardedSampler/DataLoader. The "
+    "epoch permutation is a pure function of (seed, epoch), so every "
+    "host derives the same global order with zero coordination and "
+    "resume replays the identical stream (docs/data.md).",
+)
+register_env(
     "MXNET_EXEC_CACHE_SIZE", int, 64,
     "LRU bound on retained exec_cache entries; raise it when cycling "
     "more distinct bucket/shape signatures than this. Stats: "

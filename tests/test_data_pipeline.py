@@ -1,0 +1,553 @@
+"""mxnet_tpu.data tests: sharding disjointness/coverage, loader
+determinism + backpressure + clean shutdown, device-prefetch parity,
+mid-epoch resume, stats counters — plus the satellite behaviors
+(seeded NDArrayIter, recordio crash-safe index, step-granular fault
+injection)."""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import data as mxdata
+from mxnet_tpu import fault
+from mxnet_tpu.data import (DataLoader, DataPipelineError,
+                            DevicePrefetchIter, RecordSource,
+                            ShardedSampler, epoch_permutation)
+from mxnet_tpu.io import NDArrayIter
+from mxnet_tpu.recordio import MXIndexedRecordIO, MXRecordIO
+
+
+def _arrays(n=48, feat=3):
+    x = np.arange(n * feat, dtype=np.float32).reshape(n, feat)
+    y = np.arange(n, dtype=np.float32)
+    return x, y
+
+
+# ------------------------------------------------------------- sampler
+def test_epoch_permutation_pure_function():
+    a = epoch_permutation(7, 3, 100)
+    b = epoch_permutation(7, 3, 100)
+    assert (a == b).all()
+    assert sorted(a.tolist()) == list(range(100))
+    # different epoch or seed => different order
+    assert (a != epoch_permutation(7, 4, 100)).any()
+    assert (a != epoch_permutation(8, 3, 100)).any()
+
+
+@pytest.mark.parametrize("num_shards", [1, 2, 4])
+def test_shards_disjoint_and_cover(num_shards):
+    n, bs = 101, 5
+    shards = [ShardedSampler(n, bs, seed=9, shard_id=i,
+                             num_shards=num_shards)
+              for i in range(num_shards)]
+    # equal length — all hosts run the same number of steps
+    lens = {s.shard_len for s in shards}
+    assert lens == {n // num_shards}
+    all_idx = np.concatenate([s.epoch_indices() for s in shards])
+    # disjoint across hosts, covering all but the dropped tail
+    assert len(set(all_idx.tolist())) == len(all_idx)
+    assert len(all_idx) == (n // num_shards) * num_shards
+
+
+def test_sampler_epoch_rekeys_and_batches():
+    s = ShardedSampler(40, 4, seed=1, shard_id=0, num_shards=1)
+    e0 = s.epoch_indices()
+    s.set_epoch(1)
+    e1 = s.epoch_indices()
+    assert (e0 != e1).any()
+    assert sorted(e0.tolist()) == sorted(e1.tolist())
+    assert len(s) == 10
+    assert (s.batch_indices(2) == e1[8:12]).all()
+    with pytest.raises(IndexError):
+        s.batch_indices(10)
+
+
+def test_sampler_rejects_empty_shard():
+    with pytest.raises(mx.MXNetError):
+        ShardedSampler(6, 4, shard_id=0, num_shards=2)  # 3 < batch 4
+
+
+# -------------------------------------------------------------- loader
+def _stream(x, y, num_workers, **kw):
+    out = []
+    with DataLoader(x, 4, label=y, seed=5, num_workers=num_workers,
+                    shard_id=0, num_shards=1, **kw) as it:
+        for b in it:
+            out.append(b.data[0].asnumpy().copy())
+    return out
+
+
+def test_loader_order_independent_of_worker_count():
+    x, y = _arrays()
+    s1 = _stream(x, y, 1)
+    s3 = _stream(x, y, 3)
+    assert len(s1) == 12
+    assert all((a == b).all() for a, b in zip(s1, s3))
+
+
+def test_loader_matches_sampler_order():
+    x, y = _arrays()
+    with DataLoader(x, 4, label=y, seed=5, shard_id=0,
+                    num_shards=1) as it:
+        want = it._sampler.batch_indices(0)
+        got = it.next()
+        assert (got.data[0].asnumpy() == x[want]).all()
+        assert (got.label[0].asnumpy() == y[want]).all()
+
+
+def test_loader_backpressure_bounds_queues():
+    x, y = _arrays(n=64)
+    it = DataLoader(x, 4, label=y, num_workers=2, queue_cap=2,
+                    seed=0, shard_id=0, num_shards=1)
+    try:
+        # consume nothing: producers must block at the cap, not buffer
+        # the whole epoch
+        import time
+        time.sleep(0.3)
+        assert all(q.qsize() <= 2 for q in it._queues)
+        buffered = sum(q.qsize() for q in it._queues)
+        assert buffered <= 2 * 2
+    finally:
+        it.close()
+
+
+def test_loader_clean_shutdown_no_leaked_workers():
+    x, y = _arrays()
+    before = threading.active_count()
+    it = DataLoader(x, 4, label=y, num_workers=3, queue_cap=1,
+                    seed=0, shard_id=0, num_shards=1)
+    it.next()
+    it.close()
+    assert threading.active_count() == before
+    with pytest.raises(DataPipelineError):
+        it.next()
+    it.close()  # idempotent
+
+
+def test_loader_worker_error_fast_fails():
+    class Exploding(mxdata.ArraySource):
+        def read(self, indices):
+            raise ValueError("boom")
+
+    x, y = _arrays()
+    it = DataLoader(Exploding(x, y), 4, num_workers=2, seed=0,
+                    shard_id=0, num_shards=1)
+    try:
+        with pytest.raises(DataPipelineError, match="boom"):
+            it.next()
+    finally:
+        it.close()
+
+
+def test_loader_reset_advances_epoch():
+    x, y = _arrays()
+    with DataLoader(x, 4, label=y, seed=5, shard_id=0,
+                    num_shards=1) as it:
+        e0 = [b.data[0].asnumpy().copy() for b in it]
+        it.reset()
+        assert it.epoch == 1 and it.position == 0
+        e1 = [b.data[0].asnumpy().copy() for b in it]
+    assert any((a != b).any() for a, b in zip(e0, e1))
+
+
+def test_loader_state_roundtrip_bit_identical():
+    x, y = _arrays()
+    lo = DataLoader(x, 4, label=y, seed=5, shard_id=0, num_shards=1)
+    for _ in range(5):
+        lo.next()
+    st = lo.state_dict()
+    rest = [b.data[0].asnumpy().copy() for b in lo]
+    lo.close()
+
+    lo2 = DataLoader(x, 4, label=y, seed=5, shard_id=0, num_shards=1)
+    lo2.load_state_dict(st)
+    rest2 = [b.data[0].asnumpy().copy() for b in lo2]
+    lo2.close()
+    assert len(rest) == len(rest2) == 7
+    assert all((a == b).all() for a, b in zip(rest, rest2))
+
+
+def test_loader_state_mismatch_rejected():
+    x, y = _arrays()
+    with DataLoader(x, 4, label=y, seed=5, shard_id=0,
+                    num_shards=1) as it:
+        st = it.state_dict()
+        bad = dict(st, batch_size=8)
+        with pytest.raises(DataPipelineError, match="batch_size"):
+            it.load_state_dict(bad)
+        with pytest.raises(DataPipelineError, match="format"):
+            it.load_state_dict(dict(st, format="nope"))
+
+
+def test_csv_source_roundtrip(tmp_path):
+    x, _ = _arrays(n=12)
+    path = tmp_path / "d.csv"
+    np.savetxt(path, x, delimiter=",")
+    src = mxdata.CSVSource(str(path), data_shape=(3,))
+    assert len(src) == 12
+    data, _ = src.read(np.array([2, 0]))
+    assert (data[0] == x[[2, 0]]).all()
+
+
+def test_record_source_pipeline(tmp_path):
+    idx = str(tmp_path / "r.idx")
+    rec = str(tmp_path / "r.rec")
+    with MXIndexedRecordIO(idx, rec, "w") as w:
+        for i in range(24):
+            row = np.full(4, i, dtype=np.float32)
+            w.write_idx(i, row.tobytes() + np.float32(i % 3).tobytes())
+
+    def decode(payload):
+        a = np.frombuffer(payload, dtype=np.float32)
+        return a[:4], a[4:]
+
+    src = RecordSource(idx, rec, decode)
+    with DataLoader(src, 4, seed=2, num_workers=2, shard_id=0,
+                    num_shards=1) as it:
+        seen = np.concatenate(
+            [b.data[0].asnumpy()[:, 0] for b in it])
+    assert sorted(seen.tolist()) == list(range(24))
+
+
+# ------------------------------------------------------ device prefetch
+def test_device_prefetch_parity_with_sync():
+    x, y = _arrays()
+
+    def run(prefetch):
+        it = mxdata.make_pipeline(x, 4, label=y, seed=5,
+                                  prefetch=prefetch,
+                                  shard_id=0, num_shards=1)
+        try:
+            return [b.data[0].asnumpy().copy() for b in it]
+        finally:
+            it.close()
+
+    a, b = run(2), run(0)
+    assert len(a) == len(b) == 12
+    assert all((u == v).all() for u, v in zip(a, b))
+
+
+def test_device_prefetch_batches_are_device_resident():
+    x, y = _arrays()
+    it = mxdata.make_pipeline(x, 4, label=y, seed=5,
+                              shard_id=0, num_shards=1)
+    try:
+        b = it.next()
+        assert isinstance(b.data[0], mx.NDArray)
+        assert isinstance(b.label[0], mx.NDArray)
+    finally:
+        it.close()
+
+
+def test_device_prefetch_state_counts_consumed_not_staged():
+    x, y = _arrays()
+    it = mxdata.make_pipeline(x, 4, label=y, seed=5,
+                              shard_id=0, num_shards=1)
+    try:
+        for _ in range(3):
+            it.next()
+        st = it.state_dict()
+        # the stager may have pulled ahead of the consumer — the
+        # checkpoint must reflect what was handed out
+        assert st["position"] == 3
+        assert it._inner.position >= 3
+    finally:
+        it.close()
+
+    it2 = mxdata.make_pipeline(x, 4, label=y, seed=5,
+                               shard_id=0, num_shards=1)
+    it3 = mxdata.make_pipeline(x, 4, label=y, seed=5,
+                               shard_id=0, num_shards=1)
+    try:
+        it2.load_state_dict(st)
+        rest = [b.data[0].asnumpy().copy() for b in it2]
+        full = [b.data[0].asnumpy().copy() for b in it3]
+        assert len(rest) == 9
+        assert all((a == b).all() for a, b in zip(rest, full[3:]))
+    finally:
+        it2.close()
+        it3.close()
+
+
+def test_device_prefetch_set_epoch_preserves_position():
+    x, y = _arrays()
+    it = mxdata.make_pipeline(x, 4, label=y, seed=5,
+                              shard_id=0, num_shards=1)
+    try:
+        it.next()
+        it.next()
+        it.set_epoch(0)  # fit's top-of-epoch call: same epoch = no-op
+        assert it.position == 2
+        it.set_epoch(1)  # explicit jump rewinds
+        assert it.position == 0 and it.epoch == 1
+    finally:
+        it.close()
+
+
+def test_device_prefetch_stats_counters():
+    x, y = _arrays()
+    mxdata.reset_input_pipeline_stats()
+    it = mxdata.make_pipeline(x, 4, label=y, seed=5,
+                              shard_id=0, num_shards=1)
+    try:
+        for b in it:
+            pass
+    finally:
+        it.close()
+    stats = mxdata.input_pipeline_stats()
+    assert stats["batches"] == 12
+    assert stats["host_batches"] >= 12
+    assert stats["host_bytes"] > 0
+    assert stats["prefetch_depth_peak"] >= 1
+    assert stats["wait_per_batch_us"] >= 0
+
+    # sync arm: every batch is by definition a stall
+    mxdata.reset_input_pipeline_stats()
+    it = mxdata.make_pipeline(x, 4, label=y, seed=5, prefetch=0,
+                              shard_id=0, num_shards=1)
+    try:
+        for b in it:
+            pass
+    finally:
+        it.close()
+    stats = mxdata.input_pipeline_stats()
+    assert stats["stall_count"] == stats["batches"] == 12
+    mxdata.reset_input_pipeline_stats()
+
+
+def test_profiler_embeds_input_pipeline_stats(tmp_path):
+    import json
+
+    from mxnet_tpu import profiler
+
+    assert "stall_count" in profiler.input_pipeline_stats()
+    out = str(tmp_path / "trace.json")
+    profiler.profiler_set_config(filename=out)
+    try:
+        path = profiler.dump_profile()
+        with open(path) as f:
+            trace = json.load(f)
+    finally:
+        profiler.profiler_set_config()  # restore default filename
+    assert "inputPipelineStats" in trace
+    assert "stall_count" in trace["inputPipelineStats"]
+
+
+# ------------------------------------------------- seeded NDArrayIter
+def test_ndarrayiter_seeded_shuffle_reproducible():
+    d = np.arange(20, dtype=np.float32).reshape(20, 1)
+
+    def rows(it):
+        return np.concatenate(
+            [b.data[0].asnumpy() for b in it]).ravel().tolist()
+
+    a = NDArrayIter(d, batch_size=5, shuffle=True, seed=3)
+    b = NDArrayIter(d, batch_size=5, shuffle=True, seed=3)
+    e0 = rows(a)
+    assert e0 == rows(b)
+    a.reset()
+    b.reset()
+    e1 = rows(a)
+    assert e1 == rows(b)
+    assert e1 != e0 and sorted(e1) == sorted(e0)
+    # set_epoch pins the permutation without iterating there
+    c = NDArrayIter(d, batch_size=5, shuffle=True, seed=3)
+    c.set_epoch(1)
+    assert rows(c) == e1
+
+
+def test_ndarrayiter_unseeded_shuffle_stable_across_resets():
+    d = np.arange(20, dtype=np.float32).reshape(20, 1)
+    it = NDArrayIter(d, batch_size=5, shuffle=True)
+
+    def rows():
+        return np.concatenate(
+            [b.data[0].asnumpy() for b in it]).ravel().tolist()
+
+    e0 = rows()
+    it.reset()
+    assert rows() == e0  # legacy: one-shot shuffle, same every epoch
+
+
+def test_ndarrayiter_seeded_matches_sampler_permutation():
+    d = np.arange(40, dtype=np.float32).reshape(40, 1)
+    it = NDArrayIter(d, batch_size=4, shuffle=True, seed=7)
+    got = np.concatenate(
+        [b.data[0].asnumpy() for b in it]).ravel()
+    assert (got == epoch_permutation(7, 0, 40).astype(np.float32)).all()
+
+
+# -------------------------------------------------------- recordio ctx
+def test_recordio_context_manager(tmp_path):
+    path = str(tmp_path / "a.rec")
+    with MXRecordIO(path, "w") as w:
+        w.write(b"payload")
+        assert w.is_open
+    assert not w.is_open
+    with MXRecordIO(path, "r") as r:
+        assert r.read() == b"payload"
+
+
+def test_indexed_recordio_atomic_idx_flush(tmp_path):
+    idx = str(tmp_path / "a.idx")
+    rec = str(tmp_path / "a.rec")
+    with MXIndexedRecordIO(idx, rec, "w") as w:
+        w.write_idx(0, b"hello")
+        w.flush()
+        # mid-run flush: index durable + atomic (no torn tmp visible)
+        assert os.path.exists(idx)
+        assert not os.path.exists(idx + ".tmp")
+        with MXIndexedRecordIO(idx, rec, "r") as r:
+            assert r.read_idx(0) == b"hello"
+        w.write_idx(1, b"world")
+    assert not os.path.exists(idx + ".tmp")
+    with MXIndexedRecordIO(idx, rec, "r") as r:
+        assert r.keys == [0, 1]
+        assert r.read_idx(1) == b"world"
+
+
+# --------------------------------------------------- fault step + fit
+def test_fault_injector_step_spec():
+    fi = fault.FaultInjector("step:3")
+    fi.note_step()
+    fi.note_step()
+    with pytest.raises(RuntimeError, match="step 3"):
+        fi.note_step()
+    fi.note_step()  # fires once
+    fault.FaultInjector("").note_step()  # no spec: no-op
+
+
+def _mlp():
+    return mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(
+            mx.sym.Variable("data"), num_hidden=2, name="fc"),
+        name="softmax")
+
+
+class _RecordingIter(object):
+    """Log every batch fit consumes (resume-replay observable)."""
+
+    def __init__(self, inner, log):
+        self._inner = inner
+        self._log = log
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    def next(self):
+        b = self._inner.next()
+        self._log.append(b.data[0].asnumpy().tobytes())
+        return b
+
+    def reset(self):
+        self._inner.reset()
+
+    def set_epoch(self, e):
+        self._inner.set_epoch(e)
+
+    def state_dict(self):
+        return self._inner.state_dict()
+
+    def load_state_dict(self, s):
+        self._inner.load_state_dict(s)
+
+
+def test_fit_mid_epoch_kill_and_bit_identical_resume(tmp_path):
+    rs = np.random.RandomState(0)
+    x = rs.rand(48, 10).astype(np.float32)
+    y = (x.sum(axis=1) > 5).astype(np.float32)
+    prefix = str(tmp_path / "job")
+
+    def run(log, injector, pfx):
+        it = _RecordingIter(
+            mxdata.make_pipeline(x, 8, label=y, seed=11,
+                                 shard_id=0, num_shards=1), log)
+        mod = mx.mod.Module(_mlp(), context=mx.cpu())
+        try:
+            fault.fit_auto_resume(
+                mod, it, pfx, num_epoch=2, fault_injector=injector,
+                optimizer="sgd",
+                optimizer_params={"learning_rate": 0.5})
+        finally:
+            it._inner.close()
+
+    # 6 batches/epoch; kill at global step 9 = mid-epoch 2
+    killed = []
+    with pytest.raises(RuntimeError, match="fault-injection"):
+        run(killed, fault.FaultInjector("step:9"), prefix)
+    assert len(killed) == 9
+    st = mxdata.read_state(fault.data_state_path(prefix))
+    assert st["epoch"] == 1 and st["position"] == 3
+
+    resumed = []
+    run(resumed, fault.FaultInjector(""), prefix)
+
+    reference = []
+    run(reference, fault.FaultInjector(""), str(tmp_path / "ref"))
+    assert killed + resumed == reference
+
+
+def test_fit_over_pipeline_epoch_keying(tmp_path):
+    """fit's set_epoch hook: two epochs of a seeded pipeline see
+    different permutations of the same rows."""
+    rs = np.random.RandomState(0)
+    x = rs.rand(32, 10).astype(np.float32)
+    y = (x.sum(axis=1) > 5).astype(np.float32)
+    log = []
+    it = _RecordingIter(
+        mxdata.make_pipeline(x, 8, label=y, seed=3,
+                             shard_id=0, num_shards=1), log)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    try:
+        mod.fit(it, num_epoch=2, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.5})
+    finally:
+        it._inner.close()
+    assert len(log) == 8
+
+    def rows(chunk):
+        return sorted(
+            np.frombuffer(b, dtype=np.float32).reshape(8, 10)[i]
+            .tobytes()
+            for b in chunk for i in range(8))
+
+    assert log[:4] != log[4:]        # re-keyed batch order
+    assert rows(log[:4]) == rows(log[4:])  # but the same row set
+
+
+def test_checkpoint_sharded_carries_data_state(tmp_path):
+    x, y = _arrays(n=32)
+    it = DataLoader(x, 4, label=y, seed=5, shard_id=0, num_shards=1)
+    it.next()
+    it.next()
+
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 3))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd")
+    path = str(tmp_path / "ckpt")
+    mx.save_sharded(mod, path, data_iter=it)
+    st = it.state_dict()
+    it.close()
+
+    it2 = DataLoader(x, 4, label=y, seed=5, shard_id=0, num_shards=1)
+    mod2 = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod2.bind(data_shapes=[("data", (4, 3))],
+              label_shapes=[("softmax_label", (4,))])
+    mod2.init_params()
+    mod2.init_optimizer(optimizer="sgd")
+    mx.load_sharded(mod2, path, data_iter=it2)
+    try:
+        assert it2.state_dict() == st
+        assert it2.position == 2
+    finally:
+        it2.close()

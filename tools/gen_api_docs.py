@@ -18,6 +18,7 @@ MODULES = [
     ("mxnet_tpu.autograd", "imperative tape"),
     ("mxnet_tpu.module", "training API"),
     ("mxnet_tpu.io", "data iterators"),
+    ("mxnet_tpu.data", "sharded/resumable/prefetching input pipeline"),
     ("mxnet_tpu.image", "image pipeline"),
     ("mxnet_tpu.image_det", "detection pipeline"),
     ("mxnet_tpu.recordio", "RecordIO files"),

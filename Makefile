@@ -9,8 +9,8 @@ PY      ?= python
 CPUENV  := JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS=
 XLA8    := XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: all test nightly examples lint libs predict perl docs dryrun \
-	cache-check serving-check sync-check data-check clean
+.PHONY: all test nightly examples lint lint-check libs predict perl \
+	docs dryrun cache-check serving-check sync-check data-check clean
 
 all: libs test
 
@@ -39,6 +39,12 @@ examples:
 
 lint:
 	$(CPUENV) $(PY) -m pytest tests/test_lint.py tests/test_docs.py -q
+
+# framework-native analyzer gate: mxlint over the tree (baseline-aware),
+# self-hosting pass, and a seeded-violation sanity check. Stdlib-only —
+# no CPU guard needed (the CLI never imports jax).
+lint-check:
+	bash ci/check_lint.sh
 
 # native libraries: embeddable core C API + predict-only ABI +
 # IO cores (recordio reader, JPEG decode pool, dependency engine)

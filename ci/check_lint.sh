@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# mxlint CI gate (docs/analysis.md). Three checks:
+#
+# 1. The tree is clean: mxlint over mxnet_tpu/tools/examples reports
+#    zero findings beyond ci/mxlint_baseline.json.
+# 2. Self-hosting: the analyzer's own sources (and its CLI) pass with
+#    NO baseline — the tool is held to the strictest bar.
+# 3. The gate gates: a seeded violation in a scratch file must make
+#    mxlint exit non-zero (guards against a silently broken engine —
+#    an analyzer that crashes into "0 findings" would otherwise pass).
+#
+# The CLI is stdlib-only (never imports jax/mxnet_tpu), so this script
+# needs no backend guards and runs anywhere python runs.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== mxlint: full tree (baseline: ci/mxlint_baseline.json)"
+python tools/mxlint.py mxnet_tpu tools examples
+
+echo "== mxlint: self-hosting (analyzer sources, no baseline)"
+python tools/mxlint.py mxnet_tpu/analysis tools/mxlint.py --no-baseline
+
+echo "== mxlint: gate sanity (seeded violation must fail)"
+scratch="$(mktemp -d)"
+trap 'rm -rf "$scratch"' EXIT
+cat > "$scratch/seeded.py" <<'EOF'
+import os
+x = os.environ.get("MXNET_NOT_A_REAL_KNOB")
+try:
+    pass
+except:
+    pass
+EOF
+if python tools/mxlint.py "$scratch" --no-baseline > /dev/null; then
+    echo "FAIL: mxlint did not flag the seeded violations" >&2
+    exit 1
+fi
+echo "ok: seeded violation rejected"

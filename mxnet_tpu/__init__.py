@@ -80,6 +80,7 @@ from .checkpoint_sharded import load_sharded, save_sharded
 from . import monitor as _monitor_mod
 from .monitor import Monitor
 from . import profiler
+from . import analysis
 from . import visualization
 from . import visualization as viz
 from .callback import Speedometer

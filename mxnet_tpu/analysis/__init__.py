@@ -1,0 +1,26 @@
+"""mxnet_tpu.analysis: framework-native static analysis.
+
+Two halves (docs/analysis.md):
+
+  - **mxlint** (lint.py + rules.py, CLI `tools/mxlint.py`): an AST
+    lint engine with rules MX001-MX005 for the invariants that make
+    this stack TPU-fast — no host syncs on hot paths, no per-call
+    jax.jit closures, every MXNET_* knob registered, concurrency
+    hygiene, and deterministic RNG routing. Wired as the CI lint gate
+    (ci/check_lint.sh).
+  - **graph verifier** (graph_verify.py): `verify_graph(symbol,
+    **shapes)` — pre-bind shape/dtype/aliasing checks over the Symbol
+    graph, run automatically by `Executor._build` under
+    MXNET_GRAPH_VERIFY=1 (always-on in the test suite).
+"""
+from . import rules
+from . import lint
+from . import graph_verify
+from .graph_verify import GraphIssue, GraphVerifyError, verify_graph
+from .lint import Finding, lint_file, lint_paths
+
+__all__ = [
+    "rules", "lint", "graph_verify",
+    "GraphIssue", "GraphVerifyError", "verify_graph",
+    "Finding", "lint_file", "lint_paths",
+]

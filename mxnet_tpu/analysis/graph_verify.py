@@ -1,0 +1,333 @@
+"""Pre-bind static verification of NNVM-style Symbol graphs.
+
+The Relay/Glow lesson (PAPERS.md): a compiler-centric framework should
+reject a bad graph at the graph level, with the offending op named,
+instead of failing deep inside the backend. Today an `infer_shape`
+contradiction or a donated-buffer alias surfaces as an opaque jax error
+at bind (or worse, at the first train step). `verify_graph` runs the
+checks the NNVM pass pipeline would have:
+
+  shape_contradiction   declared vs inferred shape disagree at an op,
+                        or per-op inference fails outright
+  dtype_contradiction   multi-input elementwise op fed mixed dtypes
+                        (jnp would silently promote; the reference
+                        errors — and on TPU a silent f32 upcast of a
+                        bf16 operand doubles the op's HBM traffic)
+  duplicate_arg         two distinct nodes share one name (binding is
+                        by-name: one buffer would silently serve both)
+  dead_node             serialized-graph node unreachable from any
+                        head (JSON input only — a live Symbol is
+                        defined by its heads, so its topo walk cannot
+                        contain unreachable nodes)
+  donation_alias        an output reaches a gradient-bearing argument
+                        through alias-transparent ops only (Reshape /
+                        Flatten / identity / BlockGrad): the fused
+                        backward donates buffers (exec_cache), so the
+                        aliased output can be invalidated in place
+
+`Executor._build` calls this automatically under MXNET_GRAPH_VERIFY=1
+(tests/conftest.py turns it on for the whole suite).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..base import MXNetError
+
+
+class GraphVerifyError(MXNetError):
+    """A Symbol failed static graph verification; `.issues` holds the
+    structured findings."""
+
+    def __init__(self, issues):
+        self.issues = list(issues)
+        detail = "\n".join(f"  [{i.kind}] {i.message}" for i in self.issues)
+        super().__init__(
+            f"graph verification failed ({len(self.issues)} issue(s)):\n"
+            f"{detail}")
+
+
+@dataclass
+class GraphIssue:
+    kind: str      # shape_contradiction | dtype_contradiction |
+    #                duplicate_arg | dead_node | donation_alias
+    node: str      # offending node name
+    message: str
+
+
+# Ops whose output may alias their (first) input buffer rather than
+# computing fresh storage — XLA freely forwards these.
+ALIAS_TRANSPARENT_OPS = {
+    "Reshape", "reshape", "Flatten", "flatten", "identity", "BlockGrad",
+    "stop_gradient", "expand_dims",
+}
+
+# Multi-input elementwise ops that require operand dtypes to agree.
+_SAME_DTYPE_OPS = {
+    "elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+    "_power", "broadcast_add", "broadcast_sub", "broadcast_mul",
+    "broadcast_div", "add_n", "maximum", "minimum",
+}
+
+
+def verify_graph(symbol, grad_names=None, dtypes=None, raise_on_issue=True,
+                 **shapes):
+    """Statically verify a Symbol (or a serialized graph JSON str/dict).
+
+    `shapes` are known input shapes by argument name (as passed to
+    infer_shape); `grad_names` are the arguments whose gradients will be
+    written by backward() — enables the donation-alias check. Returns
+    the list of GraphIssues (empty = clean); raises GraphVerifyError
+    instead when `raise_on_issue` and any issue was found."""
+    if isinstance(symbol, (str, dict)):
+        issues = _verify_json(symbol)
+    else:
+        issues = []
+        issues += _check_duplicates(symbol)
+        # name collisions make by-name shape keying unreliable; the
+        # remaining passes assume a well-formed namespace
+        if not issues:
+            issues += _check_shapes_dtypes(symbol, shapes, dtypes or {})
+            issues += _check_donation_alias(symbol, grad_names or ())
+    if issues and raise_on_issue:
+        raise GraphVerifyError(issues)
+    return issues
+
+
+# ------------------------------------------------------------- duplicates
+def _check_duplicates(symbol):
+    from ..symbol import _topo
+
+    seen = {}
+    issues = []
+    for n in _topo(symbol._outputs):
+        prev = seen.get(n.name)
+        if prev is None:
+            seen[n.name] = n
+            continue
+        if prev is n:
+            continue
+        kind_a = "variable" if prev.is_variable else f"op {prev.op.name}"
+        kind_b = "variable" if n.is_variable else f"op {n.op.name}"
+        issues.append(GraphIssue(
+            "duplicate_arg", n.name,
+            f"name {n.name!r} is used by two distinct nodes ({kind_a} "
+            f"and {kind_b}): binding is by-name, so one buffer would "
+            "silently serve both — rename one of them"))
+        seen[n.name] = n
+    return issues
+
+
+# ---------------------------------------------------------- shape / dtype
+def _check_shapes_dtypes(symbol, known_shapes, known_dtypes):
+    """Forward inference to fixpoint, mirroring symbol._graph_infer but
+    collecting structured issues instead of raising a flat error — and
+    additionally comparing declared input shapes against what each op
+    *requires*, which plain inference never does (it only fills
+    unknowns, so a contradiction slips through to bind/jit time)."""
+    from ..base import coerce_tuple
+    from ..ops import shape_infer as _shape_infer
+    from ..symbol import _topo
+
+    nodes = _topo(symbol._outputs)
+    shapes = {}
+    dtypes = {}
+    for n in nodes:
+        if not n.is_variable:
+            continue
+        if n.name in known_shapes:
+            shapes[(n, 0)] = tuple(known_shapes[n.name])
+            if "__shape__" in n._extra_attrs:
+                declared = coerce_tuple(n._extra_attrs["__shape__"])
+                if tuple(declared) != shapes[(n, 0)]:
+                    return [GraphIssue(
+                        "shape_contradiction", n.name,
+                        f"variable {n.name!r} declares shape "
+                        f"{tuple(declared)} but is bound with "
+                        f"{shapes[(n, 0)]}")]
+        elif "__shape__" in n._extra_attrs:
+            shapes[(n, 0)] = coerce_tuple(n._extra_attrs["__shape__"])
+        if n.name in known_dtypes:
+            dtypes[(n, 0)] = np.dtype(known_dtypes[n.name])
+        elif "__dtype__" in n._extra_attrs:
+            dtypes[(n, 0)] = np.dtype(n._extra_attrs["__dtype__"])
+
+    issues = []
+    flagged = set()   # node names already reported (stop cascades)
+    progress = True
+    while progress:
+        progress = False
+        for n in nodes:
+            if n.is_variable or n.name in flagged:
+                continue
+            params = n.op.normalize_params(n.attrs)
+            n_out = n.op.resolved_num_outputs(params)
+            outkeys = [(n, i) for i in range(n_out)]
+            if all(k in shapes for k in outkeys) and all(
+                    (src, i) in shapes for src, i in n.inputs):
+                continue
+            in_shapes = [shapes.get((src, i)) for src, i in n.inputs]
+            in_dtypes = [dtypes.get((src, i), np.dtype(np.float32))
+                         for src, i in n.inputs]
+            try:
+                new_in, out_shapes, out_dtypes = _shape_infer.infer_node(
+                    n.op, params, list(in_shapes), in_dtypes)
+            except MXNetError as e:
+                if _all_inputs_known(n, shapes):
+                    issues.append(_shape_issue(n, in_shapes, str(e)))
+                    flagged.add(n.name)
+                continue
+            except Exception as e:
+                if _all_inputs_known(n, shapes):
+                    issues.append(_shape_issue(
+                        n, in_shapes, f"{type(e).__name__}: {e}"))
+                    flagged.add(n.name)
+                continue
+            # contradiction: the op requires an input shape that
+            # disagrees with what is already declared/inferred
+            for pos, ((src, i), s) in enumerate(zip(n.inputs, new_in)):
+                if s is None:
+                    continue
+                k = (src, i)
+                if k in shapes and tuple(s) != shapes[k]:
+                    issues.append(GraphIssue(
+                        "shape_contradiction", n.name,
+                        f"op {n.name!r} ({n.op.name}) requires input "
+                        f"{pos} ({src.name!r}) of shape {tuple(s)}, but "
+                        f"it is declared/inferred as {shapes[k]}"))
+                    flagged.add(n.name)
+                elif k not in shapes:
+                    shapes[k] = tuple(s)
+                    progress = True
+            if n.name in flagged:
+                continue
+            for k, s, d in zip(outkeys, out_shapes, out_dtypes):
+                if k not in shapes:
+                    shapes[k] = tuple(s)
+                    progress = True
+                dtypes[k] = np.dtype(d)
+
+    # dtype agreement at multi-input elementwise ops
+    for n in nodes:
+        if n.is_variable or n.op.name not in _SAME_DTYPE_OPS:
+            continue
+        in_dt = [dtypes.get((src, i)) for src, i in n.inputs]
+        known = [(pos, d) for pos, d in enumerate(in_dt) if d is not None]
+        if len({d for _, d in known}) > 1:
+            detail = ", ".join(
+                f"input {pos} ({n.inputs[pos][0].name!r}): {d}"
+                for pos, d in known)
+            issues.append(GraphIssue(
+                "dtype_contradiction", n.name,
+                f"op {n.name!r} ({n.op.name}) mixes operand dtypes — "
+                f"{detail}; insert an explicit Cast"))
+    return issues
+
+
+def _all_inputs_known(n, shapes):
+    return all((src, i) in shapes for src, i in n.inputs)
+
+
+def _shape_issue(n, in_shapes, detail):
+    ins = ", ".join(
+        f"{src.name!r}: {shapes if shapes is None else tuple(shapes)}"
+        for (src, _), shapes in zip(n.inputs, in_shapes))
+    return GraphIssue(
+        "shape_contradiction", n.name,
+        f"op {n.name!r} ({n.op.name}) rejects its input shapes "
+        f"[{ins}]: {detail}")
+
+
+# ------------------------------------------------------- donation aliasing
+def _check_donation_alias(symbol, grad_names):
+    """An output reachable from a grad-bearing argument through
+    alias-transparent ops only shares that argument's buffer; the fused
+    backward path donates such buffers (exec_cache CompiledGraph), so
+    the output NDArray can be invalidated under the caller."""
+    grad_names = set(grad_names)
+    if not grad_names:
+        return []
+    issues = []
+    out_names = symbol.list_outputs()
+    for k, (node, idx) in enumerate(symbol._outputs):
+        chain = []
+        n = node
+        while (not n.is_variable
+               and n.op.name in ALIAS_TRANSPARENT_OPS and n.inputs):
+            chain.append(f"{n.op.name}({n.name!r})")
+            n = n.inputs[0][0]
+        if n.is_variable and n.name in grad_names:
+            via = " -> ".join(chain) if chain else "direct passthrough"
+            issues.append(GraphIssue(
+                "donation_alias", node.name,
+                f"output {k} ({out_names[k]!r}) aliases the buffer of "
+                f"gradient-bearing argument {n.name!r} via {via}: "
+                "backward() donates training buffers, which can "
+                "invalidate this output in place — route it through a "
+                "computing op (e.g. `x * 1`) or set grad_req='null' "
+                f"for {n.name!r}"))
+    return issues
+
+
+# ------------------------------------------------------------- JSON graphs
+def _verify_json(data):
+    """Checks on a serialized node-list graph (Symbol.tojson format):
+    dead (head-unreachable) nodes, duplicate names, and input indices
+    out of range. Runs BEFORE symbol.loads, which silently drops
+    unreachable nodes."""
+    import json as _json
+
+    if isinstance(data, str):
+        data = _json.loads(data)
+    jnodes = data.get("nodes", [])
+    heads = data.get("heads", [])
+    issues = []
+    n_nodes = len(jnodes)
+    for i, jn in enumerate(jnodes):
+        for ref in jn.get("inputs", []):
+            if not (0 <= ref[0] < n_nodes):
+                issues.append(GraphIssue(
+                    "dead_node", jn.get("name", f"#{i}"),
+                    f"node #{i} references nonexistent input node "
+                    f"#{ref[0]}"))
+    reachable = set()
+    stack = [h[0] for h in heads if 0 <= h[0] < n_nodes]
+    while stack:
+        i = stack.pop()
+        if i in reachable:
+            continue
+        reachable.add(i)
+        for ref in jnodes[i].get("inputs", []):
+            if 0 <= ref[0] < n_nodes:
+                stack.append(ref[0])
+    for i, jn in enumerate(jnodes):
+        if i not in reachable:
+            issues.append(GraphIssue(
+                "dead_node", jn.get("name", f"#{i}"),
+                f"node #{i} ({jn.get('name')!r}, op "
+                f"{jn.get('op')!r}) is unreachable from every head: "
+                "dead code in the serialized graph — it would be "
+                "silently dropped at load"))
+    names = {}
+    for i, jn in enumerate(jnodes):
+        name = jn.get("name")
+        if name in names:
+            issues.append(GraphIssue(
+                "duplicate_arg", name,
+                f"nodes #{names[name]} and #{i} share the name "
+                f"{name!r}"))
+        else:
+            names[name] = i
+    return issues
+
+
+def verify_enabled():
+    """Whether Executor._build should verify (MXNET_GRAPH_VERIFY).
+    Read raw (not through utils.getenv) to stay cheap on the bind
+    path; the knob is registered in mxnet_tpu/utils for docs."""
+    import os
+
+    return os.environ.get("MXNET_GRAPH_VERIFY", "0") not in (
+        "0", "", "false", "False", "off")

@@ -1,0 +1,213 @@
+"""mxlint engine: file walking, suppression, baseline, and reporting.
+
+The rule set lives in rules.py (one pure function per rule over a
+parsed file); this module owns everything around it:
+
+  - walking paths / reading sources / parsing
+  - inline suppression:  `# mxlint: disable=MX001` (this line),
+    `# mxlint: disable-next-line=MX001`, and a file-wide
+    `# mxlint: disable-file=MX005` anywhere in the file
+  - the checked-in baseline (grandfathered findings, matched by
+    (rule, path, stripped source line) so line-number drift does not
+    invalidate entries)
+  - text / JSON output
+
+Stdlib-only by design: `tools/mxlint.py` (and the CI lint gate) run it
+without importing jax or the framework package.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, asdict
+
+try:  # normal package import
+    from . import rules as _rules
+except ImportError:  # loaded standalone (tools/mxlint.py)
+    import rules as _rules
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*mxlint:\s*(disable|disable-next-line|disable-file)="
+    r"([A-Z0-9, ]+)")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str       # repo-relative, "/"-separated
+    line: int       # 1-based
+    col: int
+    message: str
+    source: str     # stripped source line (the baseline fingerprint)
+    baselined: bool = False
+
+    def format_text(self):
+        mark = " [baselined]" if self.baselined else ""
+        return (f"{self.path}:{self.line}:{self.col + 1}: "
+                f"{self.rule}{mark} {self.message}")
+
+
+def _parse_suppressions(lines):
+    """(per-line {lineno -> set(rules)}, file-wide set(rules))."""
+    by_line = {}
+    file_wide = set()
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        kind, codes = m.group(1), {
+            c.strip() for c in m.group(2).split(",") if c.strip()}
+        if kind == "disable":
+            by_line.setdefault(i, set()).update(codes)
+        elif kind == "disable-next-line":
+            by_line.setdefault(i + 1, set()).update(codes)
+        else:
+            file_wide.update(codes)
+    return by_line, file_wide
+
+
+def lint_file(path, relpath, registered_envs, select=None):
+    """All non-suppressed findings for one file."""
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    lines = src.splitlines()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding("MXSYN", relpath, e.lineno or 1, 0,
+                        f"syntax error: {e.msg}",
+                        lines[(e.lineno or 1) - 1].strip()
+                        if lines else "")]
+    ctx = _rules.FileContext(
+        relpath=relpath, tree=tree, lines=lines,
+        registered_envs=registered_envs)
+    by_line, file_wide = _parse_suppressions(lines)
+    out = []
+    for code, (check, _summary) in _rules.ALL_RULES.items():
+        if select and code not in select:
+            continue
+        if code in file_wide:
+            continue
+        for raw in check(ctx):
+            if raw.rule in by_line.get(raw.line, ()):
+                continue
+            text = (lines[raw.line - 1].strip()
+                    if 0 < raw.line <= len(lines) else "")
+            out.append(Finding(raw.rule, relpath, raw.line, raw.col,
+                               raw.message, text))
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def lint_paths(paths, root=None, select=None, extra_registry_paths=()):
+    """Lint every .py file under `paths`.
+
+    `root` anchors repo-relative paths (defaults to the common parent);
+    the env registry for MX003 is collected from the scanned files plus
+    `extra_registry_paths` (canonically mxnet_tpu/utils/__init__.py,
+    so linting a subdirectory still sees the full registry)."""
+    root = os.path.abspath(root or os.getcwd())
+    scan = [os.path.abspath(p) for p in paths]
+    registered = _rules.collect_registered_envs(
+        scan + [os.path.abspath(p) for p in extra_registry_paths])
+    findings = []
+    for path in _rules._iter_py(scan):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        findings.extend(lint_file(path, rel, registered, select=select))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+# ---------------------------------------------------------------- baseline
+def load_baseline(path):
+    """Baseline file -> multiset {(rule, path, source): count}."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    counts = {}
+    for e in data.get("findings", []):
+        key = (e["rule"], e["path"], e["source"])
+        counts[key] = counts.get(key, 0) + 1
+    return counts
+
+
+def apply_baseline(findings, baseline_counts):
+    """Mark findings present in the baseline; returns (new, baselined).
+    Matching is by (rule, path, stripped line text), consumed as a
+    multiset so one baseline entry cannot absorb two live findings."""
+    remaining = dict(baseline_counts)
+    new, kept = [], []
+    for f in findings:
+        key = (f.rule, f.path, f.source)
+        if remaining.get(key, 0) > 0:
+            remaining[key] -= 1
+            f.baselined = True
+            kept.append(f)
+        else:
+            new.append(f)
+    return new, kept
+
+
+def write_baseline(findings, path):
+    data = {
+        "comment": (
+            "mxlint baseline: grandfathered findings, matched by "
+            "(rule, path, source line). Reserved for DELIBERATE keeps "
+            "only — new code must lint clean. Regenerate with "
+            "`python tools/mxlint.py <paths> --write-baseline`."),
+        "findings": [
+            {"rule": f.rule, "path": f.path, "source": f.source,
+             "message": f.message}
+            for f in findings
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+# ------------------------------------------------------------------ report
+def render_text(new, baselined, show_baselined=False):
+    lines = [f.format_text() for f in new]
+    if show_baselined:
+        lines += [f.format_text() for f in baselined]
+    by_rule = {}
+    for f in new:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    if new:
+        summary = ", ".join(
+            f"{c}x {r}" for r, c in sorted(by_rule.items()))
+        lines.append(
+            f"mxlint: {len(new)} finding(s) ({summary})"
+            + (f", {len(baselined)} baselined" if baselined else ""))
+    else:
+        lines.append(
+            "mxlint: clean"
+            + (f" ({len(baselined)} baselined)" if baselined else ""))
+    return "\n".join(lines)
+
+
+def render_json(new, baselined):
+    return json.dumps(
+        {
+            "findings": [asdict(f) for f in new],
+            "baselined": [asdict(f) for f in baselined],
+            "counts": {"new": len(new), "baselined": len(baselined)},
+        },
+        indent=2)
+
+
+def run(paths, root=None, baseline_path=None, fmt="text", select=None,
+        show_baselined=False, extra_registry_paths=()):
+    """One full lint pass. Returns (exit_code, report_text):
+    exit code 1 iff any non-baselined finding exists."""
+    findings = lint_paths(paths, root=root, select=select,
+                          extra_registry_paths=extra_registry_paths)
+    baseline = {}
+    if baseline_path and os.path.exists(baseline_path):
+        baseline = load_baseline(baseline_path)
+    new, kept = apply_baseline(findings, baseline)
+    report = (render_json(new, kept) if fmt == "json"
+              else render_text(new, kept, show_baselined))
+    return (1 if new else 0), report

@@ -1,0 +1,442 @@
+"""mxlint rule set: the framework-specific invariants, checked at the AST.
+
+PRs 1-4 made this stack TPU-fast by construction — zero steady-state
+retraces (exec_cache), zero per-step host<->device sync (pipelined fit),
+registered MXNET_* knobs, deterministic worker streams — but those
+invariants were enforced only dynamically, by one runtime gate script
+per code path (ci/check_no_perstep_jit.py, ci/check_no_perstep_sync.py).
+A regression in any OTHER path shipped silently. These rules are the
+static half (the Relay/Glow lesson from PAPERS.md: verify at the graph/
+source level and fail fast with good diagnostics, not deep inside the
+backend):
+
+  MX001  host-sync call on a declared hot path
+  MX002  retrace hazard: jax.jit of a per-call / per-iteration closure
+  MX003  unregistered MXNET_* environment read
+  MX004  concurrency hygiene (bare except, implicit-daemon threads,
+         raw Lock.acquire)
+  MX005  nondeterminism: global-RNG draws outside mxnet_tpu.random,
+         wall-clock in cache keys
+
+Every rule is a pure function over one parsed file (`FileContext`);
+the engine (lint.py) owns walking, suppression, baseline, and output.
+This module is stdlib-only so `tools/mxlint.py` never imports jax.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+# --------------------------------------------------------------------------
+# Hot-path manifest (MX001). Paths are repo-relative with "/" separators;
+# values are qualified function names ("Class.method" or "function"), or
+# "*" for every function in the file. These are the per-step code paths
+# whose zero-sync property the runtime gates prove on ONE path each —
+# the manifest extends the guarantee to every listed function statically.
+# --------------------------------------------------------------------------
+HOT_PATH_MANIFEST = {
+    # pipelined fit internals (PR 3): one dispatch per step, fetches
+    # only at log intervals / epoch boundaries
+    "mxnet_tpu/module/base_module.py": (
+        "BaseModule.fit", "BaseModule.forward_backward",
+        "_DispatchWindow.admit", "_DispatchWindow.drain",
+    ),
+    "mxnet_tpu/module/module.py": (
+        "Module.forward", "Module.backward", "Module.update",
+    ),
+    # dynamic batcher flush loop (PR 2): assembly/flush must never
+    # block on device values
+    "mxnet_tpu/serving/batcher.py": "*",
+    "mxnet_tpu/serving/server.py": ("ModelServer._worker_loop",),
+    # device-prefetch worker (PR 4): staging is async device_put only
+    "mxnet_tpu/data/device_prefetch.py": (
+        "DevicePrefetchIter._stage_loop", "DevicePrefetchIter._to_device",
+        "DevicePrefetchIter.next", "DevicePrefetchIter._next_sync",
+    ),
+    # fused train step (PR 1): the whole step is one donated XLA launch
+    "mxnet_tpu/parallel/dp_step.py": (
+        "FusedTrainStep.step", "FusedTrainStep.run_steps",
+        "FusedTrainStep._place_data",
+    ),
+    # device-resident metric accumulation (PR 3)
+    "mxnet_tpu/metric.py": ("EvalMetric.update_device",),
+}
+
+# Methods that force a host<->device round-trip (MX001).
+_SYNC_METHODS = {"asnumpy", "wait_to_read"}
+
+# Global-RNG sampling entry points (MX005). Constructing an explicit
+# generator (RandomState/Generator/Philox/default_rng) is NOT flagged —
+# an owned, seedable stream is exactly what the rule asks for.
+_PY_RANDOM_FNS = {
+    "random", "randint", "uniform", "choice", "choices", "shuffle",
+    "sample", "gauss", "normalvariate", "randrange", "betavariate",
+    "expovariate", "triangular", "getrandbits", "seed",
+}
+_NP_RANDOM_FNS = {
+    "random", "rand", "randn", "randint", "random_sample", "ranf",
+    "uniform", "normal", "standard_normal", "choice", "shuffle",
+    "permutation", "beta", "binomial", "poisson", "exponential",
+    "gamma", "laplace", "multinomial", "seed",
+}
+_WALLCLOCK_CALLS = {
+    "time.time", "time.time_ns", "datetime.datetime.now",
+    "datetime.datetime.utcnow", "datetime.date.today",
+}
+
+# MX005 applies to library code only: the determinism contract is that
+# mxnet_tpu/ draws route through mxnet_tpu.random (so mx.random.seed
+# controls them); examples/ and tools/ are user-side code.
+_LIBRARY_PREFIX = "mxnet_tpu/"
+_MX005_EXEMPT = {
+    # the routing target itself: owns the seeded generators
+    "mxnet_tpu/random.py",
+}
+
+
+@dataclass
+class RawFinding:
+    rule: str
+    line: int
+    col: int
+    message: str
+
+
+@dataclass
+class FileContext:
+    """One parsed file plus the cross-file facts rules need."""
+
+    relpath: str            # repo-relative, "/"-separated
+    tree: ast.AST
+    lines: list[str]
+    registered_envs: set = field(default_factory=set)
+
+    def is_library(self):
+        return self.relpath.startswith(_LIBRARY_PREFIX)
+
+
+# --------------------------------------------------------------------------
+# shared AST helpers
+# --------------------------------------------------------------------------
+def _import_map(tree):
+    """Local name -> dotted module path for plain imports."""
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def _dotted(node, imports):
+    """Resolve an expression to a dotted name through the import map:
+    `jnp.array` -> "jax.numpy.array" when `import jax.numpy as jnp`.
+    Returns None for anything that is not a plain Name/Attribute chain."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    root = imports.get(node.id, node.id)
+    parts.append(root)
+    return ".".join(reversed(parts))
+
+
+def _qualnames(tree):
+    """(node, qualified name) for every def: "Class.method" / "fn" /
+    "fn.nested"."""
+    out = []
+
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{prefix}{child.name}"
+                out.append((child, qn))
+                # nested defs belong to their enclosing hot function
+                walk(child, f"{qn}.")
+            elif isinstance(child, ast.ClassDef):
+                walk(child, f"{prefix}{child.name}.")
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    return out
+
+
+def _str_const(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+# --------------------------------------------------------------------------
+# MX001 — host-sync calls on declared hot paths
+# --------------------------------------------------------------------------
+def check_mx001(ctx):
+    manifest = HOT_PATH_MANIFEST.get(ctx.relpath)
+    if manifest is None:
+        return []
+    qual = _qualnames(ctx.tree)
+    imports = _import_map(ctx.tree)
+    findings = []
+
+    def covers(qn):
+        if manifest == "*":
+            return True
+        # nested defs inherit the hot-path property of their parent
+        return any(qn == m or qn.startswith(m + ".") for m in manifest)
+
+    seen = set()
+    for fn_node, qn in qual:
+        if not covers(qn):
+            continue
+        for node in ast.walk(fn_node):
+            if (node.__class__, id(node)) in seen:
+                continue  # nested hot def already walked by its parent
+            seen.add((node.__class__, id(node)))
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in _SYNC_METHODS:
+                findings.append(RawFinding(
+                    "MX001", node.lineno, node.col_offset,
+                    f"`.{f.attr}()` in hot-path function `{qn}`: blocks "
+                    "the dispatch pipeline on a device round-trip; keep "
+                    "values device-resident (see docs/perf.md) or fetch "
+                    "at log/epoch boundaries only"))
+            elif (isinstance(f, ast.Attribute) and f.attr == "item"
+                    and not node.args and not node.keywords):
+                findings.append(RawFinding(
+                    "MX001", node.lineno, node.col_offset,
+                    f"`.item()` in hot-path function `{qn}`: a scalar "
+                    "fetch is still a full device sync; accumulate on "
+                    "device and drain at get() time"))
+            else:
+                dn = _dotted(f, imports)
+                if dn == "numpy.array":
+                    findings.append(RawFinding(
+                        "MX001", node.lineno, node.col_offset,
+                        f"`np.array(...)` in hot-path function `{qn}`: "
+                        "materializes (and for device arrays, fetches) "
+                        "its argument on host; use jnp ops to stay on "
+                        "device, or np.asarray for known-host data"))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# MX002 — retrace hazards
+# --------------------------------------------------------------------------
+def check_mx002(ctx):
+    imports = _import_map(ctx.tree)
+    findings = []
+
+    def is_jit(node):
+        return _dotted(node, imports) in ("jax.jit", "jax.pmap")
+
+    def walk(node, loop_depth):
+        for child in ast.iter_child_nodes(node):
+            d = loop_depth
+            if isinstance(child, (ast.For, ast.While, ast.AsyncFor)):
+                d += 1
+            if isinstance(child, ast.Call):
+                if is_jit(child.func) and d > 0:
+                    findings.append(RawFinding(
+                        "MX002", child.lineno, child.col_offset,
+                        "jax.jit inside a loop: every iteration builds "
+                        "a fresh closure, so every call is a fresh "
+                        "trace+compile; hoist the jit (or go through "
+                        "exec_cache, which keys compiled programs by "
+                        "graph signature)"))
+                elif (isinstance(child.func, ast.Call)
+                        and is_jit(child.func.func)):
+                    findings.append(RawFinding(
+                        "MX002", child.lineno, child.col_offset,
+                        "jax.jit(...)(...) immediately invoked: the "
+                        "jitted closure is rebuilt per call, which "
+                        "guarantees a retrace every time; bind the jit "
+                        "once and reuse it"))
+            walk(child, d)
+
+    walk(ctx.tree, 0)
+    return findings
+
+
+# --------------------------------------------------------------------------
+# MX003 — unregistered MXNET_* environment reads
+# --------------------------------------------------------------------------
+def check_mx003(ctx):
+    imports = _import_map(ctx.tree)
+    findings = []
+
+    def flag(node, name, how):
+        findings.append(RawFinding(
+            "MX003", node.lineno, node.col_offset,
+            f"{how} reads {name!r}, which is not declared in the env "
+            "registry (mxnet_tpu/utils register_env): undocumented knobs "
+            "drift — register it so docs/env_vars.md includes it"))
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            dn = _dotted(node.func, imports)
+            if dn is not None and (
+                    dn.endswith("os.environ.get") or dn == "os.getenv"
+                    or dn.endswith(".environ.get")):
+                name = _str_const(node.args[0]) if node.args else None
+                if (name and name.startswith("MXNET_")
+                        and name not in ctx.registered_envs):
+                    flag(node, name, f"`{dn}`")
+        elif isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, ast.Load):
+            dn = _dotted(node.value, imports)
+            if dn is not None and dn.endswith("os.environ"):
+                name = _str_const(node.slice)
+                if (name and name.startswith("MXNET_")
+                        and name not in ctx.registered_envs):
+                    flag(node, name, "`os.environ[...]`")
+    return findings
+
+
+# --------------------------------------------------------------------------
+# MX004 — concurrency hygiene
+# --------------------------------------------------------------------------
+def check_mx004(ctx):
+    imports = _import_map(ctx.tree)
+    findings = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            findings.append(RawFinding(
+                "MX004", node.lineno, node.col_offset,
+                "bare `except:` also swallows KeyboardInterrupt/"
+                "SystemExit — a worker loop that catches these can "
+                "never be shut down; catch `Exception` (or narrower)"))
+        elif isinstance(node, ast.Call):
+            dn = _dotted(node.func, imports)
+            if dn == "threading.Thread":
+                if not any(k.arg == "daemon" for k in node.keywords):
+                    findings.append(RawFinding(
+                        "MX004", node.lineno, node.col_offset,
+                        "threading.Thread without an explicit daemon=: "
+                        "an implicit non-daemon thread with no join "
+                        "path hangs interpreter exit; pass daemon=True, "
+                        "or daemon=False alongside a join"))
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "acquire"
+                    and _dotted(node.func, imports) != "locale.acquire"):
+                findings.append(RawFinding(
+                    "MX004", node.lineno, node.col_offset,
+                    "raw `.acquire()`: an exception before the matching "
+                    "release() leaves the lock held forever; use "
+                    "`with lock:`"))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# MX005 — nondeterminism
+# --------------------------------------------------------------------------
+def check_mx005(ctx):
+    if not ctx.is_library() or ctx.relpath in _MX005_EXEMPT:
+        return []
+    imports = _import_map(ctx.tree)
+    findings = []
+
+    # function spans for the wall-clock-in-key check
+    key_spans = []
+    for node, qn in _qualnames(ctx.tree):
+        leaf = qn.rsplit(".", 1)[-1].lower()
+        if "key" in leaf or "signature" in leaf:
+            key_spans.append(
+                (node.lineno, getattr(node, "end_lineno", node.lineno),
+                 qn))
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dn = _dotted(node.func, imports)
+        if dn is None:
+            continue
+        if dn.startswith("random.") and dn.split(".", 1)[1] in \
+                _PY_RANDOM_FNS:
+            findings.append(RawFinding(
+                "MX005", node.lineno, node.col_offset,
+                f"`{dn}` draws from the process-global stdlib RNG, which "
+                "mx.random.seed does NOT control: two hosts (or two "
+                "runs) diverge silently; route through "
+                "mxnet_tpu.random.py_rng()"))
+        elif dn.startswith("numpy.random.") and \
+                dn.split(".")[-1] in _NP_RANDOM_FNS:
+            findings.append(RawFinding(
+                "MX005", node.lineno, node.col_offset,
+                f"`{dn}` uses numpy's global RNG directly; library code "
+                "must route through mxnet_tpu.random.np_rng() so the "
+                "draw is visibly under mx.random.seed control"))
+        elif dn in _WALLCLOCK_CALLS:
+            for lo, hi, qn in key_spans:
+                if lo <= node.lineno <= hi:
+                    findings.append(RawFinding(
+                        "MX005", node.lineno, node.col_offset,
+                        f"wall-clock `{dn}` inside `{qn}`: a time-derived "
+                        "cache key/signature is different on every "
+                        "process, defeating the cache and any cross-host "
+                        "agreement; key on content, not time"))
+                    break
+    return findings
+
+
+#: rule code -> (checker, one-line summary) — the engine iterates this.
+ALL_RULES = {
+    "MX001": (check_mx001, "host-sync call on a declared hot path"),
+    "MX002": (check_mx002, "jax.jit of a per-call/per-iteration closure"),
+    "MX003": (check_mx003, "unregistered MXNET_* environment read"),
+    "MX004": (check_mx004, "concurrency hygiene"),
+    "MX005": (check_mx005, "nondeterministic draw / wall-clock key"),
+}
+
+
+def collect_registered_envs(paths):
+    """Every string literal passed as the first argument to a
+    register_env(...) call anywhere in `paths` (files or dirs). The
+    registry in mxnet_tpu/utils/__init__.py is the canonical source;
+    scanning all files lets subsystems register their own knobs."""
+    names = set()
+    for path in _iter_py(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+        except OSError:
+            continue
+        if "register_env" not in src:
+            continue
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                f_ = node.func
+                fname = f_.attr if isinstance(f_, ast.Attribute) else \
+                    getattr(f_, "id", None)
+                if fname == "register_env" and node.args:
+                    s = _str_const(node.args[0])
+                    if s:
+                        names.add(s)
+    return names
+
+
+def _iter_py(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        else:
+            for dirpath, dirnames, files in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"]
+                for fn in sorted(files):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)

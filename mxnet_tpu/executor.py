@@ -105,6 +105,16 @@ class Executor:
         new one."""
         import os as _os
 
+        from .analysis import graph_verify as _gv
+
+        if _gv.verify_enabled():
+            _gv.verify_graph(
+                self._symbol,
+                grad_names=self._grad_names,
+                **{n: tuple(a.shape)
+                   for n, a in {**self.arg_dict,
+                                **self.aux_dict}.items()})
+
         mirror = _os.environ.get(
             "MXNET_BACKWARD_DO_MIRROR", "0") not in ("0", "", "false")
         self._cache_key = (

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import logging
 import os
-import random
 
 import numpy as np
 
@@ -19,6 +18,7 @@ from . import io as _io
 from . import ndarray as nd
 from . import recordio
 from .base import MXNetError
+from .random import np_rng, py_rng
 
 
 def imdecode(buf, to_rgb=True, flag=1):
@@ -85,8 +85,8 @@ def random_crop(src, size, interp=2):
     img = src.asnumpy() if isinstance(src, nd.NDArray) else np.asarray(src)
     h, w = img.shape[:2]
     new_w, new_h = scale_down((w, h), size)
-    x0 = random.randint(0, w - new_w)
-    y0 = random.randint(0, h - new_h)
+    x0 = py_rng().randint(0, w - new_w)
+    y0 = py_rng().randint(0, h - new_h)
     out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
     return out, (x0, y0, new_w, new_h)
 
@@ -117,15 +117,15 @@ def random_size_crop(src, size, min_area, ratio, interp=2):
     h, w = img.shape[:2]
     area = w * h
     for _ in range(10):
-        new_area = random.uniform(min_area, 1.0) * area
-        new_ratio = random.uniform(*ratio)
+        new_area = py_rng().uniform(min_area, 1.0) * area
+        new_ratio = py_rng().uniform(*ratio)
         new_w = int(np.sqrt(new_area * new_ratio))
         new_h = int(np.sqrt(new_area / new_ratio))
-        if random.random() < 0.5:
+        if py_rng().random() < 0.5:
             new_w, new_h = new_h, new_w
         if new_w <= w and new_h <= h:
-            x0 = random.randint(0, w - new_w)
-            y0 = random.randint(0, h - new_h)
+            x0 = py_rng().randint(0, w - new_w)
+            y0 = py_rng().randint(0, h - new_h)
             out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
             return out, (x0, y0, new_w, new_h)
     return random_crop(src, size, interp)
@@ -169,7 +169,7 @@ def RandomOrderAug(ts):
 
     def aug(src):
         srcs = [src]
-        random.shuffle(ts)
+        py_rng().shuffle(ts)
         for t in ts:
             srcs = [j for i in srcs for j in t(i)]
         return srcs
@@ -183,14 +183,14 @@ def ColorJitterAug(brightness, contrast, saturation):
     coef = np.array([[[0.299, 0.587, 0.114]]], dtype=np.float32)
     if brightness > 0:
         def baug(src):
-            alpha = 1.0 + random.uniform(-brightness, brightness)
+            alpha = 1.0 + py_rng().uniform(-brightness, brightness)
             arr = src.asnumpy().astype(np.float32) * alpha
             return [nd.array(np.clip(arr, 0, 255))]
 
         ts.append(baug)
     if contrast > 0:
         def caug(src):
-            alpha = 1.0 + random.uniform(-contrast, contrast)
+            alpha = 1.0 + py_rng().uniform(-contrast, contrast)
             arr = src.asnumpy().astype(np.float32)
             gray = (arr * coef).sum(axis=2, keepdims=True)
             arr = arr * alpha + gray.mean() * (1.0 - alpha)
@@ -199,7 +199,7 @@ def ColorJitterAug(brightness, contrast, saturation):
         ts.append(caug)
     if saturation > 0:
         def saug(src):
-            alpha = 1.0 + random.uniform(-saturation, saturation)
+            alpha = 1.0 + py_rng().uniform(-saturation, saturation)
             arr = src.asnumpy().astype(np.float32)
             gray = (arr * coef).sum(axis=2, keepdims=True)
             arr = arr * alpha + gray * (1.0 - alpha)
@@ -213,7 +213,7 @@ def LightingAug(alphastd, eigval, eigvec):
     """PCA lighting noise (reference image.py:204)."""
 
     def aug(src):
-        alpha = np.random.normal(0, alphastd, size=(3,))
+        alpha = np_rng().normal(0, alphastd, size=(3,))
         rgb = np.dot(eigvec * alpha, eigval)
         arr = src.asnumpy().astype(np.float32) + rgb
         return [nd.array(arr)]
@@ -230,7 +230,7 @@ def ColorNormalizeAug(mean, std):
 
 def HorizontalFlipAug(p):
     def aug(src):
-        if random.random() < p:
+        if py_rng().random() < p:
             return [nd.array(src.asnumpy()[:, ::-1])]
         return [src]
 
@@ -457,7 +457,7 @@ class ImageIter(_io.DataIter):
 
     def reset(self):
         if self.shuffle and self.seq is not None:
-            random.shuffle(self.seq)
+            py_rng().shuffle(self.seq)
         if self.imgrec is not None:
             self.imgrec.reset()
         self.cur = 0
@@ -592,7 +592,7 @@ class ImageIter(_io.DataIter):
             blobs = [bytes(s) for _, s in raw]
             out_view = batch_data[i:i + len(raw)]
             ok = self._native_dec.decode_batch(
-                blobs, out_view, seed=random.getrandbits(63))
+                blobs, out_view, seed=py_rng().getrandbits(63))
             valid = []
             for j, (label, s) in enumerate(raw):
                 if not ok[j]:

@@ -21,7 +21,6 @@ OpenCV threads feeding the GPU).
 from __future__ import annotations
 
 import logging
-import random
 
 import numpy as np
 
@@ -29,6 +28,7 @@ from . import io as _io
 from . import ndarray as nd
 from . import recordio
 from .base import MXNetError
+from .random import py_rng
 from .image import (
     CastAug,
     ColorNormalizeAug,
@@ -92,7 +92,7 @@ class DetHorizontalFlipAug(DetAugmenter):
         self.p = p
 
     def __call__(self, src, label):
-        if random.random() < self.p:
+        if py_rng().random() < self.p:
             src = np.ascontiguousarray(src[:, ::-1])
             label = label.copy()
             x1 = label[:, 1].copy()
@@ -122,12 +122,12 @@ class DetRandomCropAug(DetAugmenter):
 
     def _sample(self, objs):
         for _ in range(self.max_trials):
-            scale = random.uniform(self.min_scale, self.max_scale)
-            ratio = random.uniform(self.min_aspect, self.max_aspect)
+            scale = py_rng().uniform(self.min_scale, self.max_scale)
+            ratio = py_rng().uniform(self.min_aspect, self.max_aspect)
             w = min(scale * np.sqrt(ratio), 1.0)
             h = min(scale / np.sqrt(ratio), 1.0)
-            x = random.uniform(0, 1 - w)
-            y = random.uniform(0, 1 - h)
+            x = py_rng().uniform(0, 1 - w)
+            y = py_rng().uniform(0, 1 - h)
             crop = np.array([x, y, x + w, y + h], dtype=np.float32)
             if not len(objs):
                 return crop
@@ -138,7 +138,7 @@ class DetRandomCropAug(DetAugmenter):
         return None
 
     def __call__(self, src, label):
-        if random.random() >= self.p:
+        if py_rng().random() >= self.p:
             return src, label
         crop = self._sample(label)
         if crop is None:
@@ -173,14 +173,14 @@ class DetRandomPadAug(DetAugmenter):
         self.p = p
 
     def __call__(self, src, label):
-        if random.random() >= self.p or self.max_pad_scale <= 1.0:
+        if py_rng().random() >= self.p or self.max_pad_scale <= 1.0:
             return src, label
         img = src
         h, w = img.shape[:2]
-        scale = random.uniform(1.0, self.max_pad_scale)
+        scale = py_rng().uniform(1.0, self.max_pad_scale)
         nh, nw = int(h * scale), int(w * scale)
-        oy = random.randint(0, nh - h)
-        ox = random.randint(0, nw - w)
+        oy = py_rng().randint(0, nh - h)
+        ox = py_rng().randint(0, nw - w)
         canvas = np.full((nh, nw) + img.shape[2:], self.fill,
                          dtype=img.dtype)
         canvas[oy:oy + h, ox:ox + w] = img
@@ -352,7 +352,7 @@ class ImageDetIter(_io.DataIter):
 
     def reset(self):
         if self.shuffle and self.seq is not None:
-            random.shuffle(self.seq)
+            py_rng().shuffle(self.seq)
         if self.imgrec is not None:
             self.imgrec.reset()
         self.cur = 0

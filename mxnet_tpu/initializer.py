@@ -18,6 +18,7 @@ import re
 import numpy as np
 
 from .base import MXNetError
+from .random import np_rng
 
 _INIT_REGISTRY: dict[str, type] = {}
 
@@ -185,7 +186,7 @@ class Uniform(Initializer):
 
     def _init_weight(self, _, arr):
         self._set(
-            arr, np.random.uniform(-self.scale, self.scale, arr.shape)
+            arr, np_rng().uniform(-self.scale, self.scale, arr.shape)
         )
 
 
@@ -198,7 +199,7 @@ class Normal(Initializer):
         self.sigma = sigma
 
     def _init_weight(self, _, arr):
-        self._set(arr, np.random.normal(0.0, self.sigma, arr.shape))
+        self._set(arr, np_rng().normal(0.0, self.sigma, arr.shape))
 
 
 @register
@@ -215,9 +216,9 @@ class Orthogonal(Initializer):
         nout = arr.shape[0]
         nin = int(np.prod(arr.shape[1:]))
         if self.rand_type == "uniform":
-            tmp = np.random.uniform(-1.0, 1.0, (nout, nin))
+            tmp = np_rng().uniform(-1.0, 1.0, (nout, nin))
         else:
-            tmp = np.random.normal(0.0, 1.0, (nout, nin))
+            tmp = np_rng().normal(0.0, 1.0, (nout, nin))
         u, _, v = np.linalg.svd(tmp, full_matrices=False)
         res = u if u.shape == tmp.shape else v
         self._set(arr, self.scale * res.reshape(arr.shape))
@@ -257,9 +258,9 @@ class Xavier(Initializer):
             raise MXNetError("Incorrect factor type")
         scale = math.sqrt(self.magnitude / factor)
         if self.rnd_type == "uniform":
-            self._set(arr, np.random.uniform(-scale, scale, shape))
+            self._set(arr, np_rng().uniform(-scale, scale, shape))
         elif self.rnd_type == "gaussian":
-            self._set(arr, np.random.normal(0, scale, shape))
+            self._set(arr, np_rng().normal(0, scale, shape))
         else:
             raise MXNetError("Unknown random type")
 

@@ -20,6 +20,7 @@ import numpy as np
 
 from .base import MXNetError
 from .ndarray import NDArray, array
+from .random import np_rng
 
 
 class DataDesc(object):
@@ -202,11 +203,11 @@ class PrefetchingIter(DataIter):
                 self.data_ready[i].set()
 
         self.prefetch_threads = [
-            threading.Thread(target=prefetch_func, args=[self, i])
+            threading.Thread(target=prefetch_func, args=[self, i],
+                             daemon=True)
             for i in range(self.n_iter)
         ]
         for thread in self.prefetch_threads:
-            thread.daemon = True
             thread.start()
 
     def close(self, timeout=5.0):
@@ -380,9 +381,9 @@ class NDArrayIter(DataIter):
     shuffle order becomes a pure function of `(seed, epoch)` (the same
     counter-based keying as data.sampler), re-derived on every
     `reset()` so each epoch sees a fresh — but replayable — order.
-    Unseeded `shuffle=True` keeps the legacy behavior: one
-    process-global `np.random.shuffle` at construction, same order
-    every epoch."""
+    Unseeded `shuffle=True` keeps the legacy behavior: one shuffle at
+    construction (drawn through `mxnet_tpu.random.np_rng`, so it is
+    under `mx.random.seed` control), same order every epoch."""
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
                  last_batch_handle="pad", data_name="data",
@@ -405,7 +406,7 @@ class NDArrayIter(DataIter):
         self.idx = np.arange(n)
         if self.shuffle:
             if self.seed is None:
-                np.random.shuffle(self.idx)  # legacy: unseeded, one-shot
+                np_rng().shuffle(self.idx)  # one-shot; under mx.random.seed control
             else:
                 self._reshuffle()
         self.idx = self.idx[: self._trim]

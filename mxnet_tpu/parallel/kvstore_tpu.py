@@ -46,6 +46,19 @@ from ..ndarray import NDArray
 # attach at client construction). Kept as a re-export for callers.
 from .._dist_bootstrap import maybe_init_distributed  # noqa: F401
 
+_BARRIER_PSUM = None
+
+
+def _barrier_psum():
+    """The barrier's pmapped psum, bound once: re-wrapping a fresh
+    lambda in jax.pmap on every `_barrier()` call would retrace each
+    time (mxlint MX002)."""
+    global _BARRIER_PSUM
+    if _BARRIER_PSUM is None:
+        _BARRIER_PSUM = jax.pmap(
+            lambda v: jax.lax.psum(v, "i"), axis_name="i")
+    return _BARRIER_PSUM
+
 
 class KVStoreTPU(KVStore):
     def __init__(self, kv_type="tpu"):
@@ -277,9 +290,7 @@ class KVStoreTPU(KVStore):
         if jax.process_count() == 1:
             return
         x = jnp.ones((jax.local_device_count(),))
-        jax.block_until_ready(
-            jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(x)
-        )
+        jax.block_until_ready(_barrier_psum()(x))
 
     def set_optimizer(self, optimizer):
         """All workers run the same updater on the merged gradient —

@@ -7,12 +7,35 @@ Keys are recorded on the autograd tape so replay is deterministic.
 """
 from __future__ import annotations
 
+import random as _pyrandom
 import threading
 
 import jax
 
 _state = threading.local()
 _DEFAULT_SEED = 0
+
+# Host-side RNG handles. Library code must draw through these instead
+# of the bare `random` / `np.random` module functions (mxlint MX005):
+# it keeps every draw visibly under mx.random.seed control, so two
+# hosts (or two runs) stay in lockstep.
+_py_rng = _pyrandom.Random(_DEFAULT_SEED)
+
+
+def py_rng() -> "_pyrandom.Random":
+    """The framework-owned stdlib RNG, reseeded by `seed()`."""
+    return _py_rng
+
+
+def np_rng():
+    """numpy RandomState under `seed()` control.
+
+    Returns numpy's global RandomState object, so draws interleave
+    exactly as if made through ``np.random.*`` — `seed()` (and plain
+    ``np.random.seed`` in tests) both steer it."""
+    import numpy as _np
+
+    return _np.random.mtrand._rand
 
 
 def _key():
@@ -31,6 +54,7 @@ def seed(seed_state: int):
 
     _state.key = jax.random.PRNGKey(int(seed_state))
     _np.random.seed(int(seed_state) & 0xFFFFFFFF)
+    _py_rng.seed(int(seed_state))
 
 
 def next_key():

@@ -8,11 +8,10 @@ bucket sizes bounds compilation count.
 """
 from __future__ import annotations
 
-import random as _pyrandom
-
 import numpy as np
 
 from ..base import MXNetError
+from ..random import np_rng, py_rng
 from ..io import DataBatch, DataDesc, DataIter
 from .. import ndarray as nd
 
@@ -135,9 +134,9 @@ class BucketSentenceIter(DataIter):
 
     def reset(self):
         self.curr_idx = 0
-        _pyrandom.shuffle(self.idx)
+        py_rng().shuffle(self.idx)
         for buck in self.data:
-            np.random.shuffle(buck)
+            np_rng().shuffle(buck)
 
         self.nddata = []
         self.ndlabel = []

@@ -12,6 +12,7 @@ import numpy as np
 from .base import MXNetError
 from .context import cpu, current_context
 from .ndarray import NDArray, array
+from .random import np_rng
 from .symbol import Symbol
 
 default_dtype = np.float32
@@ -24,9 +25,9 @@ def default_context():
 def random_arrays(*shapes):
     """Generate arrays of random float32 data."""
     arrays = [
-        np.array(np.random.randn(), dtype=default_dtype)
+        np.array(np_rng().randn(), dtype=default_dtype)
         if len(s) == 0
-        else np.random.randn(*s).astype(default_dtype)
+        else np_rng().randn(*s).astype(default_dtype)
         for s in shapes
     ]
     if len(arrays) == 1:
@@ -147,7 +148,7 @@ def check_numeric_gradient(sym, location, aux_states=None,
     input_shapes = {k: v.shape for k, v in location.items()}
     _, out_shapes, _ = sym.infer_shape(**input_shapes)
     proj = [
-        np.random.uniform(-1.0, 1.0, s).astype(np.float32)
+        np_rng().uniform(-1.0, 1.0, s).astype(np.float32)
         for s in out_shapes
     ]
 

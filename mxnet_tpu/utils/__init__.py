@@ -216,3 +216,26 @@ register_env(
     "more distinct bucket/shape signatures than this. Stats: "
     "mxnet_tpu.executor.cache_stats().",
 )
+register_env(
+    "MXNET_GRAPH_VERIFY", bool, False,
+    "run the pre-bind graph verifier (mxnet_tpu.analysis.verify_graph) "
+    "inside Executor binding: shape/dtype contradictions, duplicate "
+    "argument names, and donation-aliasing hazards are reported with "
+    "the offending op named, BEFORE jit tracing turns them into an "
+    "XLA stack trace. Always on in the test suite (tests/conftest.py); "
+    "off by default in production binds (docs/analysis.md).",
+)
+register_env(
+    "MXNET_TPU_WORKER_ID_FROM_MPI", bool, False,
+    "dist bootstrap: derive process_id from OMPI_COMM_WORLD_RANK / "
+    "PMI_RANK instead of MXNET_TPU_WORKER_ID when launching under "
+    "mpirun/srun (mxnet_tpu._dist_bootstrap).",
+)
+register_env(
+    "MXNET_TPU_FAULT_INJECT", str, "",
+    "resilience testing: deterministic crash injection for "
+    "fit_auto_resume ('epoch:N' fires after epoch N's checkpoint is "
+    "durable; 'step:N' fires at global batch N, the mid-epoch hard "
+    "resume case). Fires once, then the resumed run proceeds "
+    "(mxnet_tpu.fault.FaultInjector).",
+)

@@ -19,6 +19,11 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+# Pre-bind graph verification is always on under test: every
+# Executor._build in the suite runs mxnet_tpu.analysis.verify_graph
+# (shape/dtype contradictions, duplicate args, donation aliasing)
+# before tracing. Subprocesses inherit it through os.environ.
+os.environ.setdefault("MXNET_GRAPH_VERIFY", "1")
 
 # The axon sitecustomize (TPU tunnel) force-selects jax_platforms
 # "axon,cpu" at interpreter start, overriding JAX_PLATFORMS; pin the

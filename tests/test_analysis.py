@@ -1,0 +1,421 @@
+"""mxnet_tpu.analysis: mxlint rules MX001-MX005 (trigger + suppress),
+engine mechanics (suppression forms, baseline multiset), and the
+pre-bind graph verifier (shape/dtype contradictions, duplicate args,
+dead nodes, donation aliasing) on hand-built Symbols."""
+import json
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.analysis import (
+    GraphVerifyError,
+    lint,
+    rules,
+    verify_graph,
+)
+
+
+def _lint_src(src, relpath, registered_envs=(), tmp_path=None,
+              select=None):
+    """Run the real engine over one synthetic file."""
+    path = tmp_path / os.path.basename(relpath)
+    path.write_text(textwrap.dedent(src))
+    return lint.lint_file(str(path), relpath, set(registered_envs),
+                          select=select)
+
+
+# ===================================================================
+# MX001 — host sync on a declared hot path
+# ===================================================================
+HOT = "mxnet_tpu/serving/batcher.py"  # manifest says "*": every def is hot
+
+
+def test_mx001_flags_sync_calls_on_hot_path(tmp_path):
+    src = """
+    import numpy as np
+
+    def flush(batch):
+        a = batch.out.asnumpy()
+        batch.out.wait_to_read()
+        s = batch.loss.item()
+        h = np.array(batch.dev_arr)
+        return a, s, h
+    """
+    found = _lint_src(src, HOT, tmp_path=tmp_path, select={"MX001"})
+    assert [f.rule for f in found] == ["MX001"] * 4
+    assert "asnumpy" in found[0].message
+    assert "hot-path" in found[0].message
+
+
+def test_mx001_quiet_off_manifest_and_suppressible(tmp_path):
+    src = """
+    def flush(batch):
+        return batch.out.asnumpy()
+    """
+    # same code, not a manifest file -> clean
+    assert not _lint_src(src, "mxnet_tpu/model.py", tmp_path=tmp_path,
+                         select={"MX001"})
+    sup = """
+    def flush(batch):
+        return batch.out.asnumpy()  # mxlint: disable=MX001
+    """
+    assert not _lint_src(sup, HOT, tmp_path=tmp_path, select={"MX001"})
+
+
+def test_mx001_item_with_args_is_not_a_sync(tmp_path):
+    # dict.item-like calls with arguments are not the 0-arg scalar fetch
+    src = """
+    def flush(d):
+        return d.item("k")
+    """
+    assert not _lint_src(src, HOT, tmp_path=tmp_path, select={"MX001"})
+
+
+# ===================================================================
+# MX002 — retrace hazards
+# ===================================================================
+def test_mx002_jit_in_loop_and_immediate_invoke(tmp_path):
+    src = """
+    import jax
+
+    def train(fn, xs):
+        for x in xs:
+            step = jax.jit(lambda v: v + 1)
+            x = step(x)
+        return jax.jit(fn)(xs[0])
+    """
+    found = _lint_src(src, "mxnet_tpu/foo.py", tmp_path=tmp_path,
+                      select={"MX002"})
+    assert [f.rule for f in found] == ["MX002", "MX002"]
+    msgs = " ".join(f.message for f in found)
+    assert "inside a loop" in msgs and "immediately invoked" in msgs
+
+
+def test_mx002_hoisted_jit_is_clean(tmp_path):
+    src = """
+    import jax
+
+    _step = jax.jit(lambda v: v + 1)
+
+    def train(xs):
+        for x in xs:
+            x = _step(x)
+        return x
+    """
+    assert not _lint_src(src, "mxnet_tpu/foo.py", tmp_path=tmp_path,
+                         select={"MX002"})
+
+
+def test_mx002_suppress_next_line(tmp_path):
+    src = """
+    import jax
+
+    def once(fn, x):
+        # retrace accepted: one-shot probe
+        # mxlint: disable-next-line=MX002
+        return jax.jit(fn)(x)
+    """
+    assert not _lint_src(src, "mxnet_tpu/foo.py", tmp_path=tmp_path,
+                         select={"MX002"})
+
+
+# ===================================================================
+# MX003 — unregistered MXNET_* env reads
+# ===================================================================
+def test_mx003_unregistered_reads_flagged(tmp_path):
+    src = """
+    import os
+
+    a = os.environ.get("MXNET_BOGUS_KNOB", "0")
+    b = os.getenv("MXNET_OTHER_KNOB")
+    c = os.environ["MXNET_THIRD_KNOB"]
+    d = os.environ.get("NOT_OURS")            # non-MXNET: ignored
+    e = os.environ.get("MXNET_KNOWN_KNOB")    # registered: ignored
+    """
+    found = _lint_src(src, "mxnet_tpu/foo.py",
+                      registered_envs={"MXNET_KNOWN_KNOB"},
+                      tmp_path=tmp_path, select={"MX003"})
+    names = sorted(f.message.split("'")[1] for f in found)
+    assert names == ["MXNET_BOGUS_KNOB", "MXNET_OTHER_KNOB",
+                     "MXNET_THIRD_KNOB"]
+
+
+def test_mx003_suppressed_inline(tmp_path):
+    src = """
+    import os
+
+    a = os.environ.get("MXNET_SCRATCH")  # mxlint: disable=MX003
+    """
+    assert not _lint_src(src, "mxnet_tpu/foo.py", tmp_path=tmp_path,
+                         select={"MX003"})
+
+
+def test_registry_collection_sees_register_env_calls(tmp_path):
+    mod = tmp_path / "reg.py"
+    mod.write_text(
+        'register_env("MXNET_FROM_SCAN", int, 1, "doc")\n'
+        'utils.register_env("MXNET_VIA_ATTR", str, "", "doc")\n')
+    got = rules.collect_registered_envs([str(tmp_path)])
+    assert got == {"MXNET_FROM_SCAN", "MXNET_VIA_ATTR"}
+
+
+# ===================================================================
+# MX004 — concurrency hygiene
+# ===================================================================
+def test_mx004_bare_except_thread_acquire(tmp_path):
+    src = """
+    import threading
+
+    def go(q, lock):
+        t = threading.Thread(target=q.get)
+        t.start()
+        lock.acquire()
+        try:
+            pass
+        except:
+            pass
+    """
+    found = _lint_src(src, "mxnet_tpu/foo.py", tmp_path=tmp_path,
+                      select={"MX004"})
+    msgs = " ".join(f.message for f in found)
+    assert len(found) == 3
+    assert "daemon" in msgs and "acquire" in msgs and "bare" in msgs
+
+
+def test_mx004_clean_forms(tmp_path):
+    src = """
+    import threading
+
+    def go(q, lock):
+        t = threading.Thread(target=q.get, daemon=True)
+        t.start()
+        with lock:
+            pass
+        try:
+            pass
+        except Exception:
+            pass
+    """
+    assert not _lint_src(src, "mxnet_tpu/foo.py", tmp_path=tmp_path,
+                         select={"MX004"})
+
+
+# ===================================================================
+# MX005 — nondeterminism
+# ===================================================================
+def test_mx005_global_rng_and_wallclock_key(tmp_path):
+    src = """
+    import random
+    import time
+    import numpy as np
+
+    def augment(img):
+        if random.random() < 0.5:
+            return img + np.random.normal(0, 1, img.shape)
+        return img
+
+    def cache_key(sym):
+        return (sym.name, time.time())
+    """
+    found = _lint_src(src, "mxnet_tpu/foo.py", tmp_path=tmp_path,
+                      select={"MX005"})
+    msgs = " ".join(f.message for f in found)
+    assert len(found) == 3
+    assert "py_rng" in msgs and "np_rng" in msgs and "wall-clock" in msgs
+
+
+def test_mx005_library_only_and_owned_generators_ok(tmp_path):
+    src = """
+    import random
+    import numpy as np
+
+    r = random.random()
+    """
+    # user-side code (tools/, examples/) is out of contract
+    assert not _lint_src(src, "tools/bench.py", tmp_path=tmp_path,
+                         select={"MX005"})
+    owned = """
+    import numpy as np
+
+    def sample(seed, shape):
+        rng = np.random.RandomState(seed)   # owned stream: fine
+        return rng.uniform(size=shape)
+    """
+    assert not _lint_src(owned, "mxnet_tpu/foo.py", tmp_path=tmp_path,
+                         select={"MX005"})
+
+
+def test_mx005_disable_file(tmp_path):
+    src = """
+    # mxlint: disable-file=MX005
+    import random
+
+    x = random.random()
+    y = random.random()
+    """
+    assert not _lint_src(src, "mxnet_tpu/foo.py", tmp_path=tmp_path,
+                         select={"MX005"})
+
+
+def test_mx005_wallclock_outside_key_fn_is_fine(tmp_path):
+    src = """
+    import time
+
+    def speedometer(t0):
+        return time.time() - t0
+    """
+    assert not _lint_src(src, "mxnet_tpu/foo.py", tmp_path=tmp_path,
+                         select={"MX005"})
+
+
+# ===================================================================
+# engine mechanics
+# ===================================================================
+def test_syntax_error_is_reported_not_raised(tmp_path):
+    found = _lint_src("def broken(:\n", "mxnet_tpu/foo.py",
+                      tmp_path=tmp_path)
+    assert [f.rule for f in found] == ["MXSYN"]
+
+
+def test_baseline_multiset_consumption(tmp_path):
+    src = """
+    import os
+
+    a = os.environ.get("MXNET_AAA")
+    b = os.environ.get("MXNET_AAA")
+    """
+    found = _lint_src(src, "mxnet_tpu/foo.py", tmp_path=tmp_path,
+                      select={"MX003"})
+    assert len(found) == 2
+    bl = tmp_path / "baseline.json"
+    # baseline only ONE of the two identical findings: the second must
+    # still be reported (multiset consume, not set membership)
+    lint.write_baseline(found[:1], str(bl))
+    new, kept = lint.apply_baseline(found, lint.load_baseline(str(bl)))
+    assert len(new) == 1 and len(kept) == 1 and kept[0].baselined
+    # baselining both silences both, and the exit code goes green
+    lint.write_baseline(found, str(bl))
+    relint = _lint_src(src, "mxnet_tpu/foo.py", tmp_path=tmp_path,
+                       select={"MX003"})
+    new, kept = lint.apply_baseline(relint, lint.load_baseline(str(bl)))
+    assert not new and len(kept) == 2
+
+
+def test_render_json_shape(tmp_path):
+    found = _lint_src("import os\nx = os.environ.get('MXNET_ZZZ')\n",
+                      "mxnet_tpu/foo.py", tmp_path=tmp_path)
+    data = json.loads(lint.render_json(found, []))
+    assert data["counts"] == {"new": 1, "baselined": 0}
+    f = data["findings"][0]
+    assert f["rule"] == "MX003" and f["path"] == "mxnet_tpu/foo.py"
+
+
+def test_self_scan_analysis_package_is_clean():
+    """mxlint self-hosts: the analyzer's own sources lint clean."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    found = lint.lint_paths(
+        [os.path.join(root, "mxnet_tpu", "analysis")], root=root,
+        extra_registry_paths=(
+            os.path.join(root, "mxnet_tpu", "utils", "__init__.py"),))
+    assert not found, [f.format_text() for f in found]
+
+
+# ===================================================================
+# graph verifier
+# ===================================================================
+def test_verify_clean_graph_passes():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=8, name="fc")
+    out = mx.sym.SoftmaxOutput(fc, name="softmax")
+    assert verify_graph(out, data=(4, 16)) == []
+
+
+def test_verify_declared_vs_bound_shape_contradiction():
+    v = mx.sym.Variable("x", shape=(3, 4))
+    s = mx.sym.identity(v, name="id")
+    with pytest.raises(GraphVerifyError) as ei:
+        verify_graph(s, x=(5, 6))
+    (issue,) = ei.value.issues
+    assert issue.kind == "shape_contradiction"
+    assert "(3, 4)" in issue.message and "(5, 6)" in issue.message
+
+
+def test_verify_op_shape_contradiction_names_the_op():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    d = mx.sym.dot(a, b, name="mm")
+    with pytest.raises(GraphVerifyError) as ei:
+        verify_graph(d, a=(2, 3), b=(4, 5))
+    (issue,) = ei.value.issues
+    assert issue.kind == "shape_contradiction"
+    assert "'mm'" in issue.message          # offending op is named
+    assert "(2, 3)" in issue.message and "(4, 5)" in issue.message
+
+
+def test_verify_dtype_contradiction_at_elemwise():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    s = mx.sym.elemwise_add(a, b, name="add")
+    issues = verify_graph(
+        s, raise_on_issue=False,
+        dtypes={"a": np.float32, "b": np.float16},
+        a=(2, 2), b=(2, 2))
+    assert any(i.kind == "dtype_contradiction" and "'add'" in i.message
+               for i in issues)
+
+
+def test_verify_duplicate_name():
+    x = mx.sym.Variable("dup")
+    y = mx.sym.identity(x, name="dup")
+    with pytest.raises(GraphVerifyError) as ei:
+        verify_graph(y)
+    assert ei.value.issues[0].kind == "duplicate_arg"
+
+
+def test_verify_donation_alias_through_reshape():
+    w = mx.sym.Variable("w")
+    r = mx.sym.Reshape(w, shape=(4,), name="rs")
+    with pytest.raises(GraphVerifyError) as ei:
+        verify_graph(r, grad_names=["w"], w=(2, 2))
+    (issue,) = ei.value.issues
+    assert issue.kind == "donation_alias"
+    assert "'w'" in issue.message
+    # same head with no grad on w: not a hazard
+    assert verify_graph(r, grad_names=[], w=(2, 2)) == []
+
+
+def test_verify_dead_node_in_json():
+    live = mx.sym.identity(mx.sym.Variable("p"), name="live")
+    g = json.loads(live.tojson())
+    g["nodes"].append(
+        {"op": "identity", "name": "orphan", "inputs": [[0, 0]]})
+    issues = verify_graph(g, raise_on_issue=False)
+    assert [(i.kind, i.node) for i in issues] == [("dead_node", "orphan")]
+    # the checked JSON string form works too
+    issues = verify_graph(json.dumps(g), raise_on_issue=False)
+    assert issues and issues[0].kind == "dead_node"
+
+
+def test_verify_json_bad_input_index():
+    g = {"nodes": [{"op": "null", "name": "x", "inputs": [[7, 0]]}],
+         "heads": [[0, 0]]}
+    issues = verify_graph(g, raise_on_issue=False)
+    assert any("nonexistent" in i.message for i in issues)
+
+
+def test_executor_build_runs_verifier(monkeypatch):
+    """Under MXNET_GRAPH_VERIFY=1 a contradicted bind fails at _build
+    with the op named — before any jit tracing."""
+    monkeypatch.setenv("MXNET_GRAPH_VERIFY", "1")
+    v = mx.sym.Variable("x", shape=(2, 2))
+    s = mx.sym.identity(v, name="id")
+    arr = mx.nd.array(np.zeros((3, 3), dtype=np.float32))
+    with pytest.raises(GraphVerifyError):
+        s.bind(ctx=mx.cpu(), args={"x": arr}, grad_req="null")
+    # flag off: the same bind is allowed through to (working) execution
+    monkeypatch.setenv("MXNET_GRAPH_VERIFY", "0")
+    ex = s.bind(ctx=mx.cpu(), args={"x": arr}, grad_req="null")
+    assert ex.forward()[0].shape == (3, 3)

@@ -1,9 +1,14 @@
-"""Minimal lint gate (the reference gated `make lint` in CI; this
-environment ships no linter, so the gate is bytecode compilation +
-repo hygiene checks that catch the classes of rot a linter would)."""
+"""Lint gate (the reference gated `make lint` in CI). Two layers:
+bytecode compilation + repo hygiene (merge markers, tabs), and the
+framework-native analyzer — `tools/mxlint.py` over the whole tree must
+report zero non-baselined findings (rules MX001-MX005, docs/analysis.md).
+"""
 import compileall
+import json
 import os
 import re
+import subprocess
+import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -33,3 +38,30 @@ def test_no_merge_markers_or_tabs_in_python():
                 if "\t" in text:
                     bad.append((path, "tab indentation"))
     assert not bad, bad
+
+
+def test_mxlint_tree_is_clean():
+    """The shipped tree passes the framework analyzer: zero findings
+    beyond the checked-in baseline. The CLI is stdlib-only (never
+    imports jax), so this runs as a plain subprocess."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "mxlint.py"),
+         "mxnet_tpu", "tools", "examples", "--format", "json"],
+        cwd=ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    data = json.loads(proc.stdout)
+    assert data["counts"]["new"] == 0, data["findings"]
+
+
+def test_mxlint_exits_nonzero_on_violation(tmp_path):
+    """The gate actually gates: a seeded violation fails the run."""
+    bad = tmp_path / "mxnet_tpu" / "seeded.py"
+    bad.parent.mkdir()
+    bad.write_text("import os\n"
+                   "x = os.environ.get('MXNET_NOT_A_REAL_KNOB')\n")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "mxlint.py"),
+         str(bad.parent), "--no-baseline"],
+        cwd=str(tmp_path), capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "MX003" in proc.stdout

@@ -37,6 +37,7 @@ MODULES = [
     ("mxnet_tpu.model", "checkpoints + FeedForward"),
     ("mxnet_tpu.fault", "failure detection / auto-resume"),
     ("mxnet_tpu.serving", "dynamic-batching inference server"),
+    ("mxnet_tpu.analysis", "static analyzer (mxlint) + graph verifier"),
     ("mxnet_tpu.visualization", "network plots/summaries"),
     ("mxnet_tpu.models", "model zoo builders"),
     ("mxnet_tpu.parallel", "mesh/sharding primitives"),

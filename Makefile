@@ -10,7 +10,8 @@ CPUENV  := JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS=
 XLA8    := XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: all test nightly examples lint lint-check libs predict perl \
-	docs dryrun cache-check serving-check sync-check data-check clean
+	docs dryrun cache-check serving-check sync-check data-check \
+	passes-check clean
 
 all: libs test
 
@@ -84,6 +85,12 @@ sync-check:
 # mid-epoch auto-resumes with a bit-identical remaining batch stream
 data-check:
 	$(CPUENV) $(PY) ci/check_input_stall.py
+
+# graph-pass tier: per-pass parity tests + runtime A/B gate (pipeline
+# shrinks the executed graph at 1e-6 parity, zero steady-state retraces,
+# isomorphic builds share one compiled program)
+passes-check:
+	$(CPUENV) bash ci/check_passes.sh
 
 # multi-chip sharding dryrun (DP / SP+TP / PP / EP) on 8 virtual devices
 dryrun:

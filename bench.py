@@ -436,6 +436,99 @@ def _fit_pipeline_probe(platform):
     }
 
 
+def _passes_bench(platform):
+    """BENCH_MODE=passes: A/B of the graph-optimization pipeline
+    (mxnet_tpu.passes) on a deliberately redundant MLP — duplicate
+    branches (CSE bait), a constant scale/shift subgraph (fold bait)
+    and identity ops. One record: executed node count, bind+trace
+    latency, steady-state step throughput and graphPassStats with the
+    pipeline off vs on, plus the canonical-collision proof (two build
+    orders, one compiled program)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import exec_cache, passes
+
+    batch, hidden, iters = 32, 256, 30
+
+    def build(noise=0):
+        for _ in range(noise):      # vary auto-name numbering only
+            _ = mx.sym.exp(mx.sym.Variable("data"))
+        d = mx.sym.Variable("data")
+        fc = mx.sym.FullyConnected(d, num_hidden=hidden, name="fc1")
+        # duplicate branches off the shared fc: same op, same wiring,
+        # fresh nodes every call -> CSE bait
+        h = mx.sym.Activation(fc, act_type="relu")
+        dup = mx.sym.Activation(fc, act_type="relu")
+        h = (h + dup) * 1.0         # identity fold bait
+        # const subgraph: scale computed from literals -> fold bait
+        scale = (mx.sym.ones((hidden,)) * 0.5) + 0.5
+        h = mx.sym.broadcast_mul(h, scale)
+        out = mx.sym.FullyConnected(h, num_hidden=8, name="fc2")
+        return mx.sym.sum(out)
+
+    ctx = mx.cpu() if platform == "cpu" else mx.tpu()
+    rs = np.random.RandomState(0)
+    x = mx.nd.array(rs.rand(batch, 64).astype("float32"))
+
+    def arm(spec, noise=0):
+        os.environ["MXNET_GRAPH_PASSES"] = spec
+        exec_cache.clear()
+        exec_cache.reset_stats()
+        passes.clear_memo()
+        passes.reset_pass_stats()
+        t0 = time.perf_counter()
+        exe = build(noise).simple_bind(ctx, grad_req="null",
+                                       data=(batch, 64))
+        exe.forward(is_train=False, data=x)
+        exe.outputs[0].asnumpy()    # force the first trace + compile
+        bind_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            exe.forward(is_train=False, data=x)
+        out = exe.outputs[0].asnumpy()
+        step_us = (time.perf_counter() - t0) / iters * 1e6
+        return exe, bind_s, step_us, float(out.sum())
+
+    old = os.environ.get("MXNET_GRAPH_PASSES")
+    try:
+        exe_raw, bind_raw, step_raw, sum_raw = arm("0")
+        n_raw = len(exe_raw._compiled.plan)
+        exe_opt, bind_opt, step_opt, sum_opt = arm("1")
+        n_opt = len(exe_opt._compiled.plan)
+        pst = passes.graph_pass_stats()
+
+        # isomorphic build order -> pure cache hit on the same entry
+        build(noise=3).simple_bind(ctx, grad_req="null",
+                                   data=(batch, 64))
+        cst = exec_cache.cache_stats()
+    finally:
+        if old is None:
+            os.environ.pop("MXNET_GRAPH_PASSES", None)
+        else:
+            os.environ["MXNET_GRAPH_PASSES"] = old
+
+    rel = abs(sum_raw - sum_opt) / max(abs(sum_raw), 1e-9)
+    _emit({
+        "mode": "passes", "platform": platform, "batch": batch,
+        "executed_nodes_raw": n_raw,
+        "executed_nodes_opt": n_opt,
+        "node_reduction": round(1 - n_opt / n_raw, 3),
+        "bind_s_raw": round(bind_raw, 4),
+        "bind_s_opt": round(bind_opt, 4),
+        "step_us_raw": round(step_raw, 1),
+        "step_us_opt": round(step_opt, 1),
+        "step_speedup": round(step_raw / max(step_opt, 1e-9), 3),
+        "parity_rel_err": rel,
+        "traces": cst["traces"],
+        "canonical_collisions": cst["canonical_collisions"],
+        "pass_stats": {k: pst[k] for k in (
+            "pipeline_runs", "nodes_in", "nodes_out",
+            "nodes_eliminated", "folds", "cse_hits", "fusion_groups")},
+        "pass_time_us": pst["pass_time_us"],
+    })
+
+
 def main():
     # BENCH_XLA_FLAGS: extra XLA flags for A/B capture runs (e.g.
     # "--xla_tpu_enable_latency_hiding_scheduler=true"); appended
@@ -488,6 +581,8 @@ def main():
         return _serving_bench(jax.devices()[0].platform)
     if os.environ.get("BENCH_MODE", "train") == "input":
         return _input_bench(jax.devices()[0].platform)
+    if os.environ.get("BENCH_MODE", "train") == "passes":
+        return _passes_bench(jax.devices()[0].platform)
 
     import jax.numpy as jnp
     import numpy as np

@@ -81,6 +81,7 @@ from . import monitor as _monitor_mod
 from .monitor import Monitor
 from . import profiler
 from . import analysis
+from . import passes
 from . import visualization
 from . import visualization as viz
 from .callback import Speedometer

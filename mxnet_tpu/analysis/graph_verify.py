@@ -15,10 +15,12 @@ checks the NNVM pass pipeline would have:
                         bf16 operand doubles the op's HBM traffic)
   duplicate_arg         two distinct nodes share one name (binding is
                         by-name: one buffer would silently serve both)
-  dead_node             serialized-graph node unreachable from any
-                        head (JSON input only — a live Symbol is
-                        defined by its heads, so its topo walk cannot
-                        contain unreachable nodes)
+  dead_node             node-list-graph node unreachable from any head
+                        (JSON input or a `passes.Graph` mid-rewrite —
+                        a live Symbol is defined by its heads, so its
+                        topo walk cannot contain unreachable nodes;
+                        the traversal is shared with the DCE pass via
+                        `dead_node_indices`)
   donation_alias        an output reaches a gradient-bearing argument
                         through alias-transparent ops only (Reshape /
                         Flatten / identity / BlockGrad): the fused
@@ -80,7 +82,16 @@ def verify_graph(symbol, grad_names=None, dtypes=None, raise_on_issue=True,
     infer_shape); `grad_names` are the arguments whose gradients will be
     written by backward() — enables the donation-alias check. Returns
     the list of GraphIssues (empty = clean); raises GraphVerifyError
-    instead when `raise_on_issue` and any issue was found."""
+    instead when `raise_on_issue` and any issue was found.
+
+    Accepts a live Symbol, a serialized graph (JSON str or dict), or a
+    pass-pipeline `mxnet_tpu.passes.Graph` (anything exposing
+    `to_json_dict()`). The node-list forms get the structural checks
+    (dead nodes, duplicate names, input ranges) — this is how a pass
+    rewrite that orphans a producer is caught *after* the rewrite, not
+    only in pre-`loads` JSON."""
+    if hasattr(symbol, "to_json_dict"):
+        symbol = symbol.to_json_dict()
     if isinstance(symbol, (str, dict)):
         issues = _verify_json(symbol)
     else:
@@ -272,6 +283,29 @@ def _check_donation_alias(symbol, grad_names):
 
 
 # ------------------------------------------------------------- JSON graphs
+def dead_node_indices(node_inputs, head_indices):
+    """Indices of nodes unreachable from any head.
+
+    `node_inputs` is a list (one entry per node) of input node indices;
+    `head_indices` the node indices the graph's heads point at. This is
+    THE dead-node traversal — `_verify_json` reports what it returns,
+    and the pass pipeline's DCE (`passes.Graph.compact`) deletes it, so
+    "what the verifier flags" and "what DCE removes" can never drift.
+    Out-of-range references are ignored here (reported separately)."""
+    n = len(node_inputs)
+    reachable = set()
+    stack = [h for h in head_indices if 0 <= h < n]
+    while stack:
+        i = stack.pop()
+        if i in reachable:
+            continue
+        reachable.add(i)
+        for src in node_inputs[i]:
+            if 0 <= src < n:
+                stack.append(src)
+    return {i for i in range(n) if i not in reachable}
+
+
 def _verify_json(data):
     """Checks on a serialized node-list graph (Symbol.tojson format):
     dead (head-unreachable) nodes, duplicate names, and input indices
@@ -292,24 +326,18 @@ def _verify_json(data):
                     "dead_node", jn.get("name", f"#{i}"),
                     f"node #{i} references nonexistent input node "
                     f"#{ref[0]}"))
-    reachable = set()
-    stack = [h[0] for h in heads if 0 <= h[0] < n_nodes]
-    while stack:
-        i = stack.pop()
-        if i in reachable:
-            continue
-        reachable.add(i)
-        for ref in jnodes[i].get("inputs", []):
-            if 0 <= ref[0] < n_nodes:
-                stack.append(ref[0])
+    dead = dead_node_indices(
+        [[ref[0] for ref in jn.get("inputs", [])] for jn in jnodes],
+        [h[0] for h in heads])
     for i, jn in enumerate(jnodes):
-        if i not in reachable:
-            issues.append(GraphIssue(
-                "dead_node", jn.get("name", f"#{i}"),
-                f"node #{i} ({jn.get('name')!r}, op "
-                f"{jn.get('op')!r}) is unreachable from every head: "
-                "dead code in the serialized graph — it would be "
-                "silently dropped at load"))
+        if i not in dead:
+            continue
+        issues.append(GraphIssue(
+            "dead_node", jn.get("name", f"#{i}"),
+            f"node #{i} ({jn.get('name')!r}, op "
+            f"{jn.get('op')!r}) is unreachable from every head: "
+            "dead code in the serialized graph — it would be "
+            "silently dropped at load"))
     names = {}
     for i, jn in enumerate(jnodes):
         name = jn.get("name")

@@ -61,6 +61,13 @@ HOT_PATH_MANIFEST = {
     ),
     # device-resident metric accumulation (PR 3)
     "mxnet_tpu/metric.py": ("EvalMetric.update_device",),
+    # graph-pass pipeline entry points (PR 6): they run inside every
+    # bind, ahead of the exec-cache lookup — a host sync here would
+    # serialize binding (constant folding's host transfer lives in
+    # transforms.fold, which runs at most once per canonical graph)
+    "mxnet_tpu/passes/manager.py": (
+        "optimize_for_bind", "PassManager.run", "pipeline_spec",
+    ),
 }
 
 # Methods that force a host<->device round-trip (MX001).

@@ -47,7 +47,15 @@ _stats = {
     "jit_builds": 0,    # lazy per-mode jax.jit closures constructed
     "graph_replays": 0, # Python executions of a run_graph body
                         # (jax retraces + eval_shape abstract passes)
+    "canonical_collisions": 0,  # hits where a DISTINCT build order
+                        # (new raw pre-pass signature) landed on an
+                        # existing entry — sharing the pass pipeline's
+                        # canonicalization created (see passes/)
 }
+
+# per-entry set of raw (pre-canonicalization) signatures that resolved
+# to it; parallel to _table, pruned with it
+_raw_sigs: "dict[tuple, set]" = {}
 
 
 def _enabled():
@@ -85,6 +93,7 @@ def clear():
     """Drop all cached programs (live executors keep their references)."""
     with _lock:
         _table.clear()
+        _raw_sigs.clear()
 
 
 def note_graph_replay():
@@ -103,26 +112,40 @@ def count_shared_hit():
         _stats["shared_hits"] += 1
 
 
-def lookup_or_build(key, builder):
+def lookup_or_build(key, builder, raw_sig=None):
     """Return the cached CompiledGraph for `key`, building (and
     LRU-inserting) it with `builder()` on a miss. Building happens under
     the lock: it is pure Python closure construction — the actual jax
-    trace is deferred to the first call of each jit."""
+    trace is deferred to the first call of each jit.
+
+    `raw_sig` is a hash of the caller's PRE-canonicalization graph
+    signature: a hit whose raw_sig was never seen on that entry means
+    two distinct build orders converged onto one compiled program
+    through the pass pipeline — counted as `canonical_collisions`."""
     with _lock:
         if _enabled():
             entry = _table.get(key)
             if entry is not None:
                 _stats["hits"] += 1
                 _table.move_to_end(key)
+                if raw_sig is not None:
+                    seen = _raw_sigs.setdefault(key, set())
+                    if raw_sig not in seen:
+                        seen.add(raw_sig)
+                        if len(seen) > 1:
+                            _stats["canonical_collisions"] += 1
                 return entry
         _stats["misses"] += 1
         _stats["traces"] += 1
         entry = builder()
         if _enabled():
             _table[key] = entry
+            if raw_sig is not None:
+                _raw_sigs[key] = {raw_sig}
             cap = capacity()
             while len(_table) > cap:
-                _table.popitem(last=False)
+                old_key, _ = _table.popitem(last=False)
+                _raw_sigs.pop(old_key, None)
                 _stats["evictions"] += 1
         return entry
 

@@ -105,6 +105,7 @@ class Executor:
         new one."""
         import os as _os
 
+        from . import passes as _passes
         from .analysis import graph_verify as _gv
 
         if _gv.verify_enabled():
@@ -115,10 +116,22 @@ class Executor:
                    for n, a in {**self.arg_dict,
                                 **self.aux_dict}.items()})
 
+        # graph-pass pipeline (MXNET_GRAPH_PASSES, memoized): the
+        # executor TRACES the optimized graph but keeps the original
+        # symbol as its public surface (arg names, output names,
+        # infer_shape) — passes never rename variables, so binding
+        # stays by-name against the same buffers. The cache key is
+        # built from the OPTIMIZED canonical graph: isomorphic
+        # differently-built symbols collapse onto one entry.
+        self._opt_symbol = _passes.optimize_for_bind(self._symbol)
+        raw_key = self._symbol.structure_key()
+        graph_key = (raw_key if self._opt_symbol is self._symbol
+                     else self._opt_symbol.structure_key())
+
         mirror = _os.environ.get(
             "MXNET_BACKWARD_DO_MIRROR", "0") not in ("0", "", "false")
         self._cache_key = (
-            self._symbol.structure_key(),
+            graph_key,
             tuple(sorted(
                 (g, repr(c)) for g, c in self._group2ctx.items())),
             tuple((n, tuple(self.arg_dict[n].shape),
@@ -140,14 +153,15 @@ class Executor:
             _exec_cache.count_shared_hit()
             return
         self._compiled = _exec_cache.lookup_or_build(
-            self._cache_key, self._trace_graph)
+            self._cache_key, self._trace_graph,
+            raw_sig=hash(raw_key))
 
     def _trace_graph(self):
         """Build the pure run_graph program + node plan for this bind's
         signature (cache-miss path). No jax tracing happens here — each
         per-mode jit is constructed lazily by CompiledGraph and traces
         on its first call."""
-        sym = self._symbol
+        sym = getattr(self, "_opt_symbol", None) or self._symbol
         nodes = _topo(sym._outputs)
         node_ids = {id(n): i for i, n in enumerate(nodes)}
         heads = [(id(n), i) for n, i in sym._outputs]

@@ -59,6 +59,28 @@ def _arange(start=0.0, stop=None, step=1.0, repeat=1, dtype="float32",
     return out
 
 
+def _coerce_const_value(v):
+    # Serialized graphs carry the value as its repr (nested tuples of
+    # numbers); live graphs pass python lists/scalars straight through.
+    if isinstance(v, str):
+        import ast
+
+        return ast.literal_eval(v)
+    return v
+
+
+@register(
+    "_graph_constant",
+    coerce={"value": _coerce_const_value},
+    defaults={"dtype": "float32"},
+)
+def _graph_constant(value=0.0, dtype="float32", ctx=None):
+    """Materialized result of constant folding (passes.fold): holds the
+    folded subgraph's value as nested python lists so it survives the
+    tojson/loads round-trip. Never constructed by user code."""
+    return jnp.asarray(value, dtype=jnp.dtype(dtype))
+
+
 @register(
     "ones_like",
     arg_names=["data"],

@@ -1,0 +1,157 @@
+"""Analytic-first cost model: FLOPs + bytes-moved, padding-aware.
+
+The Kaufman et al. TPU performance-model lesson (PAPERS.md): most of a
+graph's cost on TPU is explained by two analytic terms — MXU FLOPs and
+HBM bytes — *provided* the byte term accounts for tiling: the VPU/MXU
+consume (sublane, lane) tiles of (8, 128) for f32 (16 for 2-byte, 32
+for 1-byte dtypes), so a tensor whose minor dims don't fill a tile
+pays for the padded tile anyway. `padding_waste` makes that visible,
+and the layout chooser below is exactly "which orientation wastes
+fewer padded bytes at the conv/pool tensors".
+
+FLOPs reuse the analytic 2-per-MAC convention of `utils.flops`
+(matmul-class ops only); the byte term covers every node.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+TILE_LANES = 128
+
+
+def tile_sublanes(dtype):
+    """Sublane count of the (sublane, lane) register tile: 8 rows of
+    f32, doubling as the element narrows (bf16 -> 16, int8 -> 32)."""
+    itemsize = np.dtype(dtype).itemsize
+    return max(8, 32 // max(itemsize, 1))
+
+
+def _ceil_to(x, m):
+    return ((int(x) + m - 1) // m) * m
+
+
+def padded_elems(shape, dtype):
+    """Element count after padding the two minor dims up to the tile
+    grid (lane dim -> 128, sublane dim -> dtype sublanes). Scalars and
+    1-D tensors occupy one sublane row."""
+    shape = tuple(int(d) for d in shape)
+    if not shape:
+        return TILE_LANES * 1
+    lanes = _ceil_to(shape[-1], TILE_LANES)
+    if len(shape) == 1:
+        return lanes
+    sub = _ceil_to(shape[-2], tile_sublanes(dtype))
+    out = lanes * sub
+    for d in shape[:-2]:
+        out *= d
+    return out
+
+
+def _nbytes(shape, dtype, padded):
+    itemsize = np.dtype(dtype).itemsize
+    if padded:
+        return padded_elems(shape, dtype) * itemsize
+    n = itemsize
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def graph_costs(symbol, **input_shapes):
+    """Per-node analytic costs at the given input shapes.
+
+    Returns {"total_flops", "total_bytes", "padded_bytes",
+    "padding_waste", "by_node": {name: {flops, bytes, padded_bytes}}}.
+    `bytes` per node = inputs read + outputs written (the op's minimum
+    HBM traffic, ignoring fusion); `padding_waste` is the fraction of
+    padded traffic that is tile fill, 0 when every tensor tiles
+    exactly."""
+    from ..symbol import _graph_infer, _topo
+    from ..utils.flops import count_flops
+
+    known = {k: tuple(v) for k, v in input_shapes.items()}
+    shapes, dtypes = _graph_infer(symbol._outputs, known, {},
+                                  partial=True)
+    flops_by_node = count_flops(symbol, **input_shapes)["by_op"]
+
+    by_node = {}
+    total_bytes = 0
+    total_padded = 0
+    for n in _topo(symbol._outputs):
+        if n.is_variable:
+            continue
+        params = n.op.normalize_params(n.attrs)
+        n_out = n.op.resolved_num_outputs(params)
+        tensors = [(src, i) for src, i in n.inputs]
+        tensors += [(n, i) for i in range(n_out)]
+        raw = padded = 0
+        for key in tensors:
+            s = shapes.get(key)
+            if s is None:
+                continue
+            dt = np.dtype(dtypes.get(key, np.float32))
+            raw += _nbytes(s, dt, padded=False)
+            padded += _nbytes(s, dt, padded=True)
+        by_node[n.name] = {
+            "flops": float(flops_by_node.get(n.name, 0.0)),
+            "bytes": raw,
+            "padded_bytes": padded,
+        }
+        total_bytes += raw
+        total_padded += padded
+    waste = (1.0 - total_bytes / total_padded) if total_padded else 0.0
+    return {
+        "total_flops": sum(v["flops"] for v in by_node.values()),
+        "total_bytes": total_bytes,
+        "padded_bytes": total_padded,
+        "padding_waste": waste,
+        "by_node": by_node,
+    }
+
+
+# ------------------------------------------------------- layout choice
+def _conv_pool_tensors(symbol, input_shapes):
+    """(shape, dtype) of every data/output tensor at 2-D Convolution /
+    Pooling nodes — the tensors a layout rewrite would reorient."""
+    from ..symbol import _graph_infer, _topo
+
+    known = {k: tuple(v) for k, v in input_shapes.items()}
+    shapes, dtypes = _graph_infer(symbol._outputs, known, {},
+                                  partial=True)
+    out = []
+    for n in _topo(symbol._outputs):
+        if n.is_variable or n.op.name not in ("Convolution", "Pooling"):
+            continue
+        params = n.op.normalize_params(n.attrs)
+        if str(params.get("layout") or "NCHW") != "NCHW":
+            continue
+        for key in [n.inputs[0], (n, 0)]:
+            s = shapes.get(key)
+            if s is not None and len(s) == 4:
+                out.append((s, np.dtype(dtypes.get(key, np.float32))))
+    return out
+
+
+def layout_padded_bytes(symbol, input_shapes, layout):
+    """Padded HBM bytes of the conv/pool activations under `layout`
+    ("NCHW" or "NHWC"); shapes given in NCHW."""
+    total = 0
+    for s, dt in _conv_pool_tensors(symbol, input_shapes):
+        if layout == "NHWC":
+            s = (s[0], s[2], s[3], s[1])
+        total += _nbytes(s, dt, padded=True)
+    return total
+
+
+def choose_layout(symbol, input_shapes, platform):
+    """Analytic layout pick: NHWC only where it is the native tiling
+    (TPU) AND the padded-byte model agrees it does not lose (C on the
+    128-lane dim usually wins for C >= 32; tiny-C stem layers can go
+    either way, the model decides)."""
+    if platform != "tpu":
+        return "NCHW"
+    nchw = layout_padded_bytes(symbol, input_shapes, "NCHW")
+    if nchw == 0:
+        return "NCHW"  # no conv/pool tensors to reorient
+    nhwc = layout_padded_bytes(symbol, input_shapes, "NHWC")
+    return "NHWC" if nhwc <= nchw else "NCHW"

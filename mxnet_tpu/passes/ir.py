@@ -1,0 +1,278 @@
+"""Graph IR for the pass pipeline: the Symbol JSON node-list form.
+
+A `Graph` is the mutable, index-based twin of `Symbol.tojson()`: a flat
+node list (op name or None for variables, node name, python-valued
+params, input wiring as ``(node_index, output_index)`` pairs) plus the
+head list. It exists because passes need two things the live `Symbol`
+cannot give them:
+
+  - **dead nodes**: a Symbol is defined by its heads, so its topo walk
+    can never contain an unreachable node — but a serialized graph can,
+    and rewrites (fold/CSE rewiring) orphan producers all the time. The
+    node-list form keeps orphans addressable until `compact()` sweeps
+    them (the DCE pass, sharing one traversal with the verifier's
+    dead-node check — analysis/graph_verify.dead_node_indices).
+  - **cheap rewiring**: replacing a node or redirecting consumers is an
+    index update, not a graph rebuild.
+
+Round-trips preserve everything binding depends on: variable names
+(binding is by-name), aux flags, extra attrs, param python values
+(NEVER stringified — a Custom op's callable params survive), head order
+and multi-output wiring.
+"""
+from __future__ import annotations
+
+import json
+
+from ..base import MXNetError
+
+
+class GraphNode:
+    """One node record: `op` is the registry op NAME (string), or None
+    for a variable."""
+
+    __slots__ = ("op", "name", "attrs", "inputs", "is_aux", "extra")
+
+    def __init__(self, op, name, attrs=None, inputs=None, is_aux=False,
+                 extra=None):
+        self.op = op
+        self.name = name
+        self.attrs = dict(attrs or {})
+        self.inputs = [tuple(i) for i in (inputs or [])]
+        self.is_aux = bool(is_aux)
+        self.extra = dict(extra or {})
+
+    @property
+    def is_variable(self):
+        return self.op is None
+
+    def opdef(self):
+        from ..ops import registry as _registry
+
+        if self.op is None:
+            return None
+        return _registry.get(self.op)
+
+    def params(self):
+        """Normalized (default-filled, coerced) op params."""
+        od = self.opdef()
+        return od.normalize_params(self.attrs) if od else {}
+
+    def num_outputs(self):
+        od = self.opdef()
+        if od is None:
+            return 1
+        return od.resolved_num_outputs(od.normalize_params(self.attrs))
+
+    def copy(self):
+        return GraphNode(self.op, self.name, dict(self.attrs),
+                         list(self.inputs), self.is_aux,
+                         dict(self.extra))
+
+    def __repr__(self):
+        return (f"<GraphNode {self.op or 'null'} {self.name!r} "
+                f"inputs={self.inputs}>")
+
+
+class Graph:
+    """Flat node-list graph: `nodes` (GraphNode records, inputs refer to
+    list indices) + `heads` ([(node_index, output_index)])."""
+
+    def __init__(self, nodes=None, heads=None):
+        self.nodes = list(nodes or [])
+        self.heads = [tuple(h) for h in (heads or [])]
+
+    # ------------------------------------------------------ construction
+    @classmethod
+    def from_symbol(cls, symbol):
+        from ..symbol import _topo
+
+        order = _topo(symbol._outputs)
+        index = {id(n): i for i, n in enumerate(order)}
+        nodes = []
+        for n in order:
+            nodes.append(GraphNode(
+                None if n.is_variable else n.op.name,
+                n.name,
+                attrs=n.attrs,
+                inputs=[(index[id(src)], i) for src, i in n.inputs],
+                is_aux=n.is_aux,
+                extra=n._extra_attrs,
+            ))
+        heads = [(index[id(n)], i) for n, i in symbol._outputs]
+        return cls(nodes, heads)
+
+    @classmethod
+    def from_json(cls, data):
+        """Parse a serialized node-list graph (Symbol.tojson format),
+        KEEPING unreachable nodes (symbol.loads silently drops them —
+        here they stay addressable so DCE can delete and count them)."""
+        if isinstance(data, str):
+            data = json.loads(data)
+        nodes = []
+        for jn in data.get("nodes", []):
+            attrs = dict(jn.get("attrs", jn.get("attr", {}) or {}))
+            is_aux = attrs.pop("__is_aux__", "False") in (
+                "True", "1", "true")
+            extra = {k: v for k, v in attrs.items()
+                     if k.startswith("__")}
+            params = {k: v for k, v in attrs.items()
+                      if not k.startswith("__")}
+            op = None if jn["op"] == "null" else jn["op"]
+            nodes.append(GraphNode(
+                op, jn["name"], attrs=params,
+                inputs=[(int(i), int(j)) for i, j, *_ in jn["inputs"]],
+                is_aux=is_aux, extra=extra))
+        heads = [(int(i), int(j)) for i, j, *_ in data.get("heads", [])]
+        return cls(nodes, heads)
+
+    def to_symbol(self):
+        from ..symbol import Node, Symbol
+
+        built = []
+        for gn in self.nodes:
+            node = Node(gn.opdef(), gn.name, attrs=dict(gn.attrs),
+                        is_aux=gn.is_aux)
+            node._extra_attrs = dict(gn.extra)
+            node.inputs = [(built[i], j) for i, j in gn.inputs]
+            built.append(node)
+        return Symbol([(built[i], j) for i, j in self.heads])
+
+    def to_json_dict(self):
+        """Structural dict in the Symbol.tojson layout (for the graph
+        verifier and debugging). Param VALUES are carried as-is — this
+        dict is for structural checks, not on-disk serialization (use
+        `to_symbol().tojson()` for that)."""
+        jnodes = []
+        for gn in self.nodes:
+            attrs = dict(gn.attrs)
+            attrs.update(gn.extra)
+            if gn.is_aux:
+                attrs["__is_aux__"] = "True"
+            jn = {
+                "op": "null" if gn.is_variable else gn.op,
+                "name": gn.name,
+                "inputs": [[i, j, 0] for i, j in gn.inputs],
+            }
+            if attrs:
+                jn["attrs"] = attrs
+            jnodes.append(jn)
+        return {
+            "nodes": jnodes,
+            "arg_nodes": [i for i, gn in enumerate(self.nodes)
+                          if gn.is_variable],
+            "heads": [[i, j, 0] for i, j in self.heads],
+        }
+
+    # --------------------------------------------------------- structure
+    def consumers(self):
+        """node index -> list of (consumer_index, input_position)."""
+        out = {i: [] for i in range(len(self.nodes))}
+        for ci, gn in enumerate(self.nodes):
+            for pos, (src, _) in enumerate(gn.inputs):
+                out[src].append((ci, pos))
+        return out
+
+    def validate(self):
+        n = len(self.nodes)
+        for i, gn in enumerate(self.nodes):
+            for src, _ in gn.inputs:
+                if not (0 <= src < n):
+                    raise MXNetError(
+                        f"graph node #{i} ({gn.name!r}) references "
+                        f"nonexistent input #{src}")
+                if src >= i:
+                    raise MXNetError(
+                        f"graph node #{i} ({gn.name!r}) references "
+                        f"non-topological input #{src}")
+        for src, _ in self.heads:
+            if not (0 <= src < n):
+                raise MXNetError(f"graph head references nonexistent "
+                                 f"node #{src}")
+
+    def compact(self):
+        """Remove nodes unreachable from the heads (one traversal,
+        shared with the verifier's dead-node check). Returns the number
+        of nodes removed; input indices are re-densified in place."""
+        from ..analysis.graph_verify import dead_node_indices
+
+        dead = dead_node_indices(
+            [[src for src, _ in gn.inputs] for gn in self.nodes],
+            [src for src, _ in self.heads])
+        if not dead:
+            return 0
+        remap = {}
+        kept = []
+        for i, gn in enumerate(self.nodes):
+            if i in dead:
+                continue
+            remap[i] = len(kept)
+            kept.append(gn)
+        for gn in kept:
+            gn.inputs = [(remap[src], j) for src, j in gn.inputs]
+        self.heads = [(remap[src], j) for src, j in self.heads]
+        removed = len(self.nodes) - len(kept)
+        self.nodes = kept
+        return removed
+
+    def toposort(self):
+        """Reorder `self.nodes` into DFS post-order from the heads
+        (dead nodes, if any, keep their relative order at the tail).
+        The order is a pure function of the wiring — two isomorphic
+        graphs sort identically regardless of how they were built."""
+        n = len(self.nodes)
+        order = []
+        seen = [False] * n
+        # iterative DFS matching symbol._topo's visit order
+        for h, _ in self.heads:
+            stack = [(h, False)]
+            while stack:
+                i, expanded = stack.pop()
+                if expanded:
+                    order.append(i)
+                    continue
+                if seen[i]:
+                    continue
+                seen[i] = True
+                stack.append((i, True))
+                for src, _ in reversed(self.nodes[i].inputs):
+                    if not seen[src]:
+                        stack.append((src, False))
+        for i in range(n):
+            if not seen[i]:
+                order.append(i)
+        remap = {old: new for new, old in enumerate(order)}
+        self.nodes = [self.nodes[i] for i in order]
+        for gn in self.nodes:
+            gn.inputs = [(remap[src], j) for src, j in gn.inputs]
+        self.heads = [(remap[src], j) for src, j in self.heads]
+        return self
+
+    def op_count(self):
+        """Number of executed (non-variable) nodes."""
+        return sum(1 for gn in self.nodes if not gn.is_variable)
+
+    def signature(self):
+        """Hashable structural signature of the FULL node-list form
+        (includes extra attrs and dead nodes — unlike
+        Symbol.structure_key, which sees only the live graph). Used by
+        idempotence checks: pipeline(g).signature() must be a fixpoint."""
+        from ..symbol import _canon
+
+        entries = []
+        for gn in self.nodes:
+            entries.append((
+                gn.op or "null", gn.name, _canon(gn.attrs),
+                _canon(gn.extra), gn.is_aux, tuple(gn.inputs),
+            ))
+        return (tuple(entries), tuple(self.heads))
+
+    def copy(self):
+        return Graph([gn.copy() for gn in self.nodes], list(self.heads))
+
+    def __len__(self):
+        return len(self.nodes)
+
+    def __repr__(self):
+        return (f"<Graph {len(self.nodes)} nodes "
+                f"({self.op_count()} ops), {len(self.heads)} heads>")

@@ -203,6 +203,17 @@ def exec_cache_stats():
     return cache_stats()
 
 
+def graph_pass_stats():
+    """Counters of the graph-optimization pass pipeline
+    (mxnet_tpu.passes): pipeline runs / memo hits, nodes in/out/
+    eliminated, folds, CSE merges, fusion groups, layout rewrites,
+    per-pass wall time — embedded in every dump_profile output as
+    `graphPassStats`."""
+    from .passes import graph_pass_stats as _gps
+
+    return _gps()
+
+
 def serving_stats():
     """Per-served-model counters of the serving tier (qps, queue depth,
     batch fill, padding waste, latency percentiles, retrace guard) —
@@ -249,6 +260,10 @@ def dump_profile(device_trace_dir=None):
     trace["hostSyncStats"] = host_sync_stats()
     try:
         trace["inputPipelineStats"] = input_pipeline_stats()
+    except Exception:
+        pass
+    try:
+        trace["graphPassStats"] = graph_pass_stats()
     except Exception:
         pass
     for name, cat, b, e in events:

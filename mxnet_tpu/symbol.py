@@ -346,8 +346,26 @@ class Symbol:
         heads = tuple((idx[id(n)], i) for n, i in self._outputs)
         return (tuple(entries), heads)
 
+    def canonical_signature(self):
+        """Stable hex digest of the canonical (pass-pipeline-optimized)
+        graph. Unlike structure_key() it survives pickling/processes,
+        and unlike tojson() it is construction-order independent — two
+        differently-built isomorphic symbols share one signature. Keys
+        the tuning cache (passes.Autotuner)."""
+        from . import passes as _passes
+
+        return _passes.canonical_digest(self)
+
     # ------------------------------------------------------- serialization
-    def tojson(self):
+    def tojson(self, canonical=False):
+        """Serialize to the node-list JSON graph. `canonical=True`
+        first runs the default pass pipeline (passes.optimize), so the
+        emitted JSON is the canonical form: stable topo order, dense
+        auto-names, normalized params, folded constants."""
+        if canonical:
+            from . import passes as _passes
+
+            return _passes.optimize(self).tojson()
         nodes = _topo(self._outputs)
         node_index = {id(n): i for i, n in enumerate(nodes)}
         jnodes = []

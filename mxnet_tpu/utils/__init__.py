@@ -226,6 +226,30 @@ register_env(
     "off by default in production binds (docs/analysis.md).",
 )
 register_env(
+    "MXNET_GRAPH_PASSES", str, "1",
+    "graph-optimization pass pipeline run on every bind ahead of the "
+    "exec-cache lookup (mxnet_tpu.passes): '1'/'on' = the default "
+    "pipeline (dce, fold, cse, canonicalize, fusion_hints); '0'/'off' "
+    "= trace graphs exactly as constructed; a comma list selects and "
+    "orders passes explicitly, e.g. 'dce,fold,cse,layout,"
+    "canonicalize' to add the opt-in NCHW->NHWC layout rewrite "
+    "(docs/passes.md).",
+)
+register_env(
+    "MXNET_PASS_FOLD_MAX", int, 65536,
+    "constant folding's per-tensor element cap (mxnet_tpu.passes): a "
+    "const subgraph whose result (or declared shape param) exceeds "
+    "this many elements stays in the traced graph instead of being "
+    "baked into the serialized form as a _graph_constant.",
+)
+register_env(
+    "MXNET_TUNING_CACHE", str, "~/.cache/mxnet_tpu/tuning.json",
+    "autotuner persistence (passes.Autotuner): JSON of tuning choices "
+    "(layout / multistep_k / bucket_grid) keyed by canonical graph "
+    "digest + platform; delete the file to re-tune from scratch "
+    "(docs/passes.md).",
+)
+register_env(
     "MXNET_TPU_WORKER_ID_FROM_MPI", bool, False,
     "dist bootstrap: derive process_id from OMPI_COMM_WORLD_RANK / "
     "PMI_RANK instead of MXNET_TPU_WORKER_ID when launching under "

@@ -5,6 +5,7 @@ tools/launch.py:49-52)."""
 import os
 import subprocess
 import sys
+import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -30,6 +31,7 @@ def _launch(script, timeout=600, n=2, retries=1, extra_args=()):
         )
         if proc.returncode == 0 or attempt == retries:
             return proc
+        time.sleep(3)  # let loopback ports/gloo pairs drain
     return proc
 
 
@@ -93,7 +95,12 @@ def test_dist_model_parallel_two_workers(tmp_path):
     ref = sp.run([_sys.executable, script, "--ref-out", ref_out],
                  env=env, capture_output=True, text=True, timeout=600)
     assert ref.returncode == 0, ref.stdout + ref.stderr
-    proc = _launch("dist_model_parallel.py", timeout=900,
+    # retries=3: this tier trips a pre-existing loopback-gloo flake
+    # (concurrent collectives crossing on one tcp pair — EnforceNotMet
+    # "op.preamble.length <= op.nbytes") far more often than the
+    # kvstore tiers; reproduced at ~50% per launch on an unmodified
+    # checkout, so give it more rendezvous attempts
+    proc = _launch("dist_model_parallel.py", timeout=900, retries=3,
                    extra_args=("--ref-out", ref_out))
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert proc.stdout.count("dist_model_parallel OK") == 2, (

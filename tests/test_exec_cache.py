@@ -186,6 +186,43 @@ def test_reshape_with_extra_grad_buffer_does_not_crash():
     assert out.arg_dict["data"].shape == (2, 3)
 
 
+def test_isomorphic_symbols_share_one_program():
+    """Two Symbols built in different orders (distinct auto-name
+    numbering, identical structure + variable names) canonicalize to
+    the same graph: ONE trace, and the convergence is observable as
+    cache_stats()['canonical_collisions']."""
+    from mxnet_tpu import passes
+
+    passes.clear_memo()
+
+    def build(noise):
+        for _ in range(noise):          # burn auto-name counters
+            _ = mx.sym.exp(mx.sym.Variable("x"))
+        x, w = mx.sym.Variable("x"), mx.sym.Variable("w")
+        return (x * w) + (x * w)
+
+    s1, s2 = build(0), build(5)
+    # genuinely different raw graphs (node names differ)...
+    assert s1.structure_key() != s2.structure_key()
+    e1 = s1.simple_bind(mx.cpu(), x=(2, 2), w=(2, 2))
+    e2 = s2.simple_bind(mx.cpu(), x=(2, 2), w=(2, 2))
+    s = exec_cache.cache_stats()
+    # ...yet they share one compiled program through the pass pipeline
+    assert s["traces"] == 1 and s["hits"] == 1, s
+    assert s["canonical_collisions"] == 1, s
+    assert e1._compiled is e2._compiled
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(2, 2).astype("float32")
+    w = rs.rand(2, 2).astype("float32")
+    for e in (e1, e2):
+        e.forward(is_train=False, x=mx.nd.array(x), w=mx.nd.array(w))
+    np.testing.assert_allclose(e1.outputs[0].asnumpy(), 2 * x * w,
+                               rtol=1e-6)
+    np.testing.assert_allclose(e1.outputs[0].asnumpy(),
+                               e2.outputs[0].asnumpy())
+
+
 def test_shared_exec_short_circuits_table():
     net = _mlp()
     e1 = net.simple_bind(mx.cpu(), data=(4, 3))

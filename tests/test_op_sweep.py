@@ -452,6 +452,7 @@ COVERED_ELSEWHERE = {
     "RNN": "tests/test_rnn.py",
     "RingAttention": "tests/test_module_mesh.py",
     "MoEFFN": "tests/test_module_mesh.py",
+    "_graph_constant": "tests/test_passes.py",
 }
 
 

@@ -38,6 +38,7 @@ MODULES = [
     ("mxnet_tpu.fault", "failure detection / auto-resume"),
     ("mxnet_tpu.serving", "dynamic-batching inference server"),
     ("mxnet_tpu.analysis", "static analyzer (mxlint) + graph verifier"),
+    ("mxnet_tpu.passes", "graph-optimization pass pipeline + autotuner"),
     ("mxnet_tpu.visualization", "network plots/summaries"),
     ("mxnet_tpu.models", "model zoo builders"),
     ("mxnet_tpu.parallel", "mesh/sharding primitives"),

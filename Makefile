@@ -11,7 +11,7 @@ XLA8    := XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: all test nightly examples lint lint-check libs predict perl \
 	docs dryrun cache-check serving-check sync-check data-check \
-	passes-check clean
+	passes-check telemetry-check clean
 
 all: libs test
 
@@ -91,6 +91,13 @@ data-check:
 # isomorphic builds share one compiled program)
 passes-check:
 	$(CPUENV) bash ci/check_passes.sh
+
+# telemetry tier: test suite + runtime gates (every serving request
+# correlated submit->reply, /metrics + /statusz agree with in-process
+# snapshots, always-on tracing within 3% of step time, flight record
+# on an injected fault)
+telemetry-check:
+	$(CPUENV) bash ci/check_telemetry.sh
 
 # multi-chip sharding dryrun (DP / SP+TP / PP / EP) on 8 virtual devices
 dryrun:

@@ -140,6 +140,12 @@ def _host_sync_snapshot():
     return profiler.host_sync_stats()
 
 
+def _telemetry_snapshot():
+    from mxnet_tpu import telemetry
+
+    return telemetry.bench_snapshot()
+
+
 def _synth_recordio(n, classes, side=(280, 320)):
     """ImageNet-shaped .rec of natural-entropy synthetic JPEGs (smooth
     fields + mild noise — realistic decode cost, unlike pure noise)."""
@@ -264,6 +270,9 @@ def _serving_bench(platform):
             k: cache_info[k]
             for k in ("hits", "misses", "traces", "evictions")
         },
+        # per-stage span totals (serving.submit/enqueue/batch_flush/
+        # execute/reply) over the measured burst
+        "telemetry": _telemetry_snapshot(),
         "platform": platform,
     })
 
@@ -808,6 +817,9 @@ def main():
             k: cache_info[k]
             for k in ("hits", "misses", "traces", "evictions")
         },
+        # span-ring aggregates ({name: {count, total_us}}) — the
+        # fit.data_wait / fit.dispatch split of the probe's fit runs
+        "telemetry": _telemetry_snapshot(),
         "platform": platform,
         "device_kind": getattr(dev, "device_kind", ""),
         "peak_hbm_bytes": int(mem.get("peak_bytes_in_use", 0)),

@@ -68,6 +68,16 @@ HOT_PATH_MANIFEST = {
     "mxnet_tpu/passes/manager.py": (
         "optimize_for_bind", "PassManager.run", "pipeline_spec",
     ),
+    # telemetry hot paths (PR 7): span recording runs inside every
+    # serving request and every fit step; instrument updates and the
+    # exporter handler read live counters — none may touch the device
+    "mxnet_tpu/telemetry/trace.py": "*",
+    "mxnet_tpu/telemetry/registry.py": (
+        "Counter.inc", "Gauge.set", "Histogram.observe",
+    ),
+    "mxnet_tpu/telemetry/http.py": (
+        "TelemetryHandler.do_GET", "statusz",
+    ),
 }
 
 # Methods that force a host<->device round-trip (MX001).

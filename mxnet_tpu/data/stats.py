@@ -31,6 +31,8 @@ from __future__ import annotations
 import threading
 import time
 
+from ..telemetry import register_view as _register_view
+
 _lock = threading.Lock()
 
 _COUNTER_KEYS = (
@@ -97,3 +99,9 @@ def reset_input_pipeline_stats():
         for k in _COUNTER_KEYS:
             _counters[k] = 0
         _t_first = _t_last = None
+
+
+# live view in the central telemetry registry: /statusz and /metrics
+# read the same counters dump_profile embeds as `inputPipelineStats`
+_register_view("inputPipelineStats", input_pipeline_stats,
+               prom_prefix="input_pipeline")

@@ -34,6 +34,8 @@ from collections import OrderedDict
 import jax
 import jax.numpy as jnp
 
+from .telemetry import register_view as _register_view
+
 _DEFAULT_CAPACITY = 64
 
 _lock = threading.RLock()
@@ -87,6 +89,11 @@ def reset_stats():
     with _lock:
         for k in _stats:
             _stats[k] = 0
+
+
+# live view in the central telemetry registry: /statusz and /metrics
+# read the same counters dump_profile embeds as `execCacheStats`
+_register_view("execCacheStats", cache_stats, prom_prefix="exec_cache")
 
 
 def clear():

@@ -30,6 +30,7 @@ import re
 
 from . import model as _model
 from .base import MXNetError
+from .telemetry import flight as _flight
 
 
 def latest_checkpoint(prefix):
@@ -72,6 +73,9 @@ class FaultInjector(object):
             return
         kind, val = self._parse()
         if kind == "epoch" and epoch == int(val):
+            # last-N-spans + full stats snapshot on disk BEFORE the
+            # crash propagates (MXNET_TELEMETRY_FLIGHT_DIR; no-op off)
+            _flight.maybe_dump(f"fault_injector:{self.spec}")
             raise RuntimeError(
                 f"[fault-injection] simulated failure at epoch {epoch}"
             )
@@ -86,6 +90,7 @@ class FaultInjector(object):
             return
         kind, val = self._parse()
         if kind == "step" and self._steps == int(val):
+            _flight.maybe_dump(f"fault_injector:{self.spec}")
             raise RuntimeError(
                 f"[fault-injection] simulated failure at step "
                 f"{self._steps}"

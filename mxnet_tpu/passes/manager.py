@@ -28,6 +28,7 @@ import time
 from collections import OrderedDict
 
 from ..base import MXNetError
+from ..telemetry import register_view as _register_view
 from . import transforms as _t
 from .ir import Graph
 
@@ -112,6 +113,12 @@ def reset_pass_stats():
     global _stats
     with _STATS_LOCK:
         _stats = _zero_stats()
+
+
+# live view in the central telemetry registry: /statusz and /metrics
+# read the same counters dump_profile embeds as `graphPassStats`
+_register_view("graphPassStats", graph_pass_stats,
+               prom_prefix="graph_passes")
 
 
 # -------------------------------------------------------------- manager

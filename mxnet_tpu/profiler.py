@@ -16,10 +16,13 @@ import os
 import threading
 import time
 
+from . import telemetry as _telemetry
+
 _state = {
     "mode": "symbolic",
     "filename": "profile.json",
     "running": False,
+    "ever_ran": False,
     "jax_trace_dir": None,
 }
 _events = []
@@ -85,6 +88,13 @@ def reset_host_sync_stats():
             _sync_stats[k] = 0
 
 
+# hostSyncStats is the registry view owned by this module; the other
+# four silos register theirs at their own import (exec_cache,
+# serving.stats, data.stats, passes.manager)
+_telemetry.register_view("hostSyncStats", host_sync_stats,
+                         prom_prefix="host_sync")
+
+
 def profiler_set_config(mode="symbolic", filename="profile.json"):
     """Configure profiler output (reference profiler.py:10
     MXSetProfilerConfig). mode: 'symbolic' (executor-level events) or
@@ -98,6 +108,7 @@ def profiler_set_state(state="stop"):
     (reference profiler.py:25 MXSetProfilerState)."""
     if state == "run":
         _state["running"] = True
+        _state["ever_ran"] = True
         trace_dir = os.environ.get("MXNET_TPU_XLA_TRACE_DIR")
         if trace_dir:
             try:
@@ -124,7 +135,12 @@ def profiler_set_state(state="stop"):
                 pass
             _state["jax_trace_dir"] = None
         _state["running"] = False
-        dump_profile(device_trace_dir=device_trace)
+        # no collection ever ran in this process: there is nothing to
+        # dump, and writing an empty profile.json into the cwd as a
+        # side effect of a defensive stop() call is pure pollution
+        if not _state["ever_ran"]:
+            return None
+        return dump_profile(device_trace_dir=device_trace)
     else:
         raise ValueError("state must be 'run' or 'stop'")
 
@@ -133,30 +149,39 @@ def is_running():
     return _state["running"]
 
 
-def record_event(name, category, begin_s, end_s):
-    """Record one host-side event (seconds since profiler import)."""
-    if not _state["running"]:
+def record_event(name, category, begin_s, end_s, force=False):
+    """Record one host-side event (seconds since profiler import).
+    `force` bypasses the running check for callers that latched the
+    record decision earlier (scope)."""
+    if not force and not _state["running"]:
         return
     with _lock:
         _events.append((name, category, begin_s, end_s))
 
 
 class scope:
-    """Context manager timing a host-side region into the profile."""
+    """Context manager timing a host-side region into the profile.
+
+    The record decision is latched at __enter__: a region that began
+    while the profiler was running is recorded even if collection
+    stops before __exit__ (previously the region silently vanished),
+    and symmetrically a region that began before 'run' stays out."""
 
     def __init__(self, name, category="host"):
         self.name = name
         self.category = category
 
     def __enter__(self):
+        self._record = _state["running"]
         self._b = time.perf_counter() - _t0
         return self
 
     def __exit__(self, *exc):
-        record_event(
-            self.name, self.category, self._b,
-            time.perf_counter() - _t0,
-        )
+        if self._record:
+            record_event(
+                self.name, self.category, self._b,
+                time.perf_counter() - _t0, force=True,
+            )
         return False
 
 
@@ -193,45 +218,63 @@ def _collect_device_events(trace_dir):
     return out
 
 
+def _view(key, import_module):
+    """Thin read over the telemetry registry: the silo registers its
+    snapshot function as a view at ITS import; the lazy import here
+    only triggers that registration for callers that never imported
+    the silo themselves."""
+    if not _telemetry.has_view(key):
+        import importlib
+
+        importlib.import_module(import_module, __package__)
+    return _telemetry.view_snapshot(key)
+
+
 def exec_cache_stats():
     """Counters of the process-wide compiled-computation cache
-    (exec_cache): hits/misses/traces/evictions + size. Exposed here so
-    profiling workflows read dispatch amortization next to the
-    timeline; also embedded in every dump_profile output."""
-    from .exec_cache import cache_stats
-
-    return cache_stats()
+    (exec_cache): hits/misses/traces/evictions + size. A thin read of
+    the telemetry registry's `execCacheStats` view; also embedded in
+    every dump_profile output."""
+    return _view("execCacheStats", ".exec_cache")
 
 
 def graph_pass_stats():
     """Counters of the graph-optimization pass pipeline
     (mxnet_tpu.passes): pipeline runs / memo hits, nodes in/out/
     eliminated, folds, CSE merges, fusion groups, layout rewrites,
-    per-pass wall time — embedded in every dump_profile output as
-    `graphPassStats`."""
-    from .passes import graph_pass_stats as _gps
-
-    return _gps()
+    per-pass wall time — the registry's `graphPassStats` view,
+    embedded in every dump_profile output."""
+    return _view("graphPassStats", ".passes.manager")
 
 
 def serving_stats():
     """Per-served-model counters of the serving tier (qps, queue depth,
     batch fill, padding waste, latency percentiles, retrace guard) —
-    mxnet_tpu.serving.stats; embedded in every dump_profile output."""
-    from .serving.stats import serving_stats as _ss
-
-    return _ss()
+    the registry's `servingStats` view, embedded in every dump_profile
+    output."""
+    return _view("servingStats", ".serving.stats")
 
 
 def input_pipeline_stats():
     """Input-pipeline counters (wait-for-data per step, device-prefetch
-    queue depth, bytes/s, stall count) — mxnet_tpu.data.stats; embedded
-    in every dump_profile output. The "is my step waiting on input?"
-    answer: stall_count > 0 in steady state means the data tier, not
-    the device, bounds throughput (docs/faq.md)."""
-    from .data.stats import input_pipeline_stats as _ips
+    queue depth, bytes/s, stall count) — the registry's
+    `inputPipelineStats` view, embedded in every dump_profile output.
+    The "is my step waiting on input?" answer: stall_count > 0 in
+    steady state means the data tier, not the device, bounds
+    throughput (docs/faq.md)."""
+    return _view("inputPipelineStats", ".data.stats")
 
-    return _ips()
+
+def _ensure_silo_views():
+    """Trigger registration of any legacy silo view not yet imported
+    (each wrapped: an unimportable silo — e.g. jax missing pieces —
+    must not break the dump, matching the old per-silo try/except)."""
+    for fn in (exec_cache_stats, serving_stats, input_pipeline_stats,
+               graph_pass_stats):
+        try:
+            fn()
+        except Exception:
+            pass
 
 
 def dump_profile(device_trace_dir=None):
@@ -239,33 +282,22 @@ def dump_profile(device_trace_dir=None):
     reference emits a single unified trace, src/engine/profiler.cc:134):
     host-side framework events on pid 0, and — when a jax device
     capture ran — the XLA device timeline merged in under offset
-    pids. Top-level `execCacheStats` carries the compiled-computation
-    cache counters, `servingStats` the per-model serving counters, and
-    `inputPipelineStats` the data-tier stall/throughput counters
-    (chrome://tracing ignores unknown keys)."""
+    pids. Every subsystem view registered in the telemetry registry is
+    embedded top-level under its legacy key (`execCacheStats`,
+    `servingStats`, `hostSyncStats`, `inputPipelineStats`,
+    `graphPassStats`, in that historical order — chrome://tracing
+    ignores unknown keys).
+
+    Durability (round-7 satellite): the event buffer is cleared only
+    AFTER the file is durably on disk, and the write goes through
+    tmp + os.replace — a failed or interrupted dump neither loses the
+    buffered events nor leaves a torn/partial profile behind."""
     with _lock:
         events = list(_events)
-        _events.clear()
     trace = {"traceEvents": [], "displayTimeUnit": "ms"}
-    try:
-        trace["execCacheStats"] = exec_cache_stats()
-    except Exception:
-        pass
-    try:
-        stats = serving_stats()
-        if stats:
-            trace["servingStats"] = stats
-    except Exception:
-        pass
-    trace["hostSyncStats"] = host_sync_stats()
-    try:
-        trace["inputPipelineStats"] = input_pipeline_stats()
-    except Exception:
-        pass
-    try:
-        trace["graphPassStats"] = graph_pass_stats()
-    except Exception:
-        pass
+    _ensure_silo_views()
+    for key, snap in _telemetry.view_items():
+        trace[key] = snap
     for name, cat, b, e in events:
         trace["traceEvents"].append({
             "name": name, "cat": cat, "ph": "B",
@@ -278,6 +310,20 @@ def dump_profile(device_trace_dir=None):
     if device_trace_dir:
         trace["traceEvents"].extend(
             _collect_device_events(device_trace_dir))
-    with open(_state["filename"], "w") as f:
-        json.dump(trace, f)
-    return _state["filename"]
+    filename = _state["filename"]
+    tmp = f"{filename}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(trace, f)
+        os.replace(tmp, filename)
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise  # events stay buffered: nothing was dropped
+    # success: drop exactly the events that were written (events that
+    # arrived during the dump stay for the next one)
+    with _lock:
+        del _events[:len(events)]
+    return filename

@@ -33,6 +33,7 @@ import time
 import numpy as np
 
 from ..base import MXNetError
+from ..telemetry import trace as _trace
 
 
 class ServingError(MXNetError):
@@ -207,9 +208,10 @@ class BucketSpec:
 
 class _Request:
     __slots__ = ("inputs", "future", "t_enqueue", "deadline", "length",
-                 "bucket", "row")
+                 "bucket", "row", "trace_id", "t_enqueue_pc")
 
-    def __init__(self, inputs, future, deadline, length, bucket):
+    def __init__(self, inputs, future, deadline, length, bucket,
+                 trace_id=None):
         self.inputs = inputs
         self.future = future
         self.t_enqueue = time.monotonic()
@@ -217,6 +219,10 @@ class _Request:
         self.length = length
         self.bucket = bucket
         self.row = None               # batch row, set at assembly
+        # correlation: the trace id minted at submit(); spans recorded
+        # for this request (enqueue/batch_flush/execute/reply) carry it
+        self.trace_id = trace_id
+        self.t_enqueue_pc = _trace.now()  # span clock (perf_counter)
 
 
 class DynamicBatcher:

@@ -28,10 +28,19 @@ import threading
 import time
 from concurrent.futures import Future
 
+from ..telemetry import trace as _trace
+from ..telemetry import http as _thttp
+from ..telemetry import registry as _treg
 from .batcher import (DynamicBatcher, DeadlineExceededError,
                       ServerClosedError, _Request)
 from .registry import ModelRegistry
 from . import config as _cfg
+
+# end-to-end request latency (enqueue -> reply), labelled per model —
+# the native-histogram companion of ServingStats' p50/p95/p99 snapshot
+_LATENCY_MS = _treg.histogram(
+    "mxnet_tpu_serving_request_latency_ms",
+    "End-to-end serving request latency (enqueue to reply)")
 
 
 class _ModelLane:
@@ -65,6 +74,9 @@ class ModelServer:
         self._lanes = {}
         self._lock = threading.Lock()
         self._closed = False
+        # opt-in live introspection: with MXNET_TELEMETRY_PORT set this
+        # server answers /metrics, /statusz, /healthz while serving
+        _thttp.maybe_start_exporter()
 
     # ------------------------------------------------------ model mgmt
     def load(self, name, symbol_json, param_data, input_specs,
@@ -116,28 +128,37 @@ class ModelServer:
     def submit(self, name, inputs, version=None, deadline_ms=None):
         """Async inference: returns a Future of the request's output
         list (one numpy array per model output, padding sliced off).
-        Raises ServerBusyError synchronously when the queue is full."""
-        model = self.registry.get(name, version=version)
-        with self._lock:
-            lane = self._lanes.get(model.key)
-            closed = self._closed
-        if lane is None or closed:
-            raise ServerClosedError(
-                f"no active lane for {model.key} (server stopped or "
-                "model not served)")
-        stats = model.stats
-        stats.note_submitted()
-        length = model.spec.request_length(inputs)
-        bucket = model.spec.length_bucket(length)
-        deadline = (time.monotonic() + deadline_ms / 1e3
-                    if deadline_ms is not None else None)
-        fut = Future()
-        req = _Request(inputs, fut, deadline, length, bucket)
-        try:
-            lane.batcher.put(req)
-        except Exception as exc:
-            stats.note_rejected()
-            raise exc
+        Raises ServerBusyError synchronously when the queue is full.
+
+        The Future carries the request's correlation id as
+        `fut.trace_id`; `telemetry.spans_for_trace(fut.trace_id)`
+        reconstructs the request's path submit -> enqueue ->
+        batch_flush -> execute -> reply."""
+        tid = _trace.new_trace_id()
+        with _trace.span("serving.submit", trace_id=tid, model=name):
+            model = self.registry.get(name, version=version)
+            with self._lock:
+                lane = self._lanes.get(model.key)
+                closed = self._closed
+            if lane is None or closed:
+                raise ServerClosedError(
+                    f"no active lane for {model.key} (server stopped "
+                    "or model not served)")
+            stats = model.stats
+            stats.note_submitted()
+            length = model.spec.request_length(inputs)
+            bucket = model.spec.length_bucket(length)
+            deadline = (time.monotonic() + deadline_ms / 1e3
+                        if deadline_ms is not None else None)
+            fut = Future()
+            fut.trace_id = tid
+            req = _Request(inputs, fut, deadline, length, bucket,
+                           trace_id=tid)
+            try:
+                lane.batcher.put(req)
+            except Exception as exc:
+                stats.note_rejected()
+                raise exc
         return fut
 
     def predict(self, name, inputs, version=None, deadline_ms=None,
@@ -159,6 +180,7 @@ class ModelServer:
                     return
                 continue
             now = time.monotonic()
+            t_flush = _trace.now()
             live = []
             for r in group:
                 if r.deadline is not None and now > r.deadline:
@@ -168,13 +190,28 @@ class ModelServer:
                         f"(waited {(now - r.t_enqueue) * 1e3:.1f} ms)"))
                 else:
                     live.append(r)
+                # queue-residency span closes at flush time, expired
+                # requests included (their wait is the story)
+                _trace.record_span("serving.enqueue", r.trace_id,
+                                   r.t_enqueue_pc, t_flush,
+                                   {"model": model.key})
             if not live:
                 continue
             for row, r in enumerate(live):
                 r.row = row
+            # batch-level spans carry every member's correlation id so
+            # spans_for_trace(tid) finds them via the trace_ids attr
+            tids = tuple(r.trace_id for r in live)
             try:
                 feed, batch, lb, real, padded = spec.assemble(live)
-                outs = model.infer(feed, batch, lb)
+                t_assembled = _trace.now()
+                _trace.record_span(
+                    "serving.batch_flush", None, t_flush, t_assembled,
+                    {"trace_ids": tids, "model": model.key,
+                     "live": len(live), "batch": batch, "length": lb})
+                with _trace.span("serving.execute", model=model.key,
+                                 batch=batch, trace_ids=tids):
+                    outs = model.infer(feed, batch, lb)
                 per_req = spec.disassemble(outs, live, lb)
             except Exception as exc:
                 stats.note_failed(len(live))
@@ -188,8 +225,14 @@ class ModelServer:
             done = time.monotonic()
             for r, outputs in zip(live, per_req):
                 stats.note_completed(done - r.t_enqueue, now=done)
+                t_r0 = _trace.now()
                 if r.future.set_running_or_notify_cancel():
                     r.future.set_result(outputs)
+                t_r1 = _trace.now()
+                _trace.record_span("serving.reply", r.trace_id,
+                                   t_r0, t_r1, {"model": model.key})
+                _LATENCY_MS.observe((t_r1 - r.t_enqueue_pc) * 1e3,
+                                    model=model.key)
 
     # -------------------------------------------------------- lifecycle
     def stop(self, drain=True, timeout=30):

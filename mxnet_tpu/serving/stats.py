@@ -28,6 +28,8 @@ import threading
 import time
 from collections import deque
 
+from ..telemetry import register_view as _register_view
+
 _registry_lock = threading.Lock()
 _registry: "dict[str, ServingStats]" = {}
 
@@ -57,6 +59,14 @@ def reset_serving_stats():
         items = list(_registry.values())
     for st in items:
         st.reset()
+
+
+# live view in the central telemetry registry (omit_empty keeps the
+# profiler dump byte-compatible: no `servingStats` key until a model
+# is actually served); top-level snapshot keys are "name:version",
+# exported to Prometheus as a `model` label
+_register_view("servingStats", serving_stats, prom_prefix="serving",
+               omit_empty=True, label_name="model")
 
 
 def _percentile(sorted_vals, q):

@@ -1,0 +1,156 @@
+"""Live telemetry endpoints: /metrics, /statusz, /healthz.
+
+A stop-time `dump_profile()` cannot answer "what is this stuck fit /
+loaded server doing right now". This exporter is the live window:
+opt-in via MXNET_TELEMETRY_PORT=<port> (0 picks an ephemeral port), a
+single daemon thread runs a stdlib ThreadingHTTPServer serving
+
+  /metrics   Prometheus text exposition — native instruments with
+             their true types plus every registered subsystem view
+             flattened to gauges (scrape target for the autoscaling
+             signals ROADMAP items 1/5 need: queue depth, p99, qps)
+  /statusz   one JSON snapshot: every registered view under its
+             legacy dump_profile key, native metrics, span-ring
+             counters, process info
+  /healthz   200 "ok" liveness probe
+
+Attachment points: `serving.ModelServer.__init__` and
+`BaseModule.fit` both call `maybe_start_exporter()`, so setting the
+env var is the only step for either workload. Stdlib-only — the
+handler never imports jax and never touches device state, so a scrape
+cannot stall the dispatch pipeline.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import registry as _registry
+from . import trace as _trace
+
+_t_start = time.perf_counter()
+
+
+def statusz():
+    """The one-call process snapshot: every registered subsystem view
+    (legacy dump_profile keys at top level), native metrics, and span
+    counters."""
+    out = {
+        "pid": os.getpid(),
+        "uptime_s": round(time.perf_counter() - _t_start, 3),
+    }
+    for key, snap in _registry.view_items():
+        out[key] = snap
+    out["telemetry"] = {
+        "spans": _trace.trace_stats(),
+        "metrics": _registry.REGISTRY.metrics_snapshot(),
+    }
+    return out
+
+
+class TelemetryHandler(BaseHTTPRequestHandler):
+    """GET-only handler over the registry — no device access, no
+    mutation (listed in mxlint's HOT_PATH_MANIFEST: a scrape must
+    never sync the host with the device)."""
+
+    server_version = "mxnet-tpu-telemetry/1.0"
+
+    def do_GET(self):
+        path = self.path.split("?", 1)[0]
+        if path == "/healthz":
+            self._reply(200, "text/plain; charset=utf-8", "ok\n")
+        elif path == "/metrics":
+            self._reply(200, "text/plain; version=0.0.4; charset=utf-8",
+                        _registry.prometheus_text())
+        elif path == "/statusz":
+            self._reply(200, "application/json",
+                        json.dumps(statusz(), default=str))
+        else:
+            self._reply(404, "text/plain; charset=utf-8",
+                        "not found (try /metrics /statusz /healthz)\n")
+
+    def _reply(self, code, ctype, body):
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, format, *args):  # noqa: A002 - stdlib name
+        pass  # a scrape per second must not spam stderr
+
+
+class Exporter:
+    """One HTTP server + daemon thread. `port` reflects the actual
+    bound port (useful with port 0)."""
+
+    def __init__(self, port, host="0.0.0.0"):
+        self._server = ThreadingHTTPServer((host, int(port)),
+                                           TelemetryHandler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self.host = host
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name=f"telemetry-exporter-{self.port}", daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)
+
+
+_exporter_lock = threading.Lock()
+_exporter = None
+
+
+def start_exporter(port=None, host="127.0.0.1"):
+    """Start (or return) the process's exporter. Explicit-port calls
+    with a different port raise — one process, one telemetry port."""
+    global _exporter
+    with _exporter_lock:
+        if _exporter is not None:
+            if port is not None and int(port) not in (0, _exporter.port):
+                raise RuntimeError(
+                    f"telemetry exporter already on port "
+                    f"{_exporter.port}, refusing to also bind {port}")
+            return _exporter
+        if port is None:
+            raw = os.environ.get("MXNET_TELEMETRY_PORT", "")
+            if not raw.strip():
+                return None
+            port = int(raw)
+        _exporter = Exporter(port, host=host)
+        return _exporter
+
+
+def maybe_start_exporter():
+    """Idempotent opt-in hook: starts the exporter iff
+    MXNET_TELEMETRY_PORT is set. Called from serving.ModelServer and
+    BaseModule.fit; returns the exporter or None. Never raises — a
+    bad port must not take down training."""
+    try:
+        return start_exporter(port=None)
+    except Exception:
+        return None
+
+
+def exporter_port():
+    """The running exporter's bound port, or None — the way to learn
+    the ephemeral port MXNET_TELEMETRY_PORT=0 chose."""
+    with _exporter_lock:
+        return _exporter.port if _exporter is not None else None
+
+
+def stop_exporter():
+    """Shut the process exporter down (tests / clean unload)."""
+    global _exporter
+    with _exporter_lock:
+        exp, _exporter = _exporter, None
+    if exp is not None:
+        exp.stop()

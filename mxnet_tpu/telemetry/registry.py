@@ -1,0 +1,383 @@
+"""Central metrics registry: counters, gauges, histograms — plus views.
+
+Before this module, every subsystem kept its own counter silo
+(`execCacheStats`, `servingStats`, `hostSyncStats`,
+`inputPipelineStats`, `graphPassStats`) and the only reader was the
+stop-time `dump_profile()`. The registry unifies them behind one
+process-wide surface without moving any counter: each silo registers
+its existing snapshot function as a *view* (`register_view`), so the
+silo keeps owning its lock and its hot-path increments, while every
+consumer — `/statusz`, `/metrics`, the flight recorder, the profiler
+dump — reads through one place. `dump_profile` output stays
+byte-compatible because the view snapshots ARE the legacy snapshot
+functions.
+
+Native instruments (Counter / Gauge / Histogram) carry label sets for
+the few series the silos do not already cover (e.g. the serving
+request-latency histogram). The hot-path cost of an `observe()` is a
+dict lookup + bisect + three adds under one small lock — bounded and
+allocation-free in steady state; `ci/check_telemetry.sh` enforces the
+end-to-end overhead bound.
+
+Stdlib-only: the exporter thread (telemetry.http) renders Prometheus
+text and the statusz JSON from here without importing jax.
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+
+_DEFAULT_BUCKETS_MS = (
+    0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0, 500.0,
+    1000.0, 2000.0, 5000.0,
+)
+
+
+def _label_key(labels):
+    return tuple(sorted(labels.items())) if labels else ()
+
+
+def _sanitize(name):
+    """Prometheus metric-name characters only ([a-zA-Z0-9_])."""
+    out = []
+    for ch in str(name):
+        out.append(ch if (ch.isalnum() and ch.isascii()) or ch == "_"
+                   else "_")
+    s = "".join(out)
+    if s and s[0].isdigit():
+        s = "_" + s
+    return s
+
+
+def _fmt_labels(pairs):
+    if not pairs:
+        return ""
+    body = ",".join(f'{_sanitize(k)}="{_escape(v)}"' for k, v in pairs)
+    return "{" + body + "}"
+
+
+def _escape(v):
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+class _Instrument:
+    """Base: one named metric, one value cell per label set."""
+
+    kind = "untyped"
+
+    def __init__(self, name, help_):
+        self.name = name
+        self.help = help_
+        self._lock = threading.Lock()
+        self._cells = {}
+
+    def snapshot(self):
+        """{label-key-tuple: value} — plain numbers for counter/gauge,
+        dicts for histograms."""
+        with self._lock:
+            return {k: self._read_cell(v) for k, v in
+                    self._cells.items()}
+
+    def _read_cell(self, cell):
+        return cell
+
+
+class Counter(_Instrument):
+    """Monotonic count (requests served, spans dropped, ...)."""
+
+    kind = "counter"
+
+    def inc(self, n=1, **labels):
+        key = _label_key(labels)
+        with self._lock:
+            self._cells[key] = self._cells.get(key, 0) + n
+
+    def value(self, **labels):
+        with self._lock:
+            return self._cells.get(_label_key(labels), 0)
+
+    def render(self, lines):
+        lines.append(f"# TYPE {self.name} counter")
+        with self._lock:
+            items = sorted(self._cells.items())
+        for key, val in items or [((), 0)]:
+            lines.append(f"{self.name}{_fmt_labels(key)} {val}")
+
+
+class Gauge(_Instrument):
+    """Point-in-time value; `set_fn` installs a callback read at
+    snapshot time (queue depths, ring occupancy)."""
+
+    kind = "gauge"
+
+    def __init__(self, name, help_):
+        super().__init__(name, help_)
+        self._fn = None
+
+    def set(self, value, **labels):
+        with self._lock:
+            self._cells[_label_key(labels)] = value
+
+    def set_fn(self, fn):
+        self._fn = fn
+
+    def value(self, **labels):
+        if self._fn is not None:
+            return self._fn()
+        with self._lock:
+            return self._cells.get(_label_key(labels), 0)
+
+    def render(self, lines):
+        lines.append(f"# TYPE {self.name} gauge")
+        if self._fn is not None:
+            try:
+                lines.append(f"{self.name} {self._fn()}")
+            except Exception:
+                lines.append(f"{self.name} 0")
+            return
+        with self._lock:
+            items = sorted(self._cells.items())
+        for key, val in items or [((), 0)]:
+            lines.append(f"{self.name}{_fmt_labels(key)} {val}")
+
+    def snapshot(self):
+        if self._fn is not None:
+            try:
+                return {(): self._fn()}
+            except Exception:
+                return {(): 0}
+        return super().snapshot()
+
+
+class Histogram(_Instrument):
+    """Fixed-bound bucketed distribution (latencies). An observe() is
+    a bisect into the bound list + sum/count adds — the hot-path cost
+    never grows with observation count."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help_, buckets=None):
+        super().__init__(name, help_)
+        self.bounds = tuple(sorted(buckets or _DEFAULT_BUCKETS_MS))
+
+    def _new_cell(self):
+        return {"counts": [0] * (len(self.bounds) + 1),
+                "sum": 0.0, "count": 0}
+
+    def observe(self, value, **labels):
+        key = _label_key(labels)
+        idx = bisect.bisect_left(self.bounds, value)
+        with self._lock:
+            cell = self._cells.get(key)
+            if cell is None:
+                cell = self._cells[key] = self._new_cell()
+            cell["counts"][idx] += 1
+            cell["sum"] += value
+            cell["count"] += 1
+
+    def _read_cell(self, cell):
+        return {"counts": list(cell["counts"]), "sum": cell["sum"],
+                "count": cell["count"]}
+
+    def render(self, lines):
+        lines.append(f"# TYPE {self.name} histogram")
+        with self._lock:
+            items = sorted((k, self._read_cell(v))
+                           for k, v in self._cells.items())
+        for key, cell in items:
+            cum = 0
+            for bound, n in zip(self.bounds, cell["counts"]):
+                cum += n
+                pairs = key + (("le", repr(float(bound))),)
+                lines.append(
+                    f"{self.name}_bucket{_fmt_labels(pairs)} {cum}")
+            pairs = key + (("le", "+Inf"),)
+            lines.append(
+                f"{self.name}_bucket{_fmt_labels(pairs)} "
+                f"{cell['count']}")
+            lines.append(
+                f"{self.name}_sum{_fmt_labels(key)} {cell['sum']}")
+            lines.append(
+                f"{self.name}_count{_fmt_labels(key)} {cell['count']}")
+
+
+class _View:
+    """One subsystem's registered live snapshot function."""
+
+    __slots__ = ("key", "fn", "prom_prefix", "omit_empty", "label_name")
+
+    def __init__(self, key, fn, prom_prefix, omit_empty, label_name):
+        self.key = key
+        self.fn = fn
+        self.prom_prefix = prom_prefix
+        self.omit_empty = omit_empty
+        self.label_name = label_name
+
+
+class MetricsRegistry:
+    """Process-wide metric + view table. One default instance
+    (`mxnet_tpu.telemetry.REGISTRY`) serves the whole framework."""
+
+    # the profiler's historical dump order — kept stable so the trace
+    # JSON's key sequence never churns across releases
+    LEGACY_ORDER = (
+        "execCacheStats", "servingStats", "hostSyncStats",
+        "inputPipelineStats", "graphPassStats",
+    )
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = {}
+        self._views = {}
+
+    # ------------------------------------------------- native metrics
+    def _get_or_create(self, cls, name, help_, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help_, **kw)
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}")
+            return m
+
+    def counter(self, name, help_=""):
+        return self._get_or_create(Counter, name, help_)
+
+    def gauge(self, name, help_=""):
+        return self._get_or_create(Gauge, name, help_)
+
+    def histogram(self, name, help_="", buckets=None):
+        return self._get_or_create(Histogram, name, help_,
+                                   buckets=buckets)
+
+    def metrics_snapshot(self):
+        """{name: {rendered-label-string: value}} of native metrics."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out = {}
+        for m in metrics:
+            out[m.name] = {
+                _fmt_labels(key) or "{}": val
+                for key, val in sorted(m.snapshot().items())
+            }
+        return out
+
+    # ---------------------------------------------------------- views
+    def register_view(self, key, fn, prom_prefix=None, omit_empty=False,
+                      label_name=None):
+        """Register a subsystem snapshot function as a live view.
+
+        `key` is the legacy dump_profile key (e.g. "execCacheStats");
+        `prom_prefix` names the flattened Prometheus series
+        (mxnet_tpu_<prefix>_<field>); `label_name` declares that the
+        snapshot's top level is a {instance: {field: value}} map whose
+        keys become that label (the servingStats shape); `omit_empty`
+        drops a falsy snapshot from dumps (servingStats with no models
+        loaded). Re-registration replaces (module reloads in tests)."""
+        with self._lock:
+            self._views[key] = _View(
+                key, fn, prom_prefix or _sanitize(key), omit_empty,
+                label_name)
+
+    def has_view(self, key):
+        with self._lock:
+            return key in self._views
+
+    def view_snapshot(self, key):
+        with self._lock:
+            view = self._views.get(key)
+        if view is None:
+            raise KeyError(f"no telemetry view registered for {key!r}")
+        return view.fn()
+
+    def view_items(self, legacy_first=True):
+        """[(key, snapshot)] for every registered view, honoring
+        omit_empty; legacy keys first in their historical order. A view
+        whose snapshot function raises is skipped (a silo must never
+        take observability down)."""
+        with self._lock:
+            views = dict(self._views)
+        order = [k for k in self.LEGACY_ORDER if k in views]
+        order += [k for k in views if k not in self.LEGACY_ORDER]
+        out = []
+        for key in order:
+            view = views[key]
+            try:
+                snap = view.fn()
+            except Exception:
+                continue
+            if view.omit_empty and not snap:
+                continue
+            out.append((key, snap))
+        return out
+
+    # ------------------------------------------------------ rendering
+    def prometheus_text(self):
+        """The whole registry in Prometheus text exposition format:
+        native instruments with their true types, view snapshots
+        flattened to gauges (numeric leaves only)."""
+        lines = []
+        with self._lock:
+            metrics = [self._metrics[k] for k in sorted(self._metrics)]
+            views = dict(self._views)
+        for m in metrics:
+            m.render(lines)
+        for key in sorted(views):
+            view = views[key]
+            try:
+                snap = view.fn()
+            except Exception:
+                continue
+            self._render_view(lines, view, snap)
+        return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _render_view(lines, view, snap):
+        base = "mxnet_tpu_" + _sanitize(view.prom_prefix)
+        if not isinstance(snap, dict):
+            return
+
+        def emit(name, pairs, value):
+            if isinstance(value, bool):
+                value = int(value)
+            if isinstance(value, (int, float)):
+                lines.append(f"{name}{_fmt_labels(pairs)} {value}")
+
+        if view.label_name:
+            # {instance: {field: value}} — instances become a label.
+            # View samples carry no TYPE line (untyped is valid
+            # exposition format; one TYPE would misname the family).
+            for inst, fields in sorted(snap.items()):
+                if not isinstance(fields, dict):
+                    continue
+                for field, value in sorted(fields.items()):
+                    if value is None:
+                        continue
+                    emit(f"{base}_{_sanitize(field)}",
+                         ((view.label_name, inst),), value)
+            return
+        for field, value in sorted(snap.items()):
+            if value is None:
+                continue
+            if isinstance(value, dict):
+                # one nested level ({pass: micros}) -> a "key" label
+                for sub, subval in sorted(value.items()):
+                    emit(f"{base}_{_sanitize(field)}",
+                         (("key", sub),), subval)
+            else:
+                emit(f"{base}_{_sanitize(field)}", (), value)
+
+
+#: the process-wide default registry every silo registers into
+REGISTRY = MetricsRegistry()
+
+counter = REGISTRY.counter
+gauge = REGISTRY.gauge
+histogram = REGISTRY.histogram
+register_view = REGISTRY.register_view
+has_view = REGISTRY.has_view
+view_snapshot = REGISTRY.view_snapshot
+view_items = REGISTRY.view_items
+prometheus_text = REGISTRY.prometheus_text

@@ -263,3 +263,29 @@ register_env(
     "resume case). Fires once, then the resumed run proceeds "
     "(mxnet_tpu.fault.FaultInjector).",
 )
+register_env(
+    "MXNET_TELEMETRY_PORT", str, "",
+    "telemetry: set to a TCP port to start the in-process HTTP "
+    "exporter (mxnet_tpu.telemetry.http) answering /metrics "
+    "(Prometheus text), /statusz (JSON snapshot of every registered "
+    "subsystem), and /healthz. Attached by serving.ModelServer and "
+    "Module.fit; '0' binds an ephemeral port (the chosen port is in "
+    "telemetry.http.exporter_port()). Unset = no server, zero "
+    "overhead (docs/observability.md).",
+)
+register_env(
+    "MXNET_TELEMETRY_SPANS", int, 2048,
+    "telemetry: capacity of the always-on structured-trace ring "
+    "buffer (spans retained for /statusz, flight records, and "
+    "spans_for_trace correlation). 0 disables span recording "
+    "entirely — record_span returns before constructing the Span "
+    "(the overhead A/B arm of ci/check_telemetry.py).",
+)
+register_env(
+    "MXNET_TELEMETRY_FLIGHT_DIR", str, "",
+    "telemetry: directory the flight recorder writes crash dumps "
+    "into (last-N spans + full metrics/stats snapshot as JSON, "
+    "atomic tmp+rename). Dumps fire on unhandled exceptions (sys/"
+    "threading excepthook) and on fault.FaultInjector trips. Unset "
+    "= flight recording off (docs/observability.md).",
+)

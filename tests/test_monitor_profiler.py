@@ -59,6 +59,132 @@ def test_profiler_chrome_trace(tmp_path):
     assert any("executor_forward" in n for n in names)
 
 
+def test_collect_device_events_rebase(tmp_path):
+    """_collect_device_events on a synthetic jax-style capture: every
+    device pid is offset by 1000 (separate process lanes next to the
+    host's pid 0) and every ts is re-based by trace_t0_us onto the
+    host timeline — proven here without a real XLA capture."""
+    import gzip
+
+    from mxnet_tpu import profiler
+
+    run_dir = tmp_path / "plugins" / "profile" / "run1"
+    run_dir.mkdir(parents=True)
+    device = {"traceEvents": [
+        {"name": "fusion", "pid": 2, "tid": 1, "ph": "X",
+         "ts": 10.0, "dur": 5.0},
+        {"name": "copy", "pid": 3, "tid": 0, "ph": "X",
+         "ts": 20.5, "dur": 1.0},
+        # metadata event without ts/pid-int must pass through intact
+        {"name": "process_name", "ph": "M", "pid": "meta"},
+    ]}
+    with gzip.open(str(run_dir / "host.trace.json.gz"), "wt") as f:
+        json.dump(device, f)
+
+    old_base = profiler._state.get("trace_t0_us")
+    profiler._state["trace_t0_us"] = 1000.0
+    try:
+        out = profiler._collect_device_events(str(tmp_path))
+    finally:
+        if old_base is None:
+            profiler._state.pop("trace_t0_us", None)
+        else:
+            profiler._state["trace_t0_us"] = old_base
+
+    by_name = {e["name"]: e for e in out}
+    assert by_name["fusion"]["pid"] == 1002   # 2 + 1000
+    assert by_name["copy"]["pid"] == 1003
+    assert by_name["fusion"]["ts"] == 1010.0  # 10 + trace_t0_us
+    assert by_name["copy"]["ts"] == 1020.5
+    # non-numeric pid / missing ts untouched
+    assert by_name["process_name"]["pid"] == "meta"
+    assert "ts" not in by_name["process_name"]
+
+
+def test_collect_device_events_empty_dir(tmp_path):
+    from mxnet_tpu import profiler
+
+    assert profiler._collect_device_events(str(tmp_path)) == []
+
+
+def test_dump_profile_keeps_events_on_write_failure(tmp_path):
+    """A failed dump must neither drop the buffered events nor leave a
+    torn file: the write goes through tmp + os.replace and the buffer
+    is cleared only after the rename succeeded."""
+    ok = str(tmp_path / "ok.json")
+    mx.profiler.profiler_set_config(filename=ok)
+    mx.profiler.profiler_set_state("run")
+    with mx.profiler.scope("durable-region"):
+        pass
+    mx.profiler._state["running"] = False  # no auto-dump via stop
+    bad_dir = str(tmp_path / "missing-dir" / "x.json")
+    mx.profiler.profiler_set_config(filename=bad_dir)
+    try:
+        mx.profiler.dump_profile()
+        raise AssertionError("dump into a missing dir must raise")
+    except OSError:
+        pass
+    # no tmp litter from the failed attempt
+    assert not list((tmp_path / "missing-dir").parent.glob("*.tmp.*"))
+    mx.profiler.profiler_set_config(filename=ok)
+    mx.profiler.dump_profile()
+    with open(ok) as f:
+        names = {e["name"] for e in json.load(f)["traceEvents"]}
+    assert "durable-region" in names
+
+
+def test_scope_latches_record_decision(tmp_path):
+    """A region that began while the profiler was running is recorded
+    even when collection stops before __exit__ (the old behavior
+    silently dropped it); symmetrically a region opened before 'run'
+    stays out of the profile."""
+    fn = str(tmp_path / "latch.json")
+    mx.profiler.profiler_set_config(filename=fn)
+
+    # opened before run -> stays out even though running at exit
+    pre = mx.profiler.scope("born-too-early")
+    pre.__enter__()
+    mx.profiler.profiler_set_state("run")
+    pre.__exit__(None, None, None)
+
+    # opened during run, profiler stopped mid-region -> recorded
+    mid = mx.profiler.scope("born-during-run")
+    mid.__enter__()
+    mx.profiler._state["running"] = False
+    mid.__exit__(None, None, None)
+
+    mx.profiler._state["running"] = True
+    mx.profiler.profiler_set_state("stop")
+    with open(fn) as f:
+        names = {e["name"] for e in json.load(f)["traceEvents"]}
+    assert "born-during-run" in names
+    assert "born-too-early" not in names
+
+
+def test_stop_without_run_is_noop(tmp_path, monkeypatch):
+    """profiler_set_state('stop') in a process where collection never
+    ran must not write a profile file (defensive stop() calls were
+    polluting the cwd with empty profile.json)."""
+    import subprocess
+    import sys
+
+    code = (
+        "import mxnet_tpu as mx\n"
+        "out = mx.profiler.profiler_set_state('stop')\n"
+        "assert out is None, out\n"
+        "import os\n"
+        "assert not os.path.exists('profile.json')\n"
+    )
+    repo = os.path.dirname(os.path.dirname(
+        os.path.abspath(mx.__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=str(tmp_path), env=env,
+        capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stderr
+
+
 def test_profiler_merges_device_trace(tmp_path, monkeypatch):
     """With a device capture enabled, the dumped Chrome trace must be
     ONE file holding both host events (pid 0) and the XLA device

@@ -30,6 +30,8 @@ MODULES = [
     ("mxnet_tpu.callback", "fit callbacks"),
     ("mxnet_tpu.monitor", "per-tensor training monitor"),
     ("mxnet_tpu.profiler", "host+device tracing"),
+    ("mxnet_tpu.telemetry",
+     "metrics registry + span tracing + live endpoints"),
     ("mxnet_tpu.rnn", "RNN cells + bucketing IO"),
     ("mxnet_tpu.operator", "Python custom ops"),
     ("mxnet_tpu.rtc", "runtime Pallas kernels"),

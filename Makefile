@@ -11,7 +11,7 @@ XLA8    := XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: all test nightly examples lint lint-check libs predict perl \
 	docs dryrun cache-check serving-check sync-check data-check \
-	passes-check telemetry-check clean
+	passes-check telemetry-check decode-check clean
 
 all: libs test
 
@@ -98,6 +98,14 @@ passes-check:
 # on an injected fault)
 telemetry-check:
 	$(CPUENV) bash ci/check_telemetry.sh
+
+# decode tier: test suite + runtime gates (zero retraces over a
+# >=64-step continuous decode with mid-stream admission/eviction/
+# preemption, greedy parity vs an unbatched reference loop, page-pool
+# exhaustion preempts instead of crashing) + paged-vs-rectangular
+# KV-memory bench gate
+decode-check:
+	$(CPUENV) bash ci/check_decode.sh
 
 # multi-chip sharding dryrun (DP / SP+TP / PP / EP) on 8 virtual devices
 dryrun:

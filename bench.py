@@ -538,6 +538,84 @@ def _passes_bench(platform):
     })
 
 
+def _decode_bench(platform):
+    """BENCH_MODE=decode: continuous-batching autoregressive serving.
+
+    Ragged prompt traffic through decoding.DecodedModel (paged KV
+    cache, per-step admission/eviction) measured as prefill and decode
+    tokens/s, KV-page occupancy, and KV-memory padding waste versus
+    the rectangular (batch, max_context) cache a one-shot batcher
+    would pin per request. Gate (ci/check_decode.sh): zero retraces
+    in steady state and paged waste strictly below rectangular."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import decoding as dec
+
+    n_requests = int(os.environ.get("BENCH_DECODE_REQUESTS", "48"))
+    max_new = int(os.environ.get("BENCH_DECODE_MAX_NEW", "16"))
+    page_size = 8
+    cfg = dec.DecoderConfig(vocab=128, d_model=64, n_layers=2,
+                            n_heads=4, d_ff=128, max_len=256)
+    params = dec.init_decoder_params(cfg, seed=0)
+    model = dec.DecodedModel(
+        "bench", 1, params, cfg, max_batch=8, page_size=page_size,
+        num_pages=128, page_buckets=(1, 2, 4, 8),
+        queue_cap=max(256, n_requests), max_tokens=max_new)
+    floor = model.engine.traces()
+
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(2, cfg.vocab,
+                          size=int(rs.randint(4, 25))).tolist()
+               for _ in range(n_requests)]
+    t0 = time.perf_counter()
+    futs = [model.submit(p, max_new_tokens=max_new) for p in prompts]
+    outs = [f.result(600) for f in futs]
+    dt = time.perf_counter() - t0
+    traces_added = model.engine.traces() - floor
+    snap = model.stats.snapshot()
+
+    # KV-memory padding waste: what fraction of reserved cache slots
+    # never hold a real token. The one-shot batcher's KV story is a
+    # rectangular (request, max_context) buffer; the paged cache
+    # reserves whole pages, wasting at most page_size-1 slots per seq.
+    max_ctx = model.engine.max_context
+    ctx = [len(p) + len(o) for p, o in zip(prompts, outs)]
+    rect_slots = n_requests * max_ctx
+    paged_slots = sum(
+        dec.pages_needed(c, page_size) * page_size for c in ctx)
+    toks = sum(ctx)
+    peak_occ = (snap["pages_total"] - snap["free_low_watermark"]) \
+        / max(1, snap["pages_total"])
+    model.close()
+
+    _emit({
+        "metric": f"decode_throughput_{platform}"
+                  f"_b8_p{page_size}_n{n_requests}",
+        "value": snap["decode_tokens_per_s"],
+        "unit": "tok/s",
+        "prefill_tokens_per_s": snap["prefill_tokens_per_s"],
+        "decode_tokens_per_s": snap["decode_tokens_per_s"],
+        "requests_per_s": round(n_requests / dt, 2),
+        "steps": snap["steps"],
+        "decode_tokens": snap["decode_tokens"],
+        "prefill_tokens": snap["prefill_tokens"],
+        "p50_token_ms": snap["p50_token_ms"],
+        "p99_token_ms": snap["p99_token_ms"],
+        "preemptions": snap["preemptions"],
+        "kv_peak_occupancy": round(peak_occ, 4),
+        "padding_waste_paged": round(1 - toks / paged_slots, 4)
+        if paged_slots else 0.0,
+        "padding_waste_oneshot": round(1 - toks / rect_slots, 4)
+        if rect_slots else 0.0,
+        "traces_added": traces_added,
+        "traces_since_warmup": snap["traces_since_warmup"],
+        "requests": n_requests,
+        "telemetry": _telemetry_snapshot(),
+        "platform": platform,
+    })
+
+
 def main():
     # BENCH_XLA_FLAGS: extra XLA flags for A/B capture runs (e.g.
     # "--xla_tpu_enable_latency_hiding_scheduler=true"); appended
@@ -592,6 +670,8 @@ def main():
         return _input_bench(jax.devices()[0].platform)
     if os.environ.get("BENCH_MODE", "train") == "passes":
         return _passes_bench(jax.devices()[0].platform)
+    if os.environ.get("BENCH_MODE", "train") == "decode":
+        return _decode_bench(jax.devices()[0].platform)
 
     import jax.numpy as jnp
     import numpy as np

@@ -63,6 +63,7 @@ from . import rtc
 from . import predictor
 from .predictor import Predictor
 from . import serving
+from . import decoding
 from . import module
 from . import module as mod
 from . import parallel

@@ -78,6 +78,27 @@ HOT_PATH_MANIFEST = {
     "mxnet_tpu/telemetry/http.py": (
         "TelemetryHandler.do_GET", "statusz",
     ),
+    # continuous-decode step loop + allocator (PR 8): the scheduler
+    # runs admission/growth/step every token for every live sequence;
+    # the only sanctioned syncs are the engine's np.asarray token
+    # fetches (one per prefill, one per step — EOS/stream need them)
+    "mxnet_tpu/decoding/blocks.py": "*",
+    "mxnet_tpu/decoding/engine.py": (
+        "DecodeEngine.prefill", "DecodeEngine.step",
+        "DecodeEngine.copy_page", "DecodeEngine.pool_stats",
+    ),
+    "mxnet_tpu/decoding/scheduler.py": (
+        "ContinuousScheduler._admit", "ContinuousScheduler._grow",
+        "ContinuousScheduler._step", "ContinuousScheduler._preempt",
+        "ContinuousScheduler._reclaim_one",
+        "ContinuousScheduler._check_deadlines",
+        "ContinuousScheduler._handle_token",
+        "ContinuousScheduler._resolve",
+    ),
+    "mxnet_tpu/decoding/stats.py": (
+        "DecodeStats.note_step", "DecodeStats.note_prefill",
+        "DecodeStats.note_preempted", "DecodeStats.note_pool",
+    ),
 }
 
 # Methods that force a host<->device round-trip (MX001).

@@ -1,0 +1,52 @@
+"""mxnet_tpu.decoding — continuous-batching autoregressive serving
+over a paged, ragged KV cache.
+
+The serving tier (mxnet_tpu.serving) batches ONE forward per request;
+autoregressive decoding needs hundreds of dependent steps per request,
+and naive batching staircases every sequence to the longest one. This
+package applies the Ragged Paged Attention recipe (PAPERS.md) instead:
+
+  blocks     free-list page allocator + per-sequence page tables with
+             refcounts (prefix sharing, copy-on-write fork)
+  attention  page-table attention kernels: gather-based lax reference
+             and a scalar-prefetch Pallas flash kernel
+             (MXNET_DECODE_KERNEL=lax|pallas)
+  model      the decoder contract: reference / prefill / decode-step
+             forwards over one flat params dict
+  engine     DecodeEngine — owns the device page pool and a pre-traced
+             fixed-shape program grid (zero steady-state retraces)
+  scheduler  ContinuousScheduler + DecodedModel — per-step admission,
+             eviction, priority preemption, streaming DecodeFuture
+  stats      DecodeStats -> `decodingStats` view (profiler dumps,
+             /metrics, /statusz)
+
+    from mxnet_tpu import serving
+    server = serving.ModelServer()
+    dec = server.load_decoder("lm", params, cfg)        # warmed
+    fut = server.submit_decode("lm", prompt_tokens)     # DecodeFuture
+    for tok in fut.stream(): ...                        # per-step
+    toks = server.generate("lm", prompt_tokens)         # sync
+
+Knobs: MXNET_DECODE_* (docs/env_vars.md). Guide: docs/serving.md
+("Continuous decoding").
+"""
+from . import attention, blocks, config, engine, model, scheduler, \
+    stats
+from .blocks import (SCRATCH_PAGE, BlockAllocator, PageError,
+                     PagePoolExhausted, pages_needed)
+from .attention import (get_kernel, paged_attention_lax,
+                        paged_attention_pallas)
+from .engine import DecodeEngine
+from .model import DecoderConfig, init_decoder_params, reference_logits
+from .scheduler import ContinuousScheduler, DecodeFuture, DecodedModel
+from .stats import DecodeStats, decoding_stats, reset_decoding_stats
+
+__all__ = [
+    "BlockAllocator", "ContinuousScheduler", "DecodeEngine",
+    "DecodeFuture", "DecodeStats", "DecodedModel", "DecoderConfig",
+    "PageError", "PagePoolExhausted", "SCRATCH_PAGE", "attention",
+    "blocks", "config", "decoding_stats", "engine", "get_kernel",
+    "init_decoder_params", "model", "paged_attention_lax",
+    "paged_attention_pallas", "pages_needed", "reference_logits",
+    "reset_decoding_stats", "scheduler", "stats",
+]

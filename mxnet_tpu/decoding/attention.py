@@ -1,0 +1,176 @@
+"""Page-table attention: one decode step's attention over the paged
+KV pool (the device half of Ragged Paged Attention, PAPERS.md).
+
+Contract shared by both kernels:
+
+  q           (B, H, D)        one query token per batch row
+  k_pages     (N, P, H, D)     the pool (one layer's K pages)
+  v_pages     (N, P, H, D)     the pool (one layer's V pages)
+  page_table  (B, Bp) int32    per-row page ids, seq-ordered; padding
+                               entries point at the scratch page 0
+  lengths     (B,) int32       valid context tokens per row (masking;
+                               rows beyond their length never read
+                               foreign/stale page contents)
+  -> out      (B, H, D)
+
+Every shape is a function of (max_batch, pages_bucket) only — never of
+actual sequence lengths — so the engine pre-traces one program per
+pages bucket and steady-state decode provably adds zero traces.
+
+Two implementations behind `MXNET_DECODE_KERNEL`:
+
+  lax     (default) gather the Bp pages per row into a contiguous
+          (B, Bp*P, H, D) context and run masked softmax attention —
+          pure lax, runs anywhere, XLA fuses the gather.
+  pallas  flash-style online-softmax kernel on a (B, Bp) grid whose
+          K/V block index maps read the page table via scalar
+          prefetch (PrefetchScalarGridSpec) — pages stream HBM->VMEM
+          per grid step instead of materializing the gathered
+          context. Interpret-mode on CPU, compiled on TPU.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _check_shapes(q, k_pages, v_pages, page_table, lengths):
+    b, h, d = q.shape
+    n, p, hh, dd = k_pages.shape
+    if k_pages.shape != v_pages.shape:
+        raise ValueError("k_pages/v_pages shape mismatch")
+    if (hh, dd) != (h, d):
+        raise ValueError(
+            f"pool heads/dim {(hh, dd)} != query {(h, d)}")
+    if page_table.shape[0] != b or lengths.shape != (b,):
+        raise ValueError("page_table/lengths batch mismatch")
+    return b, h, d, n, p, page_table.shape[1]
+
+
+def paged_attention_lax(q, k_pages, v_pages, page_table, lengths,
+                        scale=None):
+    """Gather-based reference kernel (see module docstring)."""
+    b, h, d, _, p, bp = _check_shapes(
+        q, k_pages, v_pages, page_table, lengths)
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    t = bp * p
+    # (B, Bp, P, H, D) -> (B, T, H, D): pages are seq-ordered, so the
+    # flattened axis IS the token axis (positions >= length masked)
+    k_ctx = k_pages[page_table].reshape(b, t, h, d)
+    v_ctx = v_pages[page_table].reshape(b, t, h, d)
+    s = jnp.einsum("bhd,bthd->bht", q, k_ctx,
+                   preferred_element_type=jnp.float32) * scale
+    mask = jnp.arange(t)[None, :] < lengths[:, None]
+    s = jnp.where(mask[:, None, :], s, NEG_INF)
+    m = s.max(axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    w = e / e.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bht,bthd->bhd", w, v_ctx,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------- pallas
+def _paged_attn_kernel(page_size):
+    """Kernel body on a (B, Bp) grid: one (page, row) tile per step,
+    online-softmax accumulated in VMEM scratch across the Bp axis."""
+    from jax.experimental import pallas as pl
+
+    def kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+               acc_ref, m_ref, l_ref):
+        i = pl.program_id(1)
+        nbp = pl.num_programs(1)
+        b = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _init():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+            m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+            l_ref[...] = jnp.zeros_like(l_ref)
+
+        qb = q_ref[0].astype(jnp.float32)          # (H, D)
+        kb = k_ref[0].astype(jnp.float32)          # (P, H, D)
+        vb = v_ref[0].astype(jnp.float32)
+        scale = 1.0 / math.sqrt(qb.shape[-1])
+        s = jnp.einsum("hd,phd->hp", qb, kb) * scale   # (H, P)
+        pos = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        valid = pos < len_ref[b]
+        s = jnp.where(valid, s, NEG_INF)
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        e = jnp.exp(s - m_new)                          # (H, P)
+        l_new = l_prev * corr + e.sum(axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jnp.einsum(
+            "hp,phd->hd", e, vb)
+        m_ref[...] = m_new
+        l_ref[...] = l_new
+
+        @pl.when(i == nbp - 1)
+        def _flush():
+            o_ref[0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+
+    return kernel
+
+
+def paged_attention_pallas(q, k_pages, v_pages, page_table, lengths,
+                           scale=None):
+    """Flash-style paged kernel; page ids drive the K/V block index
+    maps through scalar prefetch, so only the pages a row actually
+    owns ever move HBM->VMEM."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, d, _, p, bp = _check_shapes(
+        q, k_pages, v_pages, page_table, lengths)
+    if scale is not None and not math.isclose(
+            scale, 1.0 / math.sqrt(d)):
+        raise ValueError(
+            "pallas kernel hard-codes scale=1/sqrt(head_dim)")
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,   # page_table, lengths
+        grid=(b, bp),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda bb, i, pt, ln: (bb, 0, 0)),
+            pl.BlockSpec((1, p, h, d),
+                         lambda bb, i, pt, ln: (pt[bb, i], 0, 0, 0)),
+            pl.BlockSpec((1, p, h, d),
+                         lambda bb, i, pt, ln: (pt[bb, i], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, h, d), lambda bb, i, pt, ln: (bb, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, d), jnp.float32),
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, 1), jnp.float32),
+        ],
+    )
+    fn = pl.pallas_call(
+        _paged_attn_kernel(p),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        interpret=jax.default_backend() == "cpu",
+    )
+    return fn(page_table, lengths, q, k_pages, v_pages)
+
+
+_KERNELS = {
+    "lax": paged_attention_lax,
+    "pallas": paged_attention_pallas,
+}
+
+
+def get_kernel(name):
+    """Resolve MXNET_DECODE_KERNEL to an implementation."""
+    try:
+        return _KERNELS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown MXNET_DECODE_KERNEL {name!r} "
+            f"(choices: {sorted(_KERNELS)})") from None

@@ -1,0 +1,54 @@
+"""Env-knob resolution for the decode tier (registered in
+mxnet_tpu.utils so `describe_env()`/docs/env_vars.md cover them).
+
+Resolution order everywhere: explicit constructor argument > MXNET_*
+env var > built-in default (the serving/config.py convention).
+"""
+from __future__ import annotations
+
+from .. import utils
+from ..serving.batcher import _parse_buckets
+
+
+def page_size():
+    return utils.getenv("MXNET_DECODE_PAGE_SIZE")
+
+
+def num_pages():
+    return utils.getenv("MXNET_DECODE_PAGES")
+
+
+def max_batch():
+    return utils.getenv("MXNET_DECODE_MAX_BATCH")
+
+
+def page_buckets():
+    raw = utils.getenv("MXNET_DECODE_PAGE_BUCKETS")
+    return _parse_buckets(raw) if raw else None
+
+
+def kernel():
+    return utils.getenv("MXNET_DECODE_KERNEL")
+
+
+def ring_prefill():
+    return utils.getenv("MXNET_DECODE_RING_PREFILL")
+
+
+def max_tokens():
+    return utils.getenv("MXNET_DECODE_MAX_TOKENS")
+
+
+def queue_cap():
+    return utils.getenv("MXNET_DECODE_QUEUE_CAP")
+
+
+def default_page_buckets(max_pages_per_seq):
+    """Powers of two up to max_pages_per_seq (inclusive): each bucket
+    is one compiled decode program, so the grid stays logarithmic."""
+    out, b = [], 1
+    while b < max_pages_per_seq:
+        out.append(b)
+        b *= 2
+    out.append(max_pages_per_seq)
+    return tuple(out)

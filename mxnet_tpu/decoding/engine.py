@@ -1,0 +1,247 @@
+"""DecodeEngine: the device half of continuous batching.
+
+Owns the paged KV pool (one pre-allocated (layers, pages, page_size,
+heads, head_dim) buffer per K and V), the block allocator over it, and
+a FIXED grid of jitted programs:
+
+  prefill  one program per prompt length bucket (batch 1, dense causal
+           attention — optionally ring attention for long buckets —
+           that scatters K/V into the sequence's pages)
+  decode   one program per pages-per-sequence bucket; the step shape
+           is a function ONLY of (max_batch, bucket) — never of real
+           lengths or batch composition — so `warmup()` pre-traces the
+           full grid and steady-state decode adds zero traces
+  copy     one page-copy program (copy-on-write fork support)
+
+Trace accounting: every impl body bumps a python-side counter as its
+first statement. Python runs at TRACE time only, so the counter counts
+traces, not calls — `traces()` after `warmup()` is the decode tier's
+`traces_since_warmup` evidence (the PR 2 discipline, extended to a
+workload exec_cache never sees because decode jits are raw jax.jit).
+
+The engine is NOT thread-safe: exactly one scheduler thread drives it
+(the serving-lane convention — an Executor is single-threaded too).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..serving.batcher import pick_bucket
+from . import config as _cfg
+from . import attention as _attn
+from . import model as _model
+from .blocks import SCRATCH_PAGE, BlockAllocator, PageError, \
+    pages_needed
+
+
+class DecodeEngine:
+    def __init__(self, params, cfg, *, max_batch=None, page_size=None,
+                 num_pages=None, page_buckets=None, kernel=None,
+                 ring_prefill=None):
+        self.cfg = cfg
+        self.max_batch = max_batch if max_batch is not None \
+            else _cfg.max_batch()
+        self.page_size = page_size if page_size is not None \
+            else _cfg.page_size()
+        self.num_pages = num_pages if num_pages is not None \
+            else _cfg.num_pages()
+        if page_buckets is None:
+            page_buckets = _cfg.page_buckets()
+        if page_buckets is None:
+            # a sequence can never own more pages than the pool (or
+            # than max_len covers) — cap the default grid there
+            cap = min(self.num_pages - 1,
+                      cfg.max_len // self.page_size)
+            page_buckets = _cfg.default_page_buckets(max(1, cap))
+        self.page_buckets = tuple(sorted(set(int(b)
+                                             for b in page_buckets)))
+        self.kernel_name = kernel if kernel is not None \
+            else _cfg.kernel()
+        self.ring_prefill = ring_prefill if ring_prefill is not None \
+            else _cfg.ring_prefill()
+        if self.page_buckets[-1] * self.page_size > cfg.max_len:
+            raise PageError(
+                f"largest page bucket {self.page_buckets[-1]} x "
+                f"page_size {self.page_size} exceeds the model's "
+                f"max_len {cfg.max_len}")
+        if self.page_buckets[-1] > self.num_pages - 1:
+            raise PageError(
+                f"page bucket {self.page_buckets[-1]} exceeds pool "
+                f"capacity {self.num_pages - 1}")
+
+        self.allocator = BlockAllocator(self.num_pages, self.page_size)
+        self._attn = _attn.get_kernel(self.kernel_name)
+        self._params = jax.tree_util.tree_map(jnp.asarray, dict(params))
+        shape = (cfg.n_layers, self.num_pages, self.page_size,
+                 cfg.n_heads, cfg.head_dim)
+        self._k = jnp.zeros(shape, jnp.float32)
+        self._v = jnp.zeros(shape, jnp.float32)
+        # donation lets XLA update the pool in place; CPU falls back
+        # with a warning, so only donate where it pays
+        self._donate = jax.default_backend() != "cpu"
+        self._decode_fns = {}
+        self._prefill_fns = {}
+        self._copy_fn = None
+        self._trace_counts = {}
+        self._warm = False
+
+    # ------------------------------------------------------ properties
+    @property
+    def max_context(self):
+        """Tokens the largest bucket covers — the hard length cap."""
+        return self.page_buckets[-1] * self.page_size
+
+    @property
+    def prefill_buckets(self):
+        """Prompt length buckets: one per page bucket (the decode
+        extension of the serving tier's MXNET_SERVING_LENGTH_BUCKETS
+        grid, derived instead of hand-configured)."""
+        return tuple(b * self.page_size for b in self.page_buckets)
+
+    def traces(self):
+        """Total prefill/decode/copy traces so far (see docstring)."""
+        return sum(self._trace_counts.values())
+
+    def trace_counts(self):
+        return dict(self._trace_counts)
+
+    def pool_stats(self):
+        st = self.allocator.stats()
+        return {
+            "pages_total": st["pages_total"],
+            "pages_free": st["pages_free"],
+            "kv_occupancy": round(
+                st["pages_in_use"] / max(1, st["pages_total"]), 4),
+            "free_low_watermark": st["free_low_watermark"],
+        }
+
+    def _note_trace(self, name):
+        # first statement of every impl body: executes under tracing
+        # only, so this COUNTS TRACES (see module docstring)
+        self._trace_counts[name] = self._trace_counts.get(name, 0) + 1
+
+    # -------------------------------------------------------- builders
+    def _build_decode_fn(self, bucket):
+        cfg, attn = self.cfg, self._attn
+
+        def impl(params, tokens, k_pages, v_pages, page_table,
+                 lengths, active):
+            self._note_trace(f"decode@{bucket}")
+            return _model.decode_forward(
+                params, tokens, k_pages, v_pages, page_table,
+                lengths, active, cfg=cfg, attn=attn)
+
+        donate = (2, 3) if self._donate else ()
+        return jax.jit(impl, donate_argnums=donate)
+
+    def _build_prefill_fn(self, length_bucket):
+        cfg = self.cfg
+        attn_fn = None
+        if self.ring_prefill and length_bucket >= self.ring_prefill:
+            # NOTE: mxnet_tpu.parallel re-exports the ring_attention
+            # FUNCTION under the module's name; import the module by
+            # its full path
+            from ..parallel.ring_attention import (ring_attention,
+                                                   seq_mesh_for)
+
+            mesh = seq_mesh_for(length_bucket)
+
+            def attn_fn(q, k, v):
+                return ring_attention(q, k, v, mesh=mesh, causal=True)
+
+        def impl(params, tokens, length, k_pages, v_pages, page_ids):
+            self._note_trace(f"prefill@{length_bucket}")
+            return _model.prefill_forward(
+                params, tokens, length, k_pages, v_pages, page_ids,
+                cfg=cfg, attn_fn=attn_fn)
+
+        donate = (3, 4) if self._donate else ()
+        return jax.jit(impl, donate_argnums=donate)
+
+    def _build_copy_fn(self):
+        def impl(pool, src, dst):
+            self._note_trace("copy_page")
+            return pool.at[:, dst].set(pool[:, src])
+
+        donate = (0,) if self._donate else ()
+        return jax.jit(impl, donate_argnums=donate)
+
+    # ---------------------------------------------------------- warmup
+    def warmup(self):
+        """Pre-trace the full program grid: every prefill length
+        bucket, every decode pages bucket, and the page copy. All
+        writes of the dry runs land in the scratch page (lengths 0,
+        tables all-scratch), so the pool state is untouched except for
+        scratch garbage — which is never read unmasked. Idempotent."""
+        if self._warm:
+            return self
+        self._copy_fn = self._build_copy_fn()
+        self.copy_page(SCRATCH_PAGE, SCRATCH_PAGE)
+        for lb in self.prefill_buckets:
+            self._prefill_fns[lb] = self._build_prefill_fn(lb)
+            tokens = np.zeros((1, lb), np.int32)
+            page_ids = np.zeros((pages_needed(lb, self.page_size),),
+                                np.int32)
+            tok, self._k, self._v = self._prefill_fns[lb](
+                self._params, tokens, jnp.int32(0), self._k, self._v,
+                page_ids)
+            tok.block_until_ready()
+        for bucket in self.page_buckets:
+            self._decode_fns[bucket] = self._build_decode_fn(bucket)
+            b = self.max_batch
+            out, self._k, self._v = self._decode_fns[bucket](
+                self._params,
+                np.zeros((b,), np.int32), self._k, self._v,
+                np.zeros((b, bucket), np.int32),
+                np.zeros((b,), np.int32),
+                np.zeros((b,), bool))
+            out.block_until_ready()
+        self._warm = True
+        return self
+
+    # -------------------------------------------------------- hot path
+    def prefill(self, token_ids, table):
+        """Fill `table`'s pages with the prompt's K/V; returns the
+        first generated token (host int). `table` must already cover
+        pages_needed(len(token_ids))."""
+        n = len(token_ids)
+        lb = pick_bucket(n, self.prefill_buckets)
+        tokens = np.zeros((1, lb), np.int32)
+        tokens[0, :n] = token_ids
+        page_ids = np.full((pages_needed(lb, self.page_size),),
+                           SCRATCH_PAGE, np.int32)
+        page_ids[:len(table)] = table
+        tok, self._k, self._v = self._prefill_fns[lb](
+            self._params, tokens, jnp.int32(n), self._k, self._v,
+            page_ids)
+        # the sampled token must reach the host to stream/EOS-check —
+        # the one deliberate sync of the prefill path
+        return int(np.asarray(tok))
+
+    def step(self, tokens, page_table, lengths, active):
+        """One continuous-decode step. All arrays are the full
+        (max_batch, ...) fixed shapes; `page_table.shape[1]` must be a
+        configured bucket. Returns next tokens as a host (B,) array
+        (the stream/EOS sync — one fetch per step, by design)."""
+        bucket = page_table.shape[1]
+        fn = self._decode_fns[bucket]
+        out, self._k, self._v = fn(
+            self._params, tokens, self._k, self._v, page_table,
+            lengths, active)
+        return np.asarray(out)
+
+    def copy_page(self, src, dst):
+        """Device copy of one page (both pools): the COW half of
+        `BlockAllocator.make_writable`."""
+        src = jnp.int32(src)
+        dst = jnp.int32(dst)
+        self._k = self._copy_fn(self._k, src, dst)
+        self._v = self._copy_fn(self._v, src, dst)
+
+    # ----------------------------------------------------- test hooks
+    def read_page(self, layer, page):
+        """Host copy of one page's (K, V) — test/debug only."""
+        return (np.asarray(self._k[layer, page]),
+                np.asarray(self._v[layer, page]))

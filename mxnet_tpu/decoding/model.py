@@ -1,0 +1,208 @@
+"""A minimal autoregressive decoder with paged-KV decode semantics.
+
+The decode tier needs a model contract, not a model zoo: something
+with an embedding, a stack of attention+MLP blocks, and tied-logits
+output, expressed as THREE pure functions over one params dict —
+
+  reference_logits   dense causal forward over a whole (1, T) buffer
+                     (the unbatched reference arm of the parity gate)
+  prefill_forward    dense causal forward over a padded prompt that
+                     also SCATTERS per-layer K/V into the paged pool
+                     and returns the first generated token
+  decode_forward     one fixed-shape decode step: embed the last
+                     token of every row, append its K/V to the pool
+                     through the page table, attend over the pages,
+                     return each row's next greedy token
+
+All three share the same per-row arithmetic (row-invariant matmuls,
+length-masked softmax over seq-ordered pages), so a token decoded in
+a continuous batch is bit-identical to the same token decoded alone —
+the property ci/check_decode.py gates on.
+
+Weights live in a flat {name: array} dict (mx checkpoint idiom);
+`init_decoder_params` builds a seeded random one for tests/benches.
+Real checkpoints with matching names serve unchanged.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .blocks import SCRATCH_PAGE
+
+NEG_INF = -1e30
+
+
+@dataclass(frozen=True)
+class DecoderConfig:
+    """Architecture hyperparameters (static under jit)."""
+
+    vocab: int = 64
+    d_model: int = 32
+    n_layers: int = 2
+    n_heads: int = 2
+    d_ff: int = 64
+    max_len: int = 256
+    eos_id: int = 1
+
+    @property
+    def head_dim(self):
+        if self.d_model % self.n_heads:
+            raise ValueError("d_model must divide into n_heads")
+        return self.d_model // self.n_heads
+
+
+def init_decoder_params(cfg, seed=0):
+    """Seeded random weights (explicit generator: MX005-clean)."""
+    rs = np.random.RandomState(seed)
+
+    def w(*shape):
+        scale = 1.0 / math.sqrt(shape[0])
+        return (rs.uniform(-scale, scale, shape)).astype(np.float32)
+
+    params = {
+        "embed": w(cfg.vocab, cfg.d_model),
+        "pos": w(cfg.max_len, cfg.d_model) * 0.1,
+        "ln_f": np.ones((cfg.d_model,), np.float32),
+    }
+    for i in range(cfg.n_layers):
+        params[f"l{i}.ln1"] = np.ones((cfg.d_model,), np.float32)
+        params[f"l{i}.ln2"] = np.ones((cfg.d_model,), np.float32)
+        for nm in ("wq", "wk", "wv", "wo"):
+            params[f"l{i}.{nm}"] = w(cfg.d_model, cfg.d_model)
+        params[f"l{i}.w1"] = w(cfg.d_model, cfg.d_ff)
+        params[f"l{i}.w2"] = w(cfg.d_ff, cfg.d_model)
+    return params
+
+
+def _rms(x, g):
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    return x * jax.lax.rsqrt(var + 1e-6) * g
+
+
+def _qkv(params, i, x, cfg):
+    """(..., D) -> q/k/v each (..., H, Dh)."""
+    h, dh = cfg.n_heads, cfg.head_dim
+    shape = x.shape[:-1] + (h, dh)
+    q = (x @ params[f"l{i}.wq"]).reshape(shape)
+    k = (x @ params[f"l{i}.wk"]).reshape(shape)
+    v = (x @ params[f"l{i}.wv"]).reshape(shape)
+    return q, k, v
+
+
+def _mlp(params, i, x):
+    return jax.nn.relu(x @ params[f"l{i}.w1"]) @ params[f"l{i}.w2"]
+
+
+def _dense_causal_attention(q, k, v, scale):
+    """(B, T, H, Dh) causal attention, fp32 softmax."""
+    t = q.shape[1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    causal = jnp.arange(t)[:, None] >= jnp.arange(t)[None, :]
+    s = jnp.where(causal[None, None], s, NEG_INF)
+    m = s.max(axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    w = e / e.sum(axis=-1, keepdims=True)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v,
+                      preferred_element_type=jnp.float32)
+
+
+# ------------------------------------------------------------- reference
+def reference_logits(params, tokens, cfg, attn_fn=None):
+    """Dense causal forward: tokens (B, T) int32 -> logits (B, T, V).
+
+    `attn_fn(q, k, v)` overrides the attention (the ring-attention
+    prefill path routes through here with a sharded implementation);
+    default is the in-process dense kernel.
+    """
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    b, t = tokens.shape
+    x = params["embed"][tokens] + params["pos"][:t][None]
+    for i in range(cfg.n_layers):
+        h1 = _rms(x, params[f"l{i}.ln1"])
+        q, k, v = _qkv(params, i, h1, cfg)
+        if attn_fn is None:
+            o = _dense_causal_attention(q, k, v, scale)
+        else:
+            o = attn_fn(q, k, v)
+        x = x + o.reshape(b, t, cfg.d_model) @ params[f"l{i}.wo"]
+        x = x + _mlp(params, i, _rms(x, params[f"l{i}.ln2"]))
+    x = _rms(x, params["ln_f"])
+    return x @ params["embed"].T
+
+
+# --------------------------------------------------------------- prefill
+def prefill_forward(params, tokens, length, k_pages, v_pages,
+                    page_ids, *, cfg, attn_fn=None):
+    """Prompt pass: tokens (1, Tb) padded to a length bucket, length
+    () int32 the true prompt length, page_ids (ceil(Tb/P),) int32 the
+    sequence's allocated pages (padded with scratch 0).
+
+    Scatters every layer's K/V for positions < length into the pool
+    (positions >= length land in the scratch page) and returns
+    (first_token (), k_pages, v_pages).
+    """
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    page_size = k_pages.shape[2]
+    _, t = tokens.shape
+    pos = jnp.arange(t)
+    # per-position scatter targets: (page, slot) through the table,
+    # scratch for the padded tail
+    tgt_pages = jnp.where(length > pos, page_ids[pos // page_size],
+                          SCRATCH_PAGE)
+    slots = pos % page_size
+
+    x = params["embed"][tokens] + params["pos"][:t][None]
+    for i in range(cfg.n_layers):
+        h1 = _rms(x, params[f"l{i}.ln1"])
+        q, k, v = _qkv(params, i, h1, cfg)
+        k_pages = k_pages.at[i, tgt_pages, slots].set(k[0])
+        v_pages = v_pages.at[i, tgt_pages, slots].set(v[0])
+        if attn_fn is None:
+            o = _dense_causal_attention(q, k, v, scale)
+        else:
+            o = attn_fn(q, k, v)
+        x = x + o.reshape(1, t, cfg.d_model) @ params[f"l{i}.wo"]
+        x = x + _mlp(params, i, _rms(x, params[f"l{i}.ln2"]))
+    x = _rms(x, params["ln_f"])
+    last = x[0, length - 1]
+    logits = last @ params["embed"].T
+    return jnp.argmax(logits).astype(jnp.int32), k_pages, v_pages
+
+
+# ---------------------------------------------------------------- decode
+def decode_forward(params, tokens, k_pages, v_pages, page_table,
+                   lengths, active, *, cfg, attn):
+    """One decode step over the full fixed-shape batch.
+
+    tokens (B,) int32 last emitted token per row; lengths (B,) tokens
+    already in cache; active (B,) bool. Inactive rows write to / read
+    from the scratch page and their outputs are ignored by the host.
+    Returns (next_tokens (B,), k_pages, v_pages).
+    """
+    page_size = k_pages.shape[2]
+    b = tokens.shape[0]
+    rows = jnp.arange(b)
+    w_pages = jnp.where(
+        active, page_table[rows, lengths // page_size], SCRATCH_PAGE)
+    slots = lengths % page_size
+    ctx_len = jnp.where(active, lengths + 1, 1)
+
+    x = params["embed"][tokens] + params["pos"][lengths]
+    for i in range(cfg.n_layers):
+        h1 = _rms(x, params[f"l{i}.ln1"])
+        q, k, v = _qkv(params, i, h1, cfg)
+        k_pages = k_pages.at[i, w_pages, slots].set(k)
+        v_pages = v_pages.at[i, w_pages, slots].set(v)
+        o = attn(q, k_pages[i], v_pages[i], page_table, ctx_len)
+        x = x + o.reshape(b, cfg.d_model) @ params[f"l{i}.wo"]
+        x = x + _mlp(params, i, _rms(x, params[f"l{i}.ln2"]))
+    x = _rms(x, params["ln_f"])
+    logits = x @ params["embed"].T
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), \
+        k_pages, v_pages

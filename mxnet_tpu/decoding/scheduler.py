@@ -1,0 +1,523 @@
+"""Continuous-batching scheduler: the control loop of the decode tier.
+
+One scheduler thread per decoder model drives a fixed-shape
+`DecodeEngine` step loop. Unlike the one-shot batcher (which forms a
+batch, runs it, and replies), the decode batch is a ROLLING set: every
+step the scheduler
+
+  1. resolves per-sequence deadlines (mid-generation, not just at
+     admission — a stuck client's sequence frees its pages promptly),
+  2. admits waiting requests into free batch rows (prefill: one
+     bucket-padded prompt pass that scatters K/V into fresh pages),
+  3. grows each live sequence's page table by one page when its next
+     token crosses a page boundary — preempting the lowest-priority
+     (ties: most recently admitted) sequence when the pool is
+     exhausted, never crashing (CI gate iii),
+  4. runs ONE fixed-shape decode step over the full (max_batch,
+     pages_bucket) grid and streams each live row's token out.
+
+Preemption drops a sequence's pages but keeps its token history; on
+readmission the scheduler re-prefills prompt + generated-so-far and
+the continuation is bit-identical to the uninterrupted run (the
+XLA-level prefix stability tests/test_decoding.py pins).
+
+Tokens reach callers through `DecodeFuture`: `result()` is the full
+generated list (the serving Future contract), `stream()` yields tokens
+as steps complete — cancellation-free backpressure is the consumer
+just not reading; the queue is per-request and bounded by max_tokens.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+
+import numpy as np
+
+from ..serving.batcher import (DeadlineExceededError, ServerBusyError,
+                               ServerClosedError, ServingError,
+                               pick_bucket)
+from ..telemetry import trace as _trace
+from . import config as _cfg
+from .blocks import SCRATCH_PAGE, PagePoolExhausted, pages_needed
+from .engine import DecodeEngine
+from .stats import DecodeStats
+
+_DONE = object()
+
+
+class DecodeFuture:
+    """Handle for one decode request: both a future and a stream.
+
+    `result(timeout)` blocks for the COMPLETE generated token list
+    (EOS excluded) or raises the request's failure. `stream(timeout)`
+    iterates tokens as the scheduler emits them — the first token
+    arrives right after prefill, the rest one per decode step — and
+    raises the failure mid-iteration if one lands. `finish_reason` is
+    "eos" | "max_tokens" | "length" after completion.
+    """
+
+    def __init__(self, trace_id=None):
+        self.trace_id = trace_id
+        self.finish_reason = None
+        self._q = queue.Queue()
+        self._done = threading.Event()
+        self._tokens = None
+        self._exc = None
+
+    # ---------------------------------------------- scheduler side
+    def _emit(self, tok):
+        self._q.put(int(tok))
+
+    def _finish(self, tokens, reason):
+        self.finish_reason = reason
+        self._tokens = list(tokens)
+        self._done.set()
+        self._q.put(_DONE)
+
+    def _fail(self, exc):
+        self._exc = exc
+        self._done.set()
+        self._q.put(exc)
+
+    # ------------------------------------------------- caller side
+    def done(self):
+        return self._done.is_set()
+
+    def result(self, timeout=None):
+        if not self._done.wait(timeout):
+            raise TimeoutError("decode request still running")
+        if self._exc is not None:
+            raise self._exc
+        return list(self._tokens)
+
+    def exception(self, timeout=None):
+        if not self._done.wait(timeout):
+            raise TimeoutError("decode request still running")
+        return self._exc
+
+    def stream(self, timeout=None):
+        """Yield generated tokens as they are produced."""
+        while True:
+            item = self._q.get(timeout=timeout)
+            if item is _DONE:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+
+
+class _Sequence:
+    """Scheduler-internal state of one in-flight request."""
+
+    __slots__ = ("prompt", "max_new", "priority", "deadline", "future",
+                 "trace_id", "order", "generated", "table", "length",
+                 "last_token", "preempted", "t_submit_pc")
+
+    def __init__(self, prompt, max_new, priority, deadline, future,
+                 trace_id, order):
+        self.prompt = list(prompt)
+        self.max_new = max_new
+        self.priority = priority
+        self.deadline = deadline       # absolute monotonic, or None
+        self.future = future
+        self.trace_id = trace_id
+        self.order = order             # admission tiebreak (FIFO)
+        self.generated = []
+        self.table = None              # page ids while active
+        self.length = 0                # tokens materialized in cache
+        self.last_token = -1
+        self.preempted = False
+        self.t_submit_pc = _trace.now()
+
+    def context_tokens(self):
+        """Tokens the KV cache must hold for this sequence: the prompt
+        plus everything generated EXCEPT the newest token (whose K/V
+        is appended by the next decode step)."""
+        return self.prompt + self.generated[:-1] \
+            if self.generated else list(self.prompt)
+
+
+class ContinuousScheduler:
+    """The rolling-batch control loop over one DecodeEngine."""
+
+    def __init__(self, engine, stats, key, queue_cap=None,
+                 max_tokens=None, eos_id=None):
+        self.engine = engine
+        self.stats = stats
+        self.key = key
+        self.queue_cap = queue_cap if queue_cap is not None \
+            else _cfg.queue_cap()
+        self.default_max_tokens = max_tokens if max_tokens is not None \
+            else _cfg.max_tokens()
+        self.eos_id = eos_id if eos_id is not None \
+            else engine.cfg.eos_id
+        self._cond = threading.Condition()
+        self._waiting = []
+        self._rows = [None] * engine.max_batch
+        self._order = itertools.count()
+        self._closed = False
+        self._drain = True
+        self._thread = None
+
+    # ------------------------------------------------------ public API
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, name=f"decoding-{self.key}", daemon=True)
+        self._thread.start()
+        return self
+
+    def depth(self):
+        """(waiting, active) — the stats view's queue-depth probe."""
+        with self._cond:
+            return (len(self._waiting),
+                    sum(1 for s in self._rows if s is not None))
+
+    def submit(self, prompt, max_new_tokens=None, priority=0,
+               deadline_ms=None):
+        """Enqueue one autoregressive request; returns a DecodeFuture.
+
+        `priority`: higher values survive page-pool pressure longer
+        (preemption victims are chosen lowest-priority-first).
+        `deadline_ms` is end-to-end and checked EVERY step, not only
+        at admission — a mid-generation miss resolves the future with
+        DeadlineExceededError and frees the sequence's pages.
+        """
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ServingError("empty prompt")
+        if any(t < 0 or t >= self.engine.cfg.vocab for t in prompt):
+            raise ServingError("prompt token outside vocab")
+        if len(prompt) > self.engine.max_context:
+            raise ServingError(
+                f"prompt of {len(prompt)} tokens exceeds the decode "
+                f"context capacity {self.engine.max_context}")
+        max_new = int(max_new_tokens) if max_new_tokens is not None \
+            else self.default_max_tokens
+        if max_new < 1:
+            raise ServingError("max_new_tokens must be >= 1")
+        tid = _trace.new_trace_id()
+        with _trace.span("decoding.submit", trace_id=tid,
+                         model=self.key):
+            deadline = (time.monotonic() + deadline_ms / 1e3
+                        if deadline_ms is not None else None)
+            fut = DecodeFuture(tid)
+            with self._cond:
+                if self._closed:
+                    raise ServerClosedError("decoder is shut down")
+                if len(self._waiting) >= self.queue_cap:
+                    self.stats.note_rejected()
+                    raise ServerBusyError(
+                        f"decode queue full ({self.queue_cap}); "
+                        "retry with backoff")
+                seq = _Sequence(prompt, max_new, int(priority),
+                                deadline, fut, tid, next(self._order))
+                self._waiting.append(seq)
+                self._cond.notify()
+        self.stats.note_submitted()
+        return fut
+
+    def stop(self, drain=True, timeout=30):
+        """Close admission; drain=True finishes in-flight sequences,
+        drain=False fails them fast with ServerClosedError."""
+        with self._cond:
+            self._closed = True
+            self._drain = drain
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+
+    # ---------------------------------------------------- loop helpers
+    def _active(self):
+        return [s for s in self._rows if s is not None]
+
+    def _resolve(self, seq, *, exc=None, reason=None):
+        """Terminal transition: free pages, clear the row, settle the
+        future exactly once."""
+        if seq.table is not None:
+            self.engine.allocator.free(seq.table)
+            seq.table = None
+        for row, s in enumerate(self._rows):
+            if s is seq:
+                self._rows[row] = None
+        if exc is not None:
+            seq.future._fail(exc)
+        else:
+            self.stats.note_completed()
+            seq.future._finish(seq.generated, reason)
+        _trace.record_span(
+            "decoding.reply", seq.trace_id, seq.t_submit_pc,
+            _trace.now(),
+            {"model": self.key,
+             "outcome": reason or type(exc).__name__,
+             "tokens": len(seq.generated)})
+
+    def _preempt(self, seq):
+        """Evict for pages: drop the sequence's pages but keep its
+        token history; it re-prefills on readmission (bit-identical
+        continuation — the XLA prefix-stability property)."""
+        if seq.table is not None:
+            self.engine.allocator.free(seq.table)
+            seq.table = None
+        for row, s in enumerate(self._rows):
+            if s is seq:
+                self._rows[row] = None
+        seq.preempted = True
+        with self._cond:
+            self._waiting.append(seq)
+        self.stats.note_preempted()
+
+    def _reclaim_one(self, requester):
+        """Free pages by preempting ONE victim: the lowest-priority
+        active sequence, ties broken most-recently-admitted-first.
+        The requester itself is a candidate (it may BE the lowest
+        priority). Returns the victim, or None when nothing is
+        preemptible."""
+        victims = self._active()
+        if requester is not None and requester.table is None:
+            # an admission candidate competes at its own priority
+            victims = [s for s in victims
+                       if (s.priority, -s.order)
+                       < (requester.priority, -requester.order)]
+        if not victims:
+            return None
+        victim = min(victims, key=lambda s: (s.priority, -s.order))
+        self._preempt(victim)
+        return victim
+
+    def _check_deadlines(self, now):
+        """Per-step deadline resolution for BOTH queued and active
+        sequences (the decode half of the serving deadline fix)."""
+        with self._cond:
+            expired = [s for s in self._waiting
+                       if s.deadline is not None and now > s.deadline]
+            for s in expired:
+                self._waiting.remove(s)
+        for s in self._active():
+            if s.deadline is not None and now > s.deadline:
+                expired.append(s)
+        for s in expired:
+            self.stats.note_expired()
+            self._resolve(s, exc=DeadlineExceededError(
+                f"deadline passed after {len(s.generated)} tokens"))
+
+    def _handle_token(self, seq, tok):
+        """Post-step bookkeeping for one live row's emitted token."""
+        if tok == self.eos_id:
+            self._resolve(seq, reason="eos")
+            return
+        seq.generated.append(tok)
+        seq.last_token = tok
+        seq.future._emit(tok)
+        if len(seq.generated) >= seq.max_new:
+            self._resolve(seq, reason="max_tokens")
+        elif seq.length >= self.engine.max_context:
+            # no page can hold the next position: capacity stop
+            self._resolve(seq, reason="length")
+
+    # -------------------------------------------------------- admission
+    def _admit(self):
+        """Fill free batch rows from the waiting queue in (priority,
+        FIFO) order. Admission prefers free pages but will preempt
+        strictly-lower-priority active sequences to make room."""
+        alloc = self.engine.allocator
+        while None in self._rows:
+            with self._cond:
+                if not self._waiting:
+                    return
+                seq = min(self._waiting,
+                          key=lambda s: (-s.priority, s.order))
+                self._waiting.remove(seq)
+            tokens = seq.context_tokens()
+            need = pages_needed(len(tokens), self.engine.page_size)
+            while alloc.free_pages() < need:
+                if self._reclaim_one(seq) is None:
+                    # nothing below this priority to evict: requeue
+                    # and stop admitting (pages may free up later)
+                    with self._cond:
+                        self._waiting.append(seq)
+                    return
+            seq.table = alloc.alloc(need)
+            row = self._rows.index(None)
+            self._rows[row] = seq
+            t0 = _trace.now()
+            first = self.engine.prefill(tokens, seq.table)
+            dt = _trace.now() - t0
+            self.stats.note_prefill(len(tokens), dt,
+                                    readmission=seq.preempted)
+            _trace.record_span(
+                "decoding.prefill", seq.trace_id, t0, t0 + dt,
+                {"model": self.key, "tokens": len(tokens),
+                 "pages": need, "readmission": seq.preempted})
+            seq.length = len(tokens)
+            if seq.preempted:
+                # the re-prefill's argmax reproduces the token already
+                # emitted (prefix stability); restore, don't re-emit
+                seq.preempted = False
+                seq.last_token = seq.generated[-1]
+            else:
+                self._handle_token(seq, int(first))
+
+    # ------------------------------------------------------------ growth
+    def _grow(self):
+        """Before each step, make every live row's write position
+        backed by an exclusively-owned page: allocate across page
+        boundaries (preempting under pressure) and break COW aliases
+        on the tail page."""
+        alloc = self.engine.allocator
+        for seq in self._active():
+            if seq.table is None:
+                continue
+            idx = seq.length // self.engine.page_size
+            if idx >= len(seq.table):
+                while True:
+                    try:
+                        seq.table.extend(alloc.alloc(1))
+                        break
+                    except PagePoolExhausted:
+                        victim = self._reclaim_one(None)
+                        if victim is None or victim is seq:
+                            break
+                if seq.table is None or idx >= len(seq.table):
+                    continue    # preempted itself; back in the queue
+            try:
+                page, copy_from = alloc.make_writable(seq.table, idx)
+            except PagePoolExhausted:
+                self._preempt(seq)
+                continue
+            if copy_from is not None:
+                self.engine.copy_page(copy_from, page)
+
+    # -------------------------------------------------------------- step
+    def _step(self):
+        engine = self.engine
+        live = [(row, s) for row, s in enumerate(self._rows)
+                if s is not None]
+        if not live:
+            return
+        b = engine.max_batch
+        span = max(pages_needed(s.length + 1, engine.page_size)
+                   for _, s in live)
+        bucket = pick_bucket(span, engine.page_buckets)
+        tokens = np.zeros((b,), np.int32)
+        table = np.full((b, bucket), SCRATCH_PAGE, np.int32)
+        lengths = np.zeros((b,), np.int32)
+        active = np.zeros((b,), bool)
+        for row, s in live:
+            tokens[row] = s.last_token
+            table[row, :len(s.table)] = s.table
+            lengths[row] = s.length
+            active[row] = True
+        t0 = _trace.now()
+        out = engine.step(tokens, table, lengths, active)
+        dt = _trace.now() - t0
+        self.stats.note_step(len(live), dt)
+        _trace.record_span(
+            "decoding.step", None, t0, t0 + dt,
+            {"trace_ids": tuple(s.trace_id for _, s in live),
+             "model": self.key, "live": len(live), "bucket": bucket})
+        for row, s in live:
+            s.length += 1
+            self._handle_token(s, int(out[row]))
+        self.stats.note_pool()
+
+    # -------------------------------------------------------------- loop
+    def _loop(self):
+        while True:
+            with self._cond:
+                while (not self._closed and not self._waiting
+                       and not any(self._rows)):
+                    # bounded wait so queued-only deadline expiry is
+                    # still timely under an idle engine
+                    self._cond.wait(0.05)
+                if self._closed:
+                    if not self._drain:
+                        doomed = self._waiting[:]
+                        self._waiting.clear()
+                    elif not self._waiting and not any(self._rows):
+                        return
+            if self._closed and not self._drain:
+                doomed.extend(self._active())
+                for s in doomed:
+                    self.stats.note_failed()
+                    self._resolve(s, exc=ServerClosedError(
+                        "decoder stopped"))
+                return
+            try:
+                self._check_deadlines(time.monotonic())
+                self._admit()
+                self._grow()
+                self._step()
+            except Exception as exc:  # never kill the loop silently
+                for s in self._active():
+                    self.stats.note_failed()
+                    self._resolve(s, exc=exc)
+
+
+class DecodedModel:
+    """One loaded decoder: engine + scheduler + stats (the decode-tier
+    sibling of registry.ServedModel; `ModelServer.load_decoder` is the
+    usual way to construct one)."""
+
+    def __init__(self, name, version, params, cfg, *, max_batch=None,
+                 page_size=None, num_pages=None, page_buckets=None,
+                 kernel=None, ring_prefill=None, queue_cap=None,
+                 max_tokens=None, warmup=True):
+        self.name = name
+        self.version = int(version)
+        self.cfg = cfg
+        self.engine = DecodeEngine(
+            params, cfg, max_batch=max_batch, page_size=page_size,
+            num_pages=num_pages, page_buckets=page_buckets,
+            kernel=kernel, ring_prefill=ring_prefill)
+        self.stats = DecodeStats(
+            key=self.key, traces_fn=self.engine.traces,
+            pool_fn=self.engine.pool_stats)
+        self.scheduler = ContinuousScheduler(
+            self.engine, self.stats, self.key, queue_cap=queue_cap,
+            max_tokens=max_tokens)
+        self.stats._depth_fn = self.scheduler.depth
+        self._started = False
+        if warmup:
+            self.warmup()
+
+    @property
+    def key(self):
+        return f"{self.name}:{self.version}"
+
+    def warmup(self):
+        """Pre-trace the full decode grid and latch the trace floor;
+        the scheduler thread starts only once the model is warm (the
+        ServedModel readiness contract)."""
+        self.engine.warmup()
+        self.stats.mark_warmup_done()
+        if not self._started:
+            self.scheduler.start()
+            self._started = True
+        return self
+
+    # -------------------------------------------------------- data path
+    def submit(self, prompt, max_new_tokens=None, priority=0,
+               deadline_ms=None):
+        return self.scheduler.submit(prompt,
+                                     max_new_tokens=max_new_tokens,
+                                     priority=priority,
+                                     deadline_ms=deadline_ms)
+
+    def generate(self, prompt, max_new_tokens=None, priority=0,
+                 deadline_ms=None, timeout=None):
+        """Sync decode: the full generated token list."""
+        return self.submit(prompt, max_new_tokens=max_new_tokens,
+                           priority=priority,
+                           deadline_ms=deadline_ms).result(timeout)
+
+    def stream(self, prompt, max_new_tokens=None, priority=0,
+               deadline_ms=None, timeout=None):
+        """Streaming decode: yields tokens as steps complete."""
+        fut = self.submit(prompt, max_new_tokens=max_new_tokens,
+                          priority=priority, deadline_ms=deadline_ms)
+        return fut.stream(timeout=timeout)
+
+    def close(self, drain=True, timeout=30):
+        self.scheduler.stop(drain=drain, timeout=timeout)

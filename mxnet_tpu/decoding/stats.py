@@ -1,0 +1,214 @@
+"""Decode-tier counters — `decodingStats` in profiler dumps, /metrics
+and /statusz (via the PR 7 registry/view machinery).
+
+The one-shot serving tier counts requests; the decode tier counts
+TOKENS and PAGES, the units continuous batching actually schedules:
+
+  prefill/decode tokens/s   the two throughput regimes, separately —
+                            prefill is compute-bound batch work,
+                            decode is latency-bound steady state
+  kv_occupancy              owned pages / pool capacity (the paged
+                            cache's answer to padding_waste)
+  free_low_watermark        fewest free pages ever seen: how close
+                            the pool came to forcing preemption
+  preemptions/readmissions  sequences evicted for pages and brought
+                            back (re-prefilled) — nonzero is healthy
+                            under overload, a crash is not
+  p50/p95/p99_token_ms      per-token decode latency
+  traces_since_warmup       decode/prefill traces after warmup —
+                            MUST stay 0 in steady state (the decode
+                            extension of the PR 2 discipline)
+
+Registered as a separate `decodingStats` view (omit_empty) rather
+than folded into `servingStats`, so the serving snapshot's key shape
+— which tests pin byte-for-byte — is untouched.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from ..serving.stats import _percentile
+from ..telemetry import register_view as _register_view
+from ..telemetry import registry as _treg
+
+_registry_lock = threading.Lock()
+_registry: "dict[str, DecodeStats]" = {}
+
+_LATENCY_KEEP = 4096
+
+# native instruments (Prometheus-typed companions of the snapshot)
+_TOKENS = _treg.counter(
+    "mxnet_tpu_decode_tokens_total",
+    "Tokens processed by the decode tier (phase=prefill|decode)")
+_PREEMPTIONS = _treg.counter(
+    "mxnet_tpu_decode_preemptions_total",
+    "Sequences preempted for KV pages (re-prefilled on readmission)")
+_OCCUPANCY = _treg.gauge(
+    "mxnet_tpu_decode_kv_occupancy",
+    "Fraction of the KV page pool currently owned by sequences")
+_TOKEN_LATENCY_MS = _treg.histogram(
+    "mxnet_tpu_decode_token_latency_ms",
+    "Per-token decode-step latency",
+    buckets=(0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000))
+
+
+def _register(key, stats):
+    with _registry_lock:
+        _registry[key] = stats
+
+
+def _unregister(key):
+    with _registry_lock:
+        _registry.pop(key, None)
+
+
+def decoding_stats():
+    """Snapshot of every live decode model: {"name:version": {...}}."""
+    with _registry_lock:
+        items = list(_registry.items())
+    return {key: st.snapshot() for key, st in items}
+
+
+def reset_decoding_stats():
+    with _registry_lock:
+        items = list(_registry.values())
+    for st in items:
+        st.reset()
+
+
+_register_view("decodingStats", decoding_stats, prom_prefix="decoding",
+               omit_empty=True, label_name="model")
+
+
+class DecodeStats:
+    """Counters for one decode model. `traces_fn` reads the engine's
+    trace counter; `pool_fn` reads the allocator; `depth_fn` reads the
+    scheduler's (waiting, active) — all live at snapshot time."""
+
+    def __init__(self, key=None, traces_fn=None, pool_fn=None,
+                 depth_fn=None):
+        self._key = key or ""
+        self._lock = threading.Lock()
+        self._traces_fn = traces_fn
+        self._pool_fn = pool_fn
+        self._depth_fn = depth_fn
+        self.reset()
+
+    def reset(self):
+        with self._lock:
+            self.submitted = 0
+            self.completed = 0
+            self.failed = 0
+            self.rejected = 0
+            self.expired = 0
+            self.preemptions = 0
+            self.readmissions = 0
+            self.prefills = 0
+            self.prefill_tokens = 0
+            self.decode_tokens = 0
+            self.steps = 0
+            self.traces_at_warmup = None
+            self._prefill_s = 0.0
+            self._decode_s = 0.0
+            self._token_lat = deque(maxlen=_LATENCY_KEEP)
+            self._t0 = time.monotonic()
+
+    # ------------------------------------------------------ recording
+    def note_submitted(self):
+        with self._lock:
+            self.submitted += 1
+
+    def note_rejected(self):
+        with self._lock:
+            self.rejected += 1
+
+    def note_expired(self, n=1):
+        with self._lock:
+            self.expired += n
+
+    def note_failed(self, n=1):
+        with self._lock:
+            self.failed += n
+
+    def note_completed(self, n=1):
+        with self._lock:
+            self.completed += n
+
+    def note_prefill(self, tokens, seconds, readmission=False):
+        with self._lock:
+            self.prefills += 1
+            self.prefill_tokens += tokens
+            self._prefill_s += seconds
+            if readmission:
+                self.readmissions += 1
+        _TOKENS.inc(tokens, phase="prefill", model=self._key)
+
+    def note_step(self, live_rows, seconds):
+        """One continuous-decode step: `live_rows` tokens emitted."""
+        with self._lock:
+            self.steps += 1
+            self.decode_tokens += live_rows
+            self._decode_s += seconds
+            if live_rows:
+                per_tok = seconds / live_rows
+                self._token_lat.append(per_tok)
+        if live_rows:
+            _TOKEN_LATENCY_MS.observe(
+                seconds / live_rows * 1e3, model=self._key)
+
+    def note_preempted(self, n=1):
+        with self._lock:
+            self.preemptions += n
+        _PREEMPTIONS.inc(n, model=self._key)
+
+    def mark_warmup_done(self):
+        """Latch the trace floor: anything above it in steady state is
+        a retrace the fixed-shape decode grid failed to prevent."""
+        with self._lock:
+            self.traces_at_warmup = (
+                self._traces_fn() if self._traces_fn else 0)
+
+    def note_pool(self):
+        """Refresh the occupancy gauge (called per step)."""
+        if self._pool_fn:
+            _OCCUPANCY.set(self._pool_fn().get("kv_occupancy", 0.0),
+                           model=self._key)
+
+    # ------------------------------------------------------- snapshot
+    def snapshot(self):
+        traces_now = self._traces_fn() if self._traces_fn else 0
+        pool = self._pool_fn() if self._pool_fn else {}
+        waiting, active = self._depth_fn() if self._depth_fn else (0, 0)
+        with self._lock:
+            lat = sorted(self._token_lat)
+            out = {
+                "submitted": self.submitted,
+                "completed": self.completed,
+                "failed": self.failed,
+                "rejected": self.rejected,
+                "expired": self.expired,
+                "preemptions": self.preemptions,
+                "readmissions": self.readmissions,
+                "prefills": self.prefills,
+                "prefill_tokens": self.prefill_tokens,
+                "decode_tokens": self.decode_tokens,
+                "steps": self.steps,
+                "prefill_tokens_per_s": round(
+                    self.prefill_tokens / self._prefill_s, 1)
+                if self._prefill_s else 0.0,
+                "decode_tokens_per_s": round(
+                    self.decode_tokens / self._decode_s, 1)
+                if self._decode_s else 0.0,
+                "p50_token_ms": round(_percentile(lat, 0.50) * 1e3, 3),
+                "p95_token_ms": round(_percentile(lat, 0.95) * 1e3, 3),
+                "p99_token_ms": round(_percentile(lat, 0.99) * 1e3, 3),
+                "traces_since_warmup": (
+                    traces_now - self.traces_at_warmup
+                    if self.traces_at_warmup is not None else None),
+                "waiting": waiting,
+                "active": active,
+            }
+        out.update(pool)
+        return out

@@ -116,6 +116,23 @@ def ring_attention(q, k, v, mesh=None, axis_name="seq", causal=False,
     return fn(q, k, v)
 
 
+def seq_mesh_for(total_len, axis_name="seq", max_devices=None):
+    """A 1-D 'seq' mesh sized for ring attention over `total_len`
+    tokens: the largest device count that divides total_len (ring
+    attention shards the sequence axis evenly). Degrades to a 1-device
+    mesh — callers (e.g. the decode tier's long-prompt prefill,
+    MXNET_DECODE_RING_PREFILL) can use it unconditionally."""
+    import numpy as np
+
+    devs = jax.devices()
+    if max_devices is not None:
+        devs = devs[:max_devices]
+    n = len(devs)
+    while n > 1 and total_len % n:
+        n -= 1
+    return Mesh(np.asarray(devs[:n]), (axis_name,))
+
+
 def _ulysses_shard(q, k, v, *, axis_name, causal, scale):
     """Per-device body: all_to_all to head-sharded full-seq layout,
     dense local attention, all_to_all back. q: (B, T_local, H, D)."""

@@ -224,6 +224,14 @@ class _Request:
         self.trace_id = trace_id
         self.t_enqueue_pc = _trace.now()  # span clock (perf_counter)
 
+    def expired(self, now=None):
+        """True once the request's absolute deadline has passed."""
+        if self.deadline is None:
+            return False
+        if now is None:
+            now = time.monotonic()
+        return now > self.deadline
+
 
 class DynamicBatcher:
     """Bounded multi-bucket FIFO with the max-batch / max-wait flush
@@ -260,6 +268,26 @@ class DynamicBatcher:
         with self._cond:
             self._closed = True
             self._cond.notify_all()
+
+    def pop_expired(self, now=None):
+        """Remove and return every queued request whose deadline has
+        already passed. The worker calls this each wake-up, so an
+        expired request is failed promptly and its queue slot freed —
+        previously it rode along until its own bucket's group flushed,
+        which under sparse traffic (or while the process is busy with
+        multi-step decode work) could be long after the deadline, the
+        whole time counting against the admission cap."""
+        if now is None:
+            now = time.monotonic()
+        out = []
+        with self._cond:
+            for lb, group in self._pending.items():
+                keep = [r for r in group if not r.expired(now)]
+                if len(keep) != len(group):
+                    out.extend(r for r in group if r.expired(now))
+                    self._pending[lb] = keep
+            self._count -= len(out)
+        return out
 
     def _ready_group(self, now):
         """The flush decision. Returns (bucket, requests) or (None,

@@ -141,6 +141,32 @@ class ModelRegistry:
         return self.load(name, symbol_json, params, input_specs,
                          **kwargs)
 
+    def load_decoder(self, name, params, decoder_cfg, version=1,
+                     warmup=True, **kwargs):
+        """Load + (by default) warm a continuous-batching decoder
+        (mxnet_tpu.decoding.DecodedModel) into the same name/version
+        namespace as one-shot models. Warmup pre-traces the decoder's
+        full prefill + decode program grid — the identical readiness
+        contract as ServedModel.warmup — and starts its scheduler
+        thread. kwargs: DecodedModel knobs (max_batch, page_size,
+        num_pages, page_buckets, kernel, ring_prefill, queue_cap,
+        max_tokens)."""
+        from ..decoding.scheduler import DecodedModel
+        from ..decoding import stats as _dec_stats
+
+        model = DecodedModel(name, version, params, decoder_cfg,
+                             warmup=False, **kwargs)
+        with self._lock:
+            versions = self._models.setdefault(name, {})
+            if version in versions:
+                raise ServingError(
+                    f"model {name!r} version {version} already loaded")
+            versions[version] = model
+        if warmup:
+            model.warmup()
+        _dec_stats._register(model.key, model.stats)
+        return model
+
     def get(self, name, version=None):
         with self._lock:
             versions = self._models.get(name)
@@ -170,7 +196,13 @@ class ModelRegistry:
             if not self._models[name]:
                 del self._models[name]
         for model in removed.values():
-            _unregister(model.key)
+            if isinstance(model, ServedModel):
+                _unregister(model.key)
+            else:  # DecodedModel: stop its scheduler, drop its stats
+                from ..decoding import stats as _dec_stats
+
+                _dec_stats._unregister(model.key)
+                model.close(drain=False)
         return list(removed.values())
 
     def models(self):

@@ -32,7 +32,7 @@ from ..telemetry import trace as _trace
 from ..telemetry import http as _thttp
 from ..telemetry import registry as _treg
 from .batcher import (DynamicBatcher, DeadlineExceededError,
-                      ServerClosedError, _Request)
+                      ServerClosedError, ServingError, _Request)
 from .registry import ModelRegistry
 from . import config as _cfg
 
@@ -72,6 +72,7 @@ class ModelServer:
         self._queue_cap = (queue_cap if queue_cap is not None
                            else _cfg.queue_cap())
         self._lanes = {}
+        self._decoders = {}
         self._lock = threading.Lock()
         self._closed = False
         # opt-in live introspection: with MXNET_TELEMETRY_PORT set this
@@ -97,6 +98,21 @@ class ModelServer:
         self._start_lane(model)
         return model
 
+    def load_decoder(self, name, params, decoder_cfg, **kwargs):
+        """Load + warm a continuous-batching decoder
+        (mxnet_tpu.decoding.DecodedModel). Its lane is the scheduler
+        thread inside the model — no DynamicBatcher — and traffic goes
+        through submit_decode/generate/stream, not submit/predict.
+        Warmed (every prefill + decode bucket pre-traced) on return."""
+        with self._lock:
+            if self._closed:
+                raise ServerClosedError("server is stopped")
+        model = self.registry.load_decoder(name, params, decoder_cfg,
+                                           **kwargs)
+        with self._lock:
+            self._decoders[model.key] = model
+        return model
+
     def serve(self, model):
         """Attach a lane to an already-registered ServedModel (for a
         registry shared across servers)."""
@@ -119,6 +135,7 @@ class ModelServer:
         for model in removed:
             with self._lock:
                 lane = self._lanes.pop(model.key, None)
+                self._decoders.pop(model.key, None)
             if lane is not None:
                 lane.batcher.close()
                 lane.thread.join(timeout=30)
@@ -137,6 +154,10 @@ class ModelServer:
         tid = _trace.new_trace_id()
         with _trace.span("serving.submit", trace_id=tid, model=name):
             model = self.registry.get(name, version=version)
+            if not hasattr(model, "spec"):   # a DecodedModel
+                raise ServingError(
+                    f"{model.key} is a decoder model; use "
+                    "submit_decode/generate/stream")
             with self._lock:
                 lane = self._lanes.get(model.key)
                 closed = self._closed
@@ -169,21 +190,68 @@ class ModelServer:
                           deadline_ms=deadline_ms)
         return fut.result(timeout=timeout)
 
+    # ----------------------------------------------- decode data path
+    def _decoder(self, name, version=None):
+        model = self.registry.get(name, version=version)
+        if hasattr(model, "spec"):
+            raise ServingError(
+                f"{model.key} is a one-shot model; use submit/predict")
+        return model
+
+    def submit_decode(self, name, prompt, version=None,
+                      max_new_tokens=None, priority=0,
+                      deadline_ms=None):
+        """Async autoregressive decode: returns a DecodeFuture —
+        `result()` for the full token list, `stream()` to iterate
+        tokens as continuous-batching steps emit them. `deadline_ms`
+        is enforced EVERY decode step, not only at admission."""
+        return self._decoder(name, version).submit(
+            prompt, max_new_tokens=max_new_tokens, priority=priority,
+            deadline_ms=deadline_ms)
+
+    def generate(self, name, prompt, version=None, max_new_tokens=None,
+                 priority=0, deadline_ms=None, timeout=None):
+        """Sync decode: the complete generated token list."""
+        return self.submit_decode(
+            name, prompt, version=version,
+            max_new_tokens=max_new_tokens, priority=priority,
+            deadline_ms=deadline_ms).result(timeout)
+
+    def stream(self, name, prompt, version=None, max_new_tokens=None,
+               priority=0, deadline_ms=None, timeout=None):
+        """Streaming decode: an iterator of tokens (per-step)."""
+        return self.submit_decode(
+            name, prompt, version=version,
+            max_new_tokens=max_new_tokens, priority=priority,
+            deadline_ms=deadline_ms).stream(timeout=timeout)
+
     # ---------------------------------------------------------- worker
     def _worker_loop(self, lane):
         model, batcher = lane.model, lane.batcher
         spec, stats = model.spec, model.stats
         while True:
             group = batcher.next_batch()
+            # deadline sweep every wake-up, not only at this group's
+            # flush: requests in OTHER buckets whose deadline passed
+            # while queued resolve promptly and free their queue slots
+            now = time.monotonic()
+            for r in batcher.pop_expired(now):
+                stats.note_expired()
+                r.future.set_exception(DeadlineExceededError(
+                    "deadline passed while queued "
+                    f"(waited {(now - r.t_enqueue) * 1e3:.1f} ms)"))
+                _trace.record_span("serving.enqueue", r.trace_id,
+                                   r.t_enqueue_pc, _trace.now(),
+                                   {"model": model.key,
+                                    "outcome": "expired"})
             if group is None:
                 if batcher._closed and batcher.depth() == 0:
                     return
                 continue
-            now = time.monotonic()
             t_flush = _trace.now()
             live = []
             for r in group:
-                if r.deadline is not None and now > r.deadline:
+                if r.expired(now):
                     stats.note_expired()
                     r.future.set_exception(DeadlineExceededError(
                         "deadline passed while queued "
@@ -241,6 +309,9 @@ class ModelServer:
         with self._lock:
             self._closed = True
             lanes = list(self._lanes.values())
+            decoders = list(self._decoders.values())
+        for dm in decoders:
+            dm.close(drain=drain, timeout=timeout)
         for lane in lanes:
             if not drain:
                 # fail pending before the worker can flush them

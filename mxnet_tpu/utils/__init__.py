@@ -289,3 +289,58 @@ register_env(
     "threading excepthook) and on fault.FaultInjector trips. Unset "
     "= flight recording off (docs/observability.md).",
 )
+register_env(
+    "MXNET_DECODE_PAGE_SIZE", int, 16,
+    "decoding: tokens per KV-cache page. Smaller pages waste fewer "
+    "slots per sequence (worst case page_size-1 tokens) but grow the "
+    "page table and the decode-step gather fan-out; 16 matches the "
+    "Ragged Paged Attention layout (docs/serving.md).",
+)
+register_env(
+    "MXNET_DECODE_PAGES", int, 64,
+    "decoding: total pages in the pre-allocated device KV pool "
+    "(page 0 is reserved scratch, so capacity is PAGES-1). The pool "
+    "is THE decode memory budget: when it runs out the scheduler "
+    "preempts the lowest-priority sequence instead of OOMing.",
+)
+register_env(
+    "MXNET_DECODE_MAX_BATCH", int, 4,
+    "decoding: rows in the fixed-shape continuous decode batch. "
+    "Every decode step runs at exactly this batch (inactive rows "
+    "masked), which is what keeps the step shape grid finite and "
+    "fully pre-traceable at warmup.",
+)
+register_env(
+    "MXNET_DECODE_PAGE_BUCKETS", str, "",
+    "decoding: comma list of pages-per-sequence buckets (e.g. "
+    "'2,4,8'); the decode-step shape is a function only of "
+    "(max_batch, bucket), one pre-traced program per bucket. Empty = "
+    "powers of two up to the pool-derived per-sequence maximum.",
+)
+register_env(
+    "MXNET_DECODE_KERNEL", str, "lax",
+    "decoding: page-table attention implementation: 'lax' (gather + "
+    "masked softmax, runs anywhere) or 'pallas' (flash-style online-"
+    "softmax kernel whose K/V block index maps read the page table "
+    "via scalar prefetch; interpret-mode on CPU).",
+)
+register_env(
+    "MXNET_DECODE_RING_PREFILL", int, 0,
+    "decoding: minimum PADDED prompt length (length bucket) that "
+    "routes prefill attention through parallel.ring_attention on a "
+    "'seq' mesh — the long-context prefill path. 0 disables; the "
+    "bucket length must then divide across the chosen seq axis.",
+)
+register_env(
+    "MXNET_DECODE_MAX_TOKENS", int, 32,
+    "decoding: default max_new_tokens for generate()/submit() when "
+    "the request does not say (always also bounded by KV capacity: "
+    "pages_per_seq_bucket_max * page_size).",
+)
+register_env(
+    "MXNET_DECODE_QUEUE_CAP", int, 256,
+    "decoding: bounded admission queue of the continuous-batching "
+    "scheduler; a full queue fast-fails submit() with "
+    "ServerBusyError (same backpressure contract as the one-shot "
+    "serving tier).",
+)

@@ -1,0 +1,498 @@
+"""Decode tier (mxnet_tpu.decoding): allocator invariants under
+adversarial alloc/free patterns, COW fork correctness, paged-attention
+kernel parity (lax vs pallas vs dense), continuous-batching greedy
+parity against an unbatched reference loop, preempt-then-readmit
+bit-identical continuations, per-step deadlines, streaming, the
+zero-retrace guarantee over the pre-traced decode grid, and the
+`decodingStats` view's pinned key shape."""
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import decoding as dec
+from mxnet_tpu import serving
+from mxnet_tpu.decoding.blocks import (BlockAllocator, PageError,
+                                       PagePoolExhausted, SCRATCH_PAGE,
+                                       pages_needed)
+
+jnp = pytest.importorskip("jax.numpy")
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    for var in ("MXNET_DECODE_PAGE_SIZE", "MXNET_DECODE_PAGES",
+                "MXNET_DECODE_MAX_BATCH", "MXNET_DECODE_PAGE_BUCKETS",
+                "MXNET_DECODE_KERNEL", "MXNET_DECODE_RING_PREFILL",
+                "MXNET_DECODE_MAX_TOKENS", "MXNET_DECODE_QUEUE_CAP"):
+        monkeypatch.delenv(var, raising=False)
+    dec.stats._registry.clear()
+    yield
+
+
+CFG = dec.DecoderConfig(vocab=32, d_model=16, n_layers=2, n_heads=2,
+                        d_ff=32, max_len=64)
+PARAMS = dec.init_decoder_params(CFG, seed=0)
+
+
+def _model(**kw):
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("num_pages", 32)
+    kw.setdefault("page_buckets", (1, 2, 4))
+    kw.setdefault("max_tokens", 8)
+    return dec.DecodedModel("lm", 1, PARAMS, CFG, **kw)
+
+
+def _ref_greedy(prompt, n, cfg=CFG, eos=None):
+    """Unbatched single-request reference: one dense forward per
+    token — the parity oracle for every scheduler test."""
+    eos = cfg.eos_id if eos is None else eos
+    toks, out = list(prompt), []
+    for _ in range(n):
+        lg = dec.reference_logits(PARAMS,
+                                  np.asarray([toks], np.int32), cfg)
+        nxt = int(jnp.argmax(lg[0, -1]))
+        if nxt == eos:
+            break
+        out.append(nxt)
+        toks.append(nxt)
+    return out
+
+
+# ----------------------------------------------------------- allocator
+def test_alloc_free_refcount_invariants():
+    a = BlockAllocator(8, 4)
+    assert a.capacity() == 7 and a.free_pages() == 7
+    t = a.alloc(3)
+    assert len(set(t)) == 3 and SCRATCH_PAGE not in t
+    assert all(a.refcount(p) == 1 for p in t)
+    assert a.pages_in_use() == 3
+    a.check()
+    a.free(t)
+    assert a.free_pages() == 7
+    with pytest.raises(PageError):
+        a.free(t)            # double free
+    a.check()
+    # all-or-nothing: a too-large request leaves the pool untouched
+    with pytest.raises(PagePoolExhausted):
+        a.alloc(8)
+    assert a.free_pages() == 7
+    assert a.low_watermark() == 4  # the alloc(3) high-water point
+
+
+def test_pages_needed():
+    assert pages_needed(0, 4) == 0
+    assert pages_needed(1, 4) == 1
+    assert pages_needed(4, 4) == 1
+    assert pages_needed(5, 4) == 2
+
+
+def test_fragmentation_adversarial():
+    """Interleaved variable-size alloc/free must never corrupt the
+    free list and pages must be perfectly recyclable (no external
+    fragmentation: any page serves any sequence)."""
+    rng = mx.random.py_rng()
+    a = BlockAllocator(33, 4)
+    live = []
+    for _ in range(300):
+        if live and rng.random() < 0.45:
+            a.free(live.pop(rng.randrange(len(live))))
+        else:
+            n = rng.randint(1, 5)
+            try:
+                live.append(a.alloc(n))
+            except PagePoolExhausted:
+                assert a.free_pages() < n
+                if live:
+                    a.free(live.pop(0))
+        a.check()
+        assert a.free_pages() + sum(len(t) for t in live) == 32
+    for t in live:
+        a.free(t)
+    a.check()
+    assert a.free_pages() == 32
+    # after heavy churn the whole pool is still allocatable at once
+    whole = a.alloc(32)
+    assert sorted(whole) == list(range(1, 33))
+    a.free(whole)
+
+
+def test_cow_fork():
+    a = BlockAllocator(8, 4)
+    t1 = a.alloc(2)
+    t2 = a.fork(t1)
+    assert t2 == t1 and all(a.refcount(p) == 2 for p in t1)
+    # first write through the fork allocates a private copy
+    page, copy_from = a.make_writable(t2, 1)
+    assert copy_from == t1[1] and page != t1[1]
+    assert t2[1] == page and t1[1] == copy_from
+    assert a.refcount(t1[1]) == 1 and a.refcount(page) == 1
+    assert a.refcount(t1[0]) == 2    # index 0 is still shared
+    # exclusively-owned page: no copy
+    t3 = a.alloc(1)
+    page2, copy2 = a.make_writable(t3, 0)
+    assert copy2 is None and page2 == t3[0]
+    a.check()
+    a.free(t1)
+    a.free(t2)
+    a.free(t3)
+    assert a.free_pages() == 7
+    a.check()
+
+
+def test_cow_page_copy_on_device():
+    m = _model()
+    try:
+        eng = m.engine
+        t1 = eng.allocator.alloc(1)
+        # stamp recognizable content into the page via prefill
+        m.generate([5, 6, 7, 8], max_new_tokens=1, timeout=30)
+        src = t1[0]
+        t2 = eng.allocator.fork(t1)
+        page, copy_from = eng.allocator.make_writable(t2, 0)
+        assert copy_from == src
+        eng.copy_page(copy_from, page)
+        k_src, v_src = eng.read_page(0, src)
+        k_dst, v_dst = eng.read_page(0, page)
+        np.testing.assert_array_equal(k_src, k_dst)
+        np.testing.assert_array_equal(v_src, v_dst)
+        eng.allocator.free(t1)
+        eng.allocator.free(t2)
+    finally:
+        m.close()
+
+
+# ----------------------------------------------------------- attention
+def test_paged_attention_kernels_match_dense():
+    rs = np.random.RandomState(3)
+    b, h, d, p, bp, n = 3, 2, 8, 4, 3, 16
+    q = rs.randn(b, h, d).astype(np.float32)
+    k_pages = rs.randn(n, p, h, d).astype(np.float32)
+    v_pages = rs.randn(n, p, h, d).astype(np.float32)
+    table = rs.choice(np.arange(1, n), size=(b, bp),
+                      replace=False).astype(np.int32)
+    lengths = np.asarray([5, 12, 1], np.int32)
+
+    out_lax = np.asarray(dec.paged_attention_lax(
+        q, k_pages, v_pages, table, lengths))
+    out_pls = np.asarray(dec.paged_attention_pallas(
+        q, k_pages, v_pages, table, lengths))
+
+    # dense oracle: gather each row's true context and softmax it
+    scale = 1.0 / np.sqrt(d)
+    for row in range(b):
+        ctx_k = k_pages[table[row]].reshape(bp * p, h, d)
+        ctx_v = v_pages[table[row]].reshape(bp * p, h, d)
+        ln = lengths[row]
+        s = np.einsum("hd,thd->ht", q[row], ctx_k[:ln]) * scale
+        e = np.exp(s - s.max(axis=-1, keepdims=True))
+        w = e / e.sum(axis=-1, keepdims=True)
+        ref = np.einsum("ht,thd->hd", w, ctx_v[:ln])
+        np.testing.assert_allclose(out_lax[row], ref, atol=1e-5)
+        np.testing.assert_allclose(out_pls[row], ref, atol=1e-5)
+
+
+def test_get_kernel():
+    assert dec.get_kernel("lax") is dec.paged_attention_lax
+    assert dec.get_kernel("pallas") is dec.paged_attention_pallas
+    with pytest.raises(ValueError):
+        dec.get_kernel("nope")
+
+
+# ----------------------------------------------- parity + zero retrace
+def test_single_request_parity_and_trace_grid():
+    m = _model()
+    try:
+        # the warmup grid: one prefill per length bucket, one decode
+        # per pages bucket, plus the page-copy program
+        counts = m.engine.trace_counts()
+        assert counts == {"copy_page": 1, "prefill@4": 1,
+                          "prefill@8": 1, "prefill@16": 1,
+                          "decode@1": 1, "decode@2": 1, "decode@4": 1}
+        floor = m.engine.traces()
+        for prompt in ([5, 6, 7], [3], list(range(2, 13))):
+            out = m.generate(prompt, max_new_tokens=6, timeout=60)
+            assert out == _ref_greedy(prompt, 6)
+        assert m.engine.traces() == floor
+        assert m.stats.snapshot()["traces_since_warmup"] == 0
+    finally:
+        m.close()
+
+
+def test_continuous_batching_parity_concurrent():
+    """Mid-stream admissions and evictions: more requests than batch
+    rows, mixed lengths/budgets — every output token-identical to the
+    unbatched reference, zero retraces."""
+    m = _model(max_batch=4, num_pages=64, page_buckets=(1, 2, 4))
+    try:
+        floor = m.engine.traces()
+        rng = mx.random.py_rng()
+        jobs = [(
+            [rng.randrange(2, CFG.vocab) for _ in
+             range(rng.randint(1, 12))],
+            rng.randint(1, 8),
+        ) for _ in range(12)]
+        futs = [m.submit(p, max_new_tokens=n) for p, n in jobs]
+        for (p, n), f in zip(jobs, futs):
+            assert f.result(120) == _ref_greedy(p, n)
+        assert m.engine.traces() == floor
+        snap = m.stats.snapshot()
+        assert snap["completed"] == 12 and snap["pages_free"] == 63
+    finally:
+        m.close()
+
+
+def test_preempt_then_readmit_bit_identical():
+    """A pool far too small for the offered load: sequences are
+    preempted (pages dropped) and readmitted (re-prefilled); the
+    continuation must be BIT-identical to an uninterrupted run."""
+    m = _model(max_batch=4, num_pages=9, page_buckets=(1, 2, 4),
+               max_tokens=12, queue_cap=64)
+    try:
+        floor = m.engine.traces()
+        prompts = [[int(t) for t in
+                    np.random.RandomState(i).randint(2, 32, size=6)]
+                   for i in range(6)]
+        futs = [m.submit(p, max_new_tokens=10, priority=i % 2)
+                for i, p in enumerate(prompts)]
+        for p, f in zip(prompts, futs):
+            assert f.result(240) == _ref_greedy(p, 10)
+        snap = m.stats.snapshot()
+        assert snap["preemptions"] > 0
+        assert snap["readmissions"] == snap["preemptions"]
+        assert m.engine.traces() == floor  # readmission retraces nothing
+        assert m.engine.allocator.stats()["pages_in_use"] == 0
+        m.engine.allocator.check()
+    finally:
+        m.close()
+
+
+def test_pool_exhaustion_never_crashes():
+    """CI gate iii at unit scale: offered load >> pool capacity keeps
+    resolving every future (no OOM, no dead scheduler)."""
+    m = _model(max_batch=4, num_pages=5, page_buckets=(1, 2),
+               max_tokens=6, queue_cap=64)
+    try:
+        futs = [m.submit([2 + i, 3, 4], max_new_tokens=5)
+                for i in range(10)]
+        for f in futs:
+            assert f.result(240) is not None
+        assert m.engine.allocator.stats()["pages_in_use"] == 0
+    finally:
+        m.close()
+
+
+# ------------------------------------------------- deadlines/streaming
+def test_deadline_resolves_mid_generation_and_frees_pages():
+    m = _model()
+    try:
+        f = m.submit([3, 4, 5], max_new_tokens=8, deadline_ms=0.001)
+        with pytest.raises(serving.DeadlineExceededError):
+            f.result(60)
+        deadline = time.monotonic() + 10
+        while (m.engine.allocator.stats()["pages_in_use"]
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert m.engine.allocator.stats()["pages_in_use"] == 0
+        m.engine.allocator.check()
+        assert m.stats.snapshot()["expired"] == 1
+    finally:
+        m.close()
+
+
+def test_streaming_matches_result():
+    m = _model()
+    try:
+        fut = m.submit([3, 4], max_new_tokens=5)
+        streamed = list(fut.stream(timeout=60))
+        assert streamed == fut.result(1) == _ref_greedy([3, 4], 5)
+    finally:
+        m.close()
+
+
+def test_finish_reasons():
+    # max_tokens
+    m = _model()
+    try:
+        f = m.submit([5, 6, 7], max_new_tokens=2)
+        f.result(60)
+        assert f.finish_reason == "max_tokens"
+        # length: the context hits max_context (= 4 pages * 4 tokens)
+        f2 = m.submit(list(range(2, 16)), max_new_tokens=8)
+        out2 = f2.result(60)
+        assert f2.finish_reason == "length"
+        assert len(out2) + 14 == m.engine.max_context + 1
+    finally:
+        m.close()
+    # eos: rebuild the model declaring a token we KNOW it emits as EOS
+    known = _ref_greedy([5, 6, 7], 4)
+    import dataclasses
+    cfg_eos = dataclasses.replace(CFG, eos_id=known[0])
+    m2 = dec.DecodedModel("lm-eos", 1, PARAMS, cfg_eos, max_batch=2,
+                          page_size=4, num_pages=32,
+                          page_buckets=(1, 2, 4), max_tokens=8)
+    try:
+        f3 = m2.submit([5, 6, 7], max_new_tokens=8)
+        out3 = f3.result(60)
+        assert f3.finish_reason == "eos"
+        assert out3 == _ref_greedy([5, 6, 7], 8, eos=known[0])
+    finally:
+        m2.close()
+
+
+def test_admission_errors():
+    m = _model(queue_cap=0)
+    try:
+        with pytest.raises(serving.ServerBusyError):
+            m.submit([3, 4])
+        assert m.stats.snapshot()["rejected"] == 1
+        with pytest.raises(serving.ServingError):
+            m.submit([])
+        with pytest.raises(serving.ServingError):
+            m.submit([CFG.vocab + 5])
+        with pytest.raises(serving.ServingError):
+            m.submit(list(range(2, 2 + 17)))  # > max_context 16
+    finally:
+        m.close()
+    with pytest.raises(serving.ServerClosedError):
+        m.submit([3, 4])
+
+
+# ------------------------------------------------------- randomized soak
+def test_randomized_soak():
+    """Randomized continuous traffic (seeded via mx.random.py_rng —
+    MX005-clean): mixed lengths, budgets, priorities, deadlines. Every
+    future resolves, non-expired outputs match the reference exactly,
+    the allocator ends clean, and the trace count never moves."""
+    rng = mx.random.py_rng()
+    m = _model(max_batch=3, num_pages=12, page_buckets=(1, 2, 4),
+               queue_cap=128, max_tokens=10)
+    try:
+        floor = m.engine.traces()
+        jobs = []
+        for _ in range(16):
+            prompt = [rng.randrange(2, CFG.vocab)
+                      for _ in range(rng.randint(1, 10))]
+            n = rng.randint(1, 7)
+            dl = 0.001 if rng.random() < 0.2 else None
+            fut = m.submit(prompt, max_new_tokens=n,
+                           priority=rng.randint(0, 2), deadline_ms=dl)
+            jobs.append((prompt, n, dl, fut))
+            if rng.random() < 0.3:
+                time.sleep(0.002)
+        for prompt, n, dl, fut in jobs:
+            try:
+                out = fut.result(240)
+                assert out == _ref_greedy(prompt, n)
+            except serving.DeadlineExceededError:
+                assert dl is not None
+        assert m.engine.traces() == floor
+        assert m.engine.allocator.stats()["pages_in_use"] == 0
+        m.engine.allocator.check()
+    finally:
+        m.close()
+
+
+# ----------------------------------------------------- ring prefill path
+def test_seq_mesh_for_divisibility():
+    from mxnet_tpu.parallel.ring_attention import seq_mesh_for
+    mesh = seq_mesh_for(16)
+    assert 16 % mesh.shape["seq"] == 0 and mesh.shape["seq"] > 1
+    assert seq_mesh_for(7).shape["seq"] == 7   # 7 of 8 devices divide
+    assert seq_mesh_for(13).shape["seq"] == 1  # prime > devices: degrade
+
+
+def test_ring_prefill_long_prompt():
+    """Prompts at/above MXNET_DECODE_RING_PREFILL prefill through ring
+    attention (sequence sharded over the 8-device CPU mesh); greedy
+    tokens must match the dense reference."""
+    m = _model(ring_prefill=16, num_pages=32)
+    try:
+        prompt = list(range(2, 14))   # buckets to 16 -> ring path
+        out = m.generate(prompt, max_new_tokens=4, timeout=120)
+        assert out == _ref_greedy(prompt, 4)
+    finally:
+        m.close()
+
+
+# ------------------------------------------------------- stats + server
+def test_decoding_stats_view_shape_pinned():
+    """The decodingStats snapshot key set is a published surface
+    (dashboards, /metrics) — additions need a deliberate pin bump, and
+    serving's own snapshot shape must be untouched by the decode tier."""
+    m = _model()
+    try:
+        m.generate([5, 6, 7], max_new_tokens=3, timeout=60)
+        dec.stats._register(m.key, m.stats)
+        snap = dec.decoding_stats()[m.key]
+        assert sorted(snap) == sorted((
+            "submitted", "completed", "failed", "rejected", "expired",
+            "preemptions", "readmissions", "prefills",
+            "prefill_tokens", "decode_tokens", "steps",
+            "prefill_tokens_per_s", "decode_tokens_per_s",
+            "p50_token_ms", "p95_token_ms", "p99_token_ms",
+            "traces_since_warmup", "waiting", "active", "pages_total",
+            "pages_free", "kv_occupancy", "free_low_watermark"))
+        assert snap["decode_tokens"] == 2 and snap["prefills"] == 1
+        assert snap["prefill_tokens"] == 3
+        assert snap["traces_since_warmup"] == 0
+    finally:
+        dec.stats._unregister(m.key)
+        m.close()
+
+
+def test_model_server_integration():
+    with serving.ModelServer() as srv:
+        srv.load_decoder("lm", PARAMS, CFG, max_batch=2, page_size=4,
+                         num_pages=32, page_buckets=(1, 2, 4),
+                         max_tokens=8)
+        out = srv.generate("lm", [5, 6, 7], max_new_tokens=4,
+                           timeout=60)
+        assert out == _ref_greedy([5, 6, 7], 4)
+        assert list(srv.stream("lm", [3, 4], max_new_tokens=3,
+                               timeout=60)) == _ref_greedy([3, 4], 3)
+        # one-shot API refuses decoder models, and vice versa
+        with pytest.raises(serving.ServingError):
+            srv.submit("lm", {"data": np.zeros((3,), np.int32)})
+        assert "lm:1" in dec.decoding_stats()
+        srv.unload("lm")
+        assert dec.decoding_stats() == {}
+        with pytest.raises(serving.ServingError):
+            srv.generate("lm", [5, 6])
+
+
+def test_duplicate_decoder_version_rejected():
+    with serving.ModelServer() as srv:
+        srv.load_decoder("lm", PARAMS, CFG, max_batch=2, page_size=4,
+                         num_pages=16, page_buckets=(1, 2))
+        with pytest.raises(serving.ServingError):
+            srv.load_decoder("lm", PARAMS, CFG, max_batch=2,
+                             page_size=4, num_pages=16,
+                             page_buckets=(1, 2))
+        srv.unload("lm")
+
+
+# -------------------------------------------- one-shot batcher deadlines
+def test_batcher_pop_expired():
+    """The serving-side deadline fix: expired requests leave the queue
+    at the next worker wake-up, not only when their own bucket
+    flushes."""
+    from concurrent.futures import Future
+    from mxnet_tpu.serving.batcher import (BucketSpec, DynamicBatcher,
+                                           _Request)
+    spec = BucketSpec({"data": ("L",)}, (1, 2), length_buckets=(8, 16))
+    b = DynamicBatcher(spec, max_wait_us=10_000_000, queue_cap=8)
+    now = time.monotonic()
+    dead = _Request({"data": np.zeros((3,), np.int32)}, Future(),
+                    now - 1.0, 3, 8)
+    alive = _Request({"data": np.zeros((12,), np.int32)}, Future(),
+                     now + 60.0, 12, 16)
+    b.put(dead)
+    b.put(alive)
+    assert dead.expired() and not alive.expired()
+    popped = b.pop_expired()
+    assert popped == [dead]
+    assert b.depth() == 1            # the live request stays queued
+    assert b.pop_expired() == []

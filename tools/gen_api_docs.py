@@ -39,6 +39,8 @@ MODULES = [
     ("mxnet_tpu.model", "checkpoints + FeedForward"),
     ("mxnet_tpu.fault", "failure detection / auto-resume"),
     ("mxnet_tpu.serving", "dynamic-batching inference server"),
+    ("mxnet_tpu.decoding",
+     "continuous-batching autoregressive decode, paged KV cache"),
     ("mxnet_tpu.analysis", "static analyzer (mxlint) + graph verifier"),
     ("mxnet_tpu.passes", "graph-optimization pass pipeline + autotuner"),
     ("mxnet_tpu.visualization", "network plots/summaries"),

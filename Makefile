@@ -11,7 +11,7 @@ XLA8    := XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: all test nightly examples lint lint-check libs predict perl \
 	docs dryrun cache-check serving-check sync-check data-check \
-	passes-check telemetry-check decode-check clean
+	passes-check telemetry-check decode-check race-check clean
 
 all: libs test
 
@@ -106,6 +106,14 @@ telemetry-check:
 # KV-memory bench gate
 decode-check:
 	$(CPUENV) bash ci/check_decode.sh
+
+# concurrency race gate: MX006-MX008 clean tree with no baseline, a
+# seeded lock-order inversion caught both statically (MX007) and by
+# the runtime witness (LockOrderViolation instead of deadlock), and a
+# serving+decoding+data+telemetry soak that finishes deadlock-free
+# under MXNET_LOCK_WITNESS=raise
+race-check:
+	$(CPUENV) bash ci/check_concurrency.sh
 
 # multi-chip sharding dryrun (DP / SP+TP / PP / EP) on 8 virtual devices
 dryrun:

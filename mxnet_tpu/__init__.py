@@ -13,6 +13,13 @@ from . import _dist_bootstrap
 # client construction (see _dist_bootstrap docstring)
 _dist_bootstrap.maybe_init_distributed()
 
+# opt-in runtime lock witness (MXNET_LOCK_WITNESS, docs/analysis.md):
+# patch the threading lock factories BEFORE any submodule creates its
+# module-level locks so every lock in the package is witnessed.
+# lockwitness is stdlib-only, so importing it here costs nothing.
+from .analysis import lockwitness as _lockwitness
+_lockwitness.install_from_env()
+
 from . import base
 from .base import MXNetError
 from .context import (

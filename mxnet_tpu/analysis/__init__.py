@@ -12,15 +12,29 @@ Two halves (docs/analysis.md):
     **shapes)` — pre-bind shape/dtype/aliasing checks over the Symbol
     graph, run automatically by `Executor._build` under
     MXNET_GRAPH_VERIFY=1 (always-on in the test suite).
+  - **concurrency analysis** (callgraph.py + concurrency.py +
+    lockwitness.py): an interprocedural call graph, a lock registry +
+    static held-before graph feeding project-scope rules MX006-MX008,
+    and an opt-in runtime lock witness (MXNET_LOCK_WITNESS) that
+    records actual acquisition order and raises on a genuine
+    lock-order cycle. Wired as the CI race gate
+    (ci/check_concurrency.sh).
 """
 from . import rules
 from . import lint
 from . import graph_verify
+from . import callgraph
+from . import concurrency
+from . import lockwitness
 from .graph_verify import GraphIssue, GraphVerifyError, verify_graph
 from .lint import Finding, lint_file, lint_paths
+from .concurrency import ConcurrencyModel, LockId
+from .lockwitness import LockOrderViolation
 
 __all__ = [
     "rules", "lint", "graph_verify",
+    "callgraph", "concurrency", "lockwitness",
     "GraphIssue", "GraphVerifyError", "verify_graph",
     "Finding", "lint_file", "lint_paths",
+    "ConcurrencyModel", "LockId", "LockOrderViolation",
 ]

@@ -1,0 +1,378 @@
+"""Best-effort interprocedural call graph over a set of parsed files.
+
+The concurrency pass (concurrency.py) needs to answer "while holding
+lock L in function f, which other locks can be acquired and which
+blocking calls can run?" — and the acquisition/blocking site is very
+often one or two calls away from the `with self._lock:` region (e.g.
+`ServingStats.mark_warmup_done` holds its own lock while calling
+`exec_cache.cache_stats()`, which takes the cache lock). This module
+builds the call graph that makes that walk possible.
+
+"Best-effort" is a design point, not an apology: Python call targets
+are not statically decidable, so resolution is *conservative* — a call
+is resolved only when the target is unambiguous, and left out of the
+graph otherwise. The supported shapes cover the package's idioms:
+
+  - `fn(...)`            same-file top-level function, or an imported
+                         one (absolute and package-relative imports)
+  - `mod.fn(...)`        module resolved through the import map
+  - `self.meth(...)`     method of the enclosing class, following
+                         textual base-class chains
+  - `self.a.b.meth(...)` attribute types inferred from
+                         `self.a = ClassName(...)` assignments
+  - `x.meth(...)`        local `x = ClassName(...)` in the same scope
+  - `super().meth(...)`  first base class that defines `meth`
+  - `ClassName(...)`     resolves to `ClassName.__init__`
+
+A miss yields no edge (the analysis stays quiet) — never a wrong edge.
+Stdlib-only, like the rest of the analyzer: `tools/mxlint.py` loads it
+without importing jax or the framework package.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+_MAX_BASE_DEPTH = 8
+
+
+@dataclass
+class FunctionInfo:
+    """One def: module-level function, method, or nested def."""
+
+    relpath: str
+    qualname: str                 # "Class.method" / "fn" / "fn.inner"
+    cls: str | None               # enclosing class name, if a method
+    node: ast.AST
+
+    @property
+    def key(self):
+        return (self.relpath, self.qualname)
+
+
+@dataclass
+class ClassInfo:
+    relpath: str
+    name: str
+    node: ast.AST
+    bases: list = field(default_factory=list)     # textual base names
+    methods: dict = field(default_factory=dict)   # name -> FunctionInfo
+    attr_types: dict = field(default_factory=dict)  # attr -> class key
+
+    @property
+    def key(self):
+        return (self.relpath, self.name)
+
+
+def module_name(relpath):
+    """'mxnet_tpu/serving/stats.py' -> 'mxnet_tpu.serving.stats';
+    package __init__ files name the package itself."""
+    p = relpath[:-3] if relpath.endswith(".py") else relpath
+    parts = p.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def imports_for(relpath, tree):
+    """Local name -> dotted path, with package-relative imports
+    (`from ..exec_cache import cache_stats`) resolved against the
+    file's own module path."""
+    mod_parts = module_name(relpath).split(".")
+    is_pkg = relpath.endswith("__init__.py")
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                keep = len(mod_parts) - node.level + (1 if is_pkg else 0)
+                if keep < 0:
+                    continue
+                base = ".".join(mod_parts[:keep])
+                modname = (f"{base}.{node.module}" if node.module and base
+                           else (node.module or base))
+            else:
+                modname = node.module or ""
+            for a in node.names:
+                out[a.asname or a.name] = (
+                    f"{modname}.{a.name}" if modname else a.name)
+    return out
+
+
+def dotted_name(node, imports):
+    """Resolve a Name/Attribute chain through the import map; None for
+    anything else (calls, subscripts, ...)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(imports.get(node.id, node.id))
+    return ".".join(reversed(parts))
+
+
+def attr_chain(node):
+    """`self.a.b.c` -> ('self', ['a', 'b', 'c']); None otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    return node.id, list(reversed(parts))
+
+
+class CallGraph:
+    """Functions, classes, and resolved call edges over many files."""
+
+    def __init__(self, files):
+        """files: iterable of (relpath, ast tree)."""
+        self.functions = {}        # (relpath, qualname) -> FunctionInfo
+        self.classes = {}          # (relpath, classname) -> ClassInfo
+        self.imports = {}          # relpath -> {name -> dotted}
+        self.calls = {}            # fn key -> [(callee key, lineno)]
+        self._mod_to_rel = {}      # dotted module -> relpath
+        self._cls_by_name = {}     # classname -> key, or None if dup
+        files = list(files)
+        for relpath, tree in files:
+            self._index_file(relpath, tree)
+        for relpath, tree in files:
+            self._infer_attr_types(relpath)
+        for info in self.functions.values():
+            self.calls[info.key] = self._resolve_calls(info)
+
+    # ------------------------------------------------------ indexing
+    def _index_file(self, relpath, tree):
+        self._mod_to_rel[module_name(relpath)] = relpath
+        self.imports[relpath] = imports_for(relpath, tree)
+
+        def walk(node, prefix, cls):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child,
+                              (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qn = f"{prefix}{child.name}"
+                    info = FunctionInfo(relpath, qn, cls, child)
+                    self.functions[info.key] = info
+                    if isinstance(node, ast.ClassDef):
+                        self.classes[(relpath, cls)].methods[
+                            child.name] = info
+                    walk(child, f"{qn}.", cls)
+                elif isinstance(child, ast.ClassDef):
+                    ci = ClassInfo(
+                        relpath, child.name, child,
+                        bases=[b for b in
+                               (dotted_name(x, self.imports[relpath])
+                                for x in child.bases) if b])
+                    self.classes[ci.key] = ci
+                    if child.name in self._cls_by_name and \
+                            self._cls_by_name[child.name] != ci.key:
+                        self._cls_by_name[child.name] = None  # ambiguous
+                    else:
+                        self._cls_by_name.setdefault(child.name, ci.key)
+                    walk(child, f"{prefix}{child.name}.", child.name)
+                else:
+                    walk(child, prefix, cls)
+
+        walk(tree, "", None)
+
+    def _infer_attr_types(self, relpath):
+        """self.attr = ClassName(...) anywhere in a class's methods."""
+        for ci in self.classes.values():
+            if ci.relpath != relpath:
+                continue
+            for meth in ci.methods.values():
+                for node in ast.walk(meth.node):
+                    if not (isinstance(node, ast.Assign)
+                            and isinstance(node.value, ast.Call)):
+                        continue
+                    ck = self._call_to_class(node.value, relpath)
+                    if ck is None:
+                        continue
+                    for tgt in node.targets:
+                        ch = attr_chain(tgt)
+                        if ch and ch[0] == "self" and len(ch[1]) == 1:
+                            ci.attr_types.setdefault(ch[1][0], ck)
+
+    def _call_to_class(self, call, relpath):
+        """The class a constructor call builds, if unambiguous."""
+        dn = dotted_name(call.func, self.imports[relpath])
+        if dn is None:
+            return None
+        r = self.resolve_dotted(dn, relpath)
+        if r and r[0] == "class":
+            return r[1]
+        return None
+
+    # ---------------------------------------------------- resolution
+    def resolve_dotted(self, dotted, relpath=None):
+        """dotted path -> ('func', key) | ('class', key) | None. Bare
+        names resolve in `relpath`'s own module first."""
+        parts = dotted.split(".")
+        if len(parts) == 1 and relpath is not None:
+            name = parts[0]
+            if (relpath, name) in self.functions:
+                return ("func", (relpath, name))
+            if (relpath, name) in self.classes:
+                return ("class", (relpath, name))
+            ck = self._cls_by_name.get(name)
+            if ck:
+                return ("class", ck)
+            return None
+        for i in range(len(parts) - 1, 0, -1):
+            rel = self._mod_to_rel.get(".".join(parts[:i]))
+            if rel is None:
+                continue
+            rest = parts[i:]
+            if len(rest) == 1:
+                if (rel, rest[0]) in self.functions:
+                    return ("func", (rel, rest[0]))
+                if (rel, rest[0]) in self.classes:
+                    return ("class", (rel, rest[0]))
+            elif len(rest) == 2 and (rel, rest[0]) in self.classes:
+                fi = self.method((rel, rest[0]), rest[1])
+                if fi is not None:
+                    return ("func", fi.key)
+            return None
+        return None
+
+    def resolve_base(self, base_name, relpath):
+        """Textual base-class name -> class key (same file, imports,
+        then globally-unique name)."""
+        r = self.resolve_dotted(base_name, relpath)
+        if r and r[0] == "class":
+            return r[1]
+        leaf = base_name.rsplit(".", 1)[-1]
+        return self._cls_by_name.get(leaf)
+
+    def method(self, class_key, name, _depth=0):
+        """Method lookup following textual base chains."""
+        ci = self.classes.get(class_key)
+        if ci is None or _depth > _MAX_BASE_DEPTH:
+            return None
+        if name in ci.methods:
+            return ci.methods[name]
+        for b in ci.bases:
+            bk = self.resolve_base(b, ci.relpath)
+            if bk and bk != class_key:
+                fi = self.method(bk, name, _depth + 1)
+                if fi is not None:
+                    return fi
+        return None
+
+    def attr_type(self, class_key, attr, _depth=0):
+        """Inferred class of `self.<attr>`, following base chains."""
+        ci = self.classes.get(class_key)
+        if ci is None or _depth > _MAX_BASE_DEPTH:
+            return None
+        if attr in ci.attr_types:
+            return ci.attr_types[attr]
+        for b in ci.bases:
+            bk = self.resolve_base(b, ci.relpath)
+            if bk and bk != class_key:
+                t = self.attr_type(bk, attr, _depth + 1)
+                if t is not None:
+                    return t
+        return None
+
+    def chain_type(self, class_key, attrs):
+        """Class key at the end of `self.<a>.<b>...`, or None."""
+        ck = class_key
+        for a in attrs:
+            ck = self.attr_type(ck, a) if ck else None
+            if ck is None:
+                return None
+        return ck
+
+    def local_types(self, fn_node, relpath):
+        """{var -> class key} for `x = ClassName(...)` assignments."""
+        out = {}
+        for node in ast.walk(fn_node):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            ck = self._call_to_class(node.value, relpath)
+            if ck is not None:
+                out[node.targets[0].id] = ck
+        return out
+
+    def resolve_call(self, call, relpath, cls, local_types):
+        """The callee's function key for one ast.Call, or None."""
+        imports = self.imports.get(relpath, {})
+        f = call.func
+        if isinstance(f, ast.Name):
+            r = self.resolve_dotted(
+                imports.get(f.id, f.id), relpath)
+            if r is None:
+                return None
+            if r[0] == "func":
+                return r[1]
+            fi = self.method(r[1], "__init__")
+            return fi.key if fi else None
+        if not isinstance(f, ast.Attribute):
+            return None
+        meth = f.attr
+        base = f.value
+        # super().meth(...)
+        if (isinstance(base, ast.Call)
+                and isinstance(base.func, ast.Name)
+                and base.func.id == "super" and cls is not None):
+            ci = self.classes.get((relpath, cls))
+            if ci:
+                for b in ci.bases:
+                    bk = self.resolve_base(b, relpath)
+                    if bk:
+                        fi = self.method(bk, meth)
+                        if fi is not None:
+                            return fi.key
+            return None
+        ch = attr_chain(base)
+        if ch is None:
+            return None
+        root, attrs = ch
+        if root == "self" and cls is not None:
+            ck = self.chain_type((relpath, cls), attrs) if attrs \
+                else (relpath, cls)
+            if ck:
+                fi = self.method(ck, meth)
+                if fi is not None:
+                    return fi.key
+            return None
+        if not attrs and root in local_types:
+            fi = self.method(local_types[root], meth)
+            return fi.key if fi else None
+        r = self.resolve_dotted(dotted_name(f, imports) or "", relpath)
+        if r and r[0] == "func":
+            return r[1]
+        if r and r[0] == "class":
+            fi = self.method(r[1], "__init__")
+            return fi.key if fi else None
+        return None
+
+    def _resolve_calls(self, info):
+        local = self.local_types(info.node, info.relpath)
+        out = []
+        root = info.node
+        stack = [root]
+        while stack:
+            node = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                if child is not root and isinstance(
+                        child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda, ast.ClassDef)):
+                    continue  # separate scope, analyzed on its own
+                if isinstance(child, ast.Call):
+                    key = self.resolve_call(
+                        child, info.relpath, info.cls, local)
+                    if key is not None and key != info.key:
+                        out.append((key, child.lineno))
+                stack.append(child)
+        return out
+
+    def callees(self, key):
+        return self.calls.get(key, [])

@@ -1,0 +1,677 @@
+"""Concurrency analysis: lock registry, held-before graph, MX006-MX008.
+
+The package is a fleet of cooperating threads (serving batcher,
+continuous-decode scheduler, loader workers, device prefetcher,
+telemetry exporter, kvstore heartbeats). MX004 checks local hygiene;
+this pass checks the *global* properties that make threaded code
+deadlock- and race-free:
+
+  MX006  blocking call while holding a lock — untimed queue get/put,
+         `Future.result()`, zero-arg `.join()`, `asnumpy`/
+         `device_get`/`block_until_ready`, socket sends, untimed
+         `.wait()` on a foreign Event/Condition, `time.sleep` at or
+         above SLEEP_THRESHOLD_S. Holding a lock across any of these
+         stalls every thread contending for it (and an untimed wait
+         whose producer needs that same lock is a deadlock).
+  MX007  lock-order inversion — a cycle in the held-before graph
+         (lock B acquired while A is held somewhere, A acquired while
+         B is held somewhere else). Reported with both acquisition
+         paths; two threads walking the two paths concurrently
+         deadlock.
+  MX008  a shared attribute written both inside and outside lock
+         regions of its class — the lock suggests the attribute is
+         lock-protected, the unlocked write says it is not; one of
+         the two sites is wrong.
+
+Mechanics: a lock registry discovers every lock-like attribute
+(`self._lock = threading.Lock()/RLock()/Condition()`), module-level
+locks, and queue/event attributes; `with <lock>:` regions are walked
+with the held-lock stack; the interprocedural half pushes each region
+through the call graph (callgraph.py) so an acquisition or blocking
+call one or two calls away is still attributed to the holding region.
+Resolution is conservative — unresolvable receivers/calls produce no
+finding rather than a wrong one.
+
+Like every mxlint rule, findings support inline suppression and the
+baseline (the engine applies both); this module is stdlib-only.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+try:  # normal package import
+    from . import callgraph as _cg
+    from .rules import RawFinding
+except ImportError:  # loaded standalone (tools/mxlint.py)
+    import callgraph as _cg
+    from rules import RawFinding
+
+#: `time.sleep(t)` with a constant t >= this, under a lock, is MX006.
+SLEEP_THRESHOLD_S = 0.005
+
+#: interprocedural walk depth (region -> callee -> callee ...)
+MAX_CALL_DEPTH = 6
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                ast.ClassDef)
+
+_LOCK_CTORS = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+    "threading.Condition": "condition",
+    "multiprocessing.Lock": "lock",
+    "multiprocessing.RLock": "rlock",
+}
+_QUEUE_CTORS = {"queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+                "queue.SimpleQueue"}
+_EVENT_CTORS = {"threading.Event", "threading.Barrier",
+                "threading.Semaphore", "threading.BoundedSemaphore"}
+
+#: attribute calls that force a host<->device round trip or block on
+#: another thread/endpoint regardless of arguments
+_ALWAYS_BLOCKING_ATTRS = {
+    "asnumpy": "fetches a device value (host<->device round trip)",
+    "wait_to_read": "blocks on device completion",
+    "block_until_ready": "blocks on device completion",
+    "sendall": "socket send can block on the peer",
+    "recv": "socket receive blocks on the peer",
+    "accept": "socket accept blocks on a connection",
+    "connect": "socket connect blocks on the network",
+}
+_BLOCKING_DOTTED = {
+    "jax.device_get": "fetches a device value",
+    "urllib.request.urlopen": "HTTP request blocks on the remote end",
+}
+
+_CTOR_EXEMPT_METHODS = ("__init__", "__new__", "__del__")
+
+
+# ---------------------------------------------------------------- model
+@dataclass(frozen=True)
+class LockId:
+    """Identity of one lock in the static graph: a class attribute
+    (`relpath`, `cls`, `attr`) or a module-level name (cls=None)."""
+
+    relpath: str
+    cls: object          # class name or None
+    attr: str
+
+    def __str__(self):
+        owner = f"{self.cls}." if self.cls else ""
+        return f"{self.relpath}:{owner}{self.attr}"
+
+
+@dataclass
+class LockInfo:
+    lid: LockId
+    kind: str            # "lock" | "rlock" | "condition"
+    line: int            # line of the `threading.Lock()` call
+
+
+@dataclass
+class Edge:
+    """Held-before edge: `dst` acquired while `src` is held."""
+
+    src: LockId
+    dst: LockId
+    relpath: str         # where the acquisition happens (anchor)
+    line: int
+    path: str            # human-readable acquisition path
+
+
+@dataclass
+class _Summary:
+    """Per-function facts for the interprocedural walk."""
+
+    acquires: list = field(default_factory=list)   # (LockId, line)
+    blocking: list = field(default_factory=list)   # (reason, line)
+
+
+class ConcurrencyModel:
+    """Lock registry + held-before graph + MX006/7/8 findings over a
+    set of parsed files ((relpath, tree) pairs)."""
+
+    def __init__(self, files):
+        self.files = [(r, t) for r, t in files]
+        self.graph = _cg.CallGraph(self.files)
+        self.locks = {}          # LockId -> LockInfo
+        self._class_locks = {}   # class key -> [LockId]
+        self._module_locks = {}  # (relpath, name) -> LockId
+        self._queues = {}        # (class key, attr) -> bounded: bool
+        self._events = set()     # (class key, attr)
+        self._conds = set()      # LockId with kind == "condition"
+        self._discover()
+        self.summaries = {}      # fn key -> _Summary
+        self._findings = []      # (relpath, RawFinding)
+        self.edges = []          # [Edge]
+        self._edge_index = {}    # (src, dst) -> Edge (first exemplar)
+        for info in self.graph.functions.values():
+            self.summaries[info.key] = self._summarize(info)
+        self._propagate()
+        self._check_inversions()
+        self._check_unlocked_writes()
+
+    # ---------------------------------------------------- discovery
+    def _discover(self):
+        for relpath, tree in self.files:
+            imports = self.graph.imports[relpath]
+            # module-level locks: NAME = threading.Lock()
+            for node in ast.iter_child_nodes(tree):
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)):
+                    continue
+                dn = _cg.dotted_name(node.value.func, imports)
+                kind = _LOCK_CTORS.get(dn)
+                if kind:
+                    lid = LockId(relpath, None, node.targets[0].id)
+                    self.locks[lid] = LockInfo(lid, kind, node.lineno)
+                    self._module_locks[(relpath, lid.attr)] = lid
+                    if kind == "condition":
+                        self._conds.add(lid)
+            # class attributes assigned in any method
+            for ci in self.graph.classes.values():
+                if ci.relpath != relpath:
+                    continue
+                for meth in ci.methods.values():
+                    for node in ast.walk(meth.node):
+                        if not (isinstance(node, ast.Assign)
+                                and isinstance(node.value, ast.Call)):
+                            continue
+                        dn = _cg.dotted_name(node.value.func, imports)
+                        if dn is None:
+                            continue
+                        for tgt in node.targets:
+                            ch = _cg.attr_chain(tgt)
+                            if not (ch and ch[0] == "self"
+                                    and len(ch[1]) == 1):
+                                continue
+                            attr = ch[1][0]
+                            kind = _LOCK_CTORS.get(dn)
+                            if kind:
+                                lid = LockId(relpath, ci.name, attr)
+                                self.locks.setdefault(
+                                    lid,
+                                    LockInfo(lid, kind, node.lineno))
+                                locks = self._class_locks.setdefault(
+                                    ci.key, [])
+                                if lid not in locks:
+                                    locks.append(lid)
+                                if kind == "condition":
+                                    self._conds.add(lid)
+                            elif dn in _QUEUE_CTORS:
+                                self._queues[(ci.key, attr)] = \
+                                    _bounded(node.value)
+                            elif dn in _EVENT_CTORS:
+                                self._events.add((ci.key, attr))
+
+    def class_locks(self, class_key):
+        """LockIds owned by a class, following base chains."""
+        out = list(self._class_locks.get(class_key, ()))
+        ci = self.graph.classes.get(class_key)
+        if ci:
+            for b in ci.bases:
+                bk = self.graph.resolve_base(b, ci.relpath)
+                if bk and bk != class_key:
+                    for lid in self._class_locks.get(bk, ()):
+                        if lid not in out:
+                            out.append(lid)
+        return out
+
+    def lock_sites(self):
+        """{(relpath, creation line) -> LockId} — the join key the
+        runtime witness uses to map dynamically-observed locks (keyed
+        by creation site) back onto the static graph."""
+        return {(i.lid.relpath, i.line): i.lid
+                for i in self.locks.values()}
+
+    # ---------------------------------------------- expr resolution
+    def _resolve_lock_expr(self, expr, relpath, cls):
+        """`with <expr>:` -> LockId, for self attrs (incl. inherited),
+        module-level names, and imported module locks."""
+        if isinstance(expr, ast.Name):
+            lid = self._module_locks.get((relpath, expr.id))
+            if lid:
+                return lid
+            dn = self.graph.imports.get(relpath, {}).get(expr.id)
+            if dn and "." in dn:
+                mod, name = dn.rsplit(".", 1)
+                rel = self.graph._mod_to_rel.get(mod)
+                if rel:
+                    return self._module_locks.get((rel, name))
+            return None
+        ch = _cg.attr_chain(expr)
+        if ch is None:
+            return None
+        root, attrs = ch
+        if root == "self" and cls is not None and attrs:
+            ck = self.graph.chain_type((relpath, cls), attrs[:-1]) \
+                if len(attrs) > 1 else (relpath, cls)
+            if ck:
+                for lid in self.class_locks(ck):
+                    if lid.attr == attrs[-1]:
+                        return lid
+            return None
+        if attrs:
+            # module attribute: `_trace._lock` via `from . import trace`
+            dn = _cg.dotted_name(expr,
+                                 self.graph.imports.get(relpath, {}))
+            if dn and "." in dn:
+                mod, name = dn.rsplit(".", 1)
+                rel = self.graph._mod_to_rel.get(mod)
+                if rel:
+                    return self._module_locks.get((rel, name))
+        return None
+
+    def _receiver_kind(self, recv, relpath, cls, local_queues):
+        """('queue', bounded) / ('event', None) / ('cond', LockId) /
+        None for the receiver of a .get/.put/.wait call."""
+        if isinstance(recv, ast.Name) and recv.id in local_queues:
+            return ("queue", local_queues[recv.id])
+        ch = _cg.attr_chain(recv)
+        if ch is None or ch[0] != "self" or cls is None or not ch[1]:
+            lid = self._resolve_lock_expr(recv, relpath, cls)
+            if lid is not None and lid in self._conds:
+                return ("cond", lid)
+            return None
+        attrs = ch[1]
+        ck = self.graph.chain_type((relpath, cls), attrs[:-1]) \
+            if len(attrs) > 1 else (relpath, cls)
+        if ck is None:
+            return None
+        attr = attrs[-1]
+        if (ck, attr) in self._queues:
+            return ("queue", self._queues[(ck, attr)])
+        if (ck, attr) in self._events:
+            return ("event", None)
+        for lid in self.class_locks(ck):
+            if lid.attr == attr and lid in self._conds:
+                return ("cond", lid)
+        return None
+
+    def _with_locks(self, node, relpath, cls):
+        """Resolved (LockId, line) pairs of one With's items."""
+        out = []
+        for item in node.items:
+            lid = self._resolve_lock_expr(item.context_expr,
+                                          relpath, cls)
+            if lid is not None:
+                out.append((lid, item.context_expr.lineno))
+        return out
+
+    # ---------------------------------------------------- summaries
+    def _summarize(self, info):
+        """Direct facts for one function: every lock region it enters
+        (with the held-stack maintained through arbitrary nesting),
+        every held-before edge it creates directly, every blocking
+        call (kept even when no lock is held — callers holding one
+        inherit it through propagation), and MX006 findings for
+        blocking calls directly under a held lock."""
+        s = _Summary()
+        relpath, cls = info.relpath, info.cls
+        local_queues = {}
+        for node in ast.walk(info.node):
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                dn = _cg.dotted_name(
+                    node.value.func, self.graph.imports[relpath])
+                if dn in _QUEUE_CTORS:
+                    local_queues[node.targets[0].id] = \
+                        _bounded(node.value)
+
+        def visit(node, held):
+            if isinstance(node, _SCOPE_NODES):
+                return  # separate scope, analyzed on its own
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                acquired = self._with_locks(node, relpath, cls)
+                for lid, line in acquired:
+                    s.acquires.append((lid, line))
+                    for h, _hl in held:
+                        if h != lid:
+                            self._add_edge(
+                                h, lid, relpath, line,
+                                f"{relpath}:{info.qualname} holds "
+                                f"{h} and takes {lid} at line {line}")
+                for stmt in node.body:
+                    visit(stmt, held + acquired)
+                return
+            if isinstance(node, ast.Call):
+                reason = self._blocking_reason(
+                    node, relpath, cls, local_queues, held)
+                if reason is not None:
+                    s.blocking.append((reason, node.lineno))
+                    if held:
+                        locks = ", ".join(str(h) for h, _ in held)
+                        self._findings.append((relpath, RawFinding(
+                            "MX006", node.lineno, node.col_offset,
+                            f"blocking call under lock ({locks}): "
+                            f"{reason}; release the lock first (copy "
+                            "state out, then block) or use a timed "
+                            "variant — every thread contending for "
+                            "the lock stalls behind this call")))
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for child in ast.iter_child_nodes(info.node):
+            visit(child, [])
+        return s
+
+    def _blocking_reason(self, call, relpath, cls, local_queues, held):
+        f = call.func
+        kw = {k.arg for k in call.keywords}
+        nargs = len(call.args)
+        dn = _cg.dotted_name(f, self.graph.imports.get(relpath, {}))
+        if dn == "time.sleep" and call.args:
+            v = call.args[0]
+            if (isinstance(v, ast.Constant)
+                    and isinstance(v.value, (int, float))
+                    and v.value >= SLEEP_THRESHOLD_S):
+                return (f"`time.sleep({v.value})` parks the thread "
+                        "with the lock held")
+            return None
+        if dn in _BLOCKING_DOTTED:
+            return f"`{dn}` {_BLOCKING_DOTTED[dn]}"
+        if isinstance(f, ast.Attribute):
+            a = f.attr
+            if a == "join" and nargs == 0 and "timeout" not in kw:
+                return ("untimed `.join()` waits forever on the "
+                        "target thread")
+            if a == "result" and nargs == 0 and "timeout" not in kw:
+                return ("untimed `Future.result()` waits forever on "
+                        "the producer")
+            if a in _ALWAYS_BLOCKING_ATTRS:
+                return f"`.{a}()` {_ALWAYS_BLOCKING_ATTRS[a]}"
+            if a in ("get", "put"):
+                rk = self._receiver_kind(f.value, relpath, cls,
+                                         local_queues)
+                if rk is None or rk[0] != "queue":
+                    return None
+                timed = "timeout" in kw or (
+                    nargs >= (2 if a == "get" else 3))
+                if a == "get" and not timed:
+                    return ("untimed `Queue.get()` blocks until a "
+                            "producer supplies an item")
+                if a == "put" and not timed and rk[1]:
+                    return ("untimed `Queue.put()` on a bounded "
+                            "queue blocks until a consumer drains it")
+                return None
+            if a == "wait" and nargs == 0 and "timeout" not in kw:
+                rk = self._receiver_kind(f.value, relpath, cls,
+                                         local_queues)
+                if rk is None:
+                    return None
+                if rk[0] == "cond":
+                    # waiting on a held condition releases that lock
+                    # while sleeping — only foreign locks stay held
+                    if any(h == rk[1] for h, _ in held):
+                        return None
+                    return ("untimed `Condition.wait()` on a foreign "
+                            "condition sleeps without releasing the "
+                            "held lock")
+                if rk[0] == "event":
+                    return ("untimed `Event.wait()` sleeps without "
+                            "releasing the held lock")
+        return None
+
+    # ------------------------------------------------- propagation
+    def _add_edge(self, src, dst, relpath, line, path):
+        key = (src, dst)
+        if key not in self._edge_index:
+            e = Edge(src, dst, relpath, line, path)
+            self._edge_index[key] = e
+            self.edges.append(e)
+
+    def _propagate(self):
+        """Push every held region through the call graph: a callee's
+        acquisitions become held-before edges, a callee's blocking
+        calls become MX006 at the call site in the holder."""
+        for info in self.graph.functions.values():
+            for held, calls in self._regions_with_calls(info):
+                for callee, line in calls:
+                    for g, path in self._reach(callee):
+                        gs = self.summaries.get(g)
+                        if gs is None:
+                            continue
+                        for lid, gl in gs.acquires:
+                            for h in held:
+                                if h != lid:
+                                    self._add_edge(
+                                        h, lid, info.relpath, line,
+                                        f"{info.relpath}:"
+                                        f"{info.qualname} holds {h}; "
+                                        f"call chain [{path}] "
+                                        f"acquires {lid} at "
+                                        f"{g[0]}:{gl}")
+                        for reason, gl in gs.blocking:
+                            locks = ", ".join(str(h) for h in held)
+                            self._findings.append((
+                                info.relpath, RawFinding(
+                                    "MX006", line, 0,
+                                    f"call chain [{path}] reaches a "
+                                    f"blocking call at {g[0]}:{gl} "
+                                    f"while holding {locks}: {reason}"
+                                    "; move the call outside the "
+                                    "lock region or make it timed")))
+
+    def _regions_with_calls(self, info):
+        """[(tuple of held LockIds, [(callee key, line), ...])] for
+        every `with <lock>` region of one function. Calls under a
+        nested region are attributed to every enclosing region (all
+        those locks are held at the call)."""
+        out = []
+        relpath, cls = info.relpath, info.cls
+        local = self.graph.local_types(info.node, relpath)
+
+        def visit(node, held):
+            if isinstance(node, _SCOPE_NODES):
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                acquired = [lid for lid, _l in
+                            self._with_locks(node, relpath, cls)]
+                inner = held + acquired
+                if acquired:
+                    calls = []
+                    for stmt in node.body:
+                        collect_calls(stmt, calls)
+                    if calls:
+                        out.append((tuple(inner), calls))
+                for stmt in node.body:
+                    visit(stmt, inner)
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        def collect_calls(node, acc):
+            if isinstance(node, _SCOPE_NODES):
+                return
+            if isinstance(node, ast.Call):
+                key = self.graph.resolve_call(node, relpath, cls,
+                                              local)
+                if key is not None and key != info.key:
+                    acc.append((key, node.lineno))
+            for child in ast.iter_child_nodes(node):
+                collect_calls(child, acc)
+
+        for child in ast.iter_child_nodes(info.node):
+            visit(child, [])
+        return out
+
+    def _reach(self, start):
+        """(fn key, path string) for `start` and everything it
+        transitively calls, depth-capped and deduplicated."""
+        out = []
+        seen = {start}
+        frontier = [(start, self._fn_label(start))]
+        depth = 0
+        while frontier and depth < MAX_CALL_DEPTH:
+            nxt = []
+            for key, path in frontier:
+                out.append((key, path))
+                for callee, _line in self.graph.callees(key):
+                    if callee not in seen:
+                        seen.add(callee)
+                        nxt.append(
+                            (callee,
+                             f"{path} -> {self._fn_label(callee)}"))
+            frontier = nxt
+            depth += 1
+        return out
+
+    @staticmethod
+    def _fn_label(key):
+        return f"{key[0]}:{key[1]}"
+
+    # ---------------------------------------------------- inversions
+    def _check_inversions(self):
+        """MX007: cycles in the held-before graph. Every 2-cycle is
+        reported with both acquisition paths; longer cycles once per
+        distinct lock set."""
+        adj = {}
+        for e in self.edges:
+            adj.setdefault(e.src, set()).add(e.dst)
+        reported = set()
+        for e in self.edges:
+            back = self._edge_index.get((e.dst, e.src))
+            if back is None:
+                continue
+            pair = frozenset((e.src, e.dst))
+            if pair in reported:
+                continue
+            reported.add(pair)
+            self._findings.append((e.relpath, RawFinding(
+                "MX007", e.line, 0,
+                f"lock-order inversion between {e.src} and {e.dst}: "
+                f"path A [{e.path}]; path B [{back.path}] "
+                f"({back.relpath}:{back.line}). Two threads running "
+                "the two paths concurrently deadlock — pick one "
+                "order and normalize both sites")))
+        for cyc in self._long_cycles(adj, reported):
+            hops = "; ".join(
+                f"[{self._edge_index[(a, b)].path}]"
+                for a, b in zip(cyc, cyc[1:] + cyc[:1]))
+            anchor = self._edge_index[(cyc[0], cyc[1])]
+            self._findings.append((anchor.relpath, RawFinding(
+                "MX007", anchor.line, 0,
+                f"lock-order cycle through {len(cyc)} locks "
+                f"({' -> '.join(str(c) for c in cyc)} -> {cyc[0]}): "
+                f"{hops}")))
+
+    def _long_cycles(self, adj, reported_pairs):
+        """Cycles of length >= 3 (one representative per lock set)."""
+        cycles = []
+        seen_sets = set(reported_pairs)
+        for start in sorted(adj, key=str):
+            stack = [(start, [start])]
+            while stack:
+                node, path = stack.pop()
+                for nxt in sorted(adj.get(node, ()), key=str):
+                    if nxt == start and len(path) >= 3:
+                        key = frozenset(path)
+                        if key not in seen_sets:
+                            seen_sets.add(key)
+                            cycles.append(list(path))
+                    elif nxt not in path and len(path) < 6:
+                        stack.append((nxt, path + [nxt]))
+        return cycles
+
+    # ---------------------------------------------- unlocked writes
+    def _check_unlocked_writes(self):
+        """MX008 per class owning at least one lock. Writes in
+        __init__/__new__/__del__ are exempt (construction and
+        teardown are single-threaded by contract)."""
+        for ci in self.graph.classes.values():
+            if not self.class_locks(ci.key):
+                continue
+            inside = {}   # attr -> (line, holding LockId)
+            outside = {}  # attr -> line
+            for name, meth in sorted(ci.methods.items()):
+                if name in _CTOR_EXEMPT_METHODS:
+                    continue
+                self._collect_writes(meth, ci, inside, outside)
+            for attr in sorted(set(inside) & set(outside)):
+                in_line, lid = inside[attr]
+                self._findings.append((ci.relpath, RawFinding(
+                    "MX008", outside[attr], 0,
+                    f"`self.{attr}` of {ci.name} is written under "
+                    f"{lid} (line {in_line}) but also without the "
+                    "lock here: the locked site implies the lock "
+                    "protects it, the unlocked one races with every "
+                    "reader that trusts the lock; move this write "
+                    "into the lock region (writes in __init__ are "
+                    "exempt — construction is single-threaded)")))
+
+    def _collect_writes(self, meth, ci, inside, outside):
+        relpath, cls = ci.relpath, ci.name
+
+        def note(stmt, held):
+            if isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                targets = [stmt.target]
+            else:
+                return
+            for tgt in targets:
+                ch = _cg.attr_chain(tgt)
+                if ch and ch[0] == "self" and len(ch[1]) == 1:
+                    attr = ch[1][0]
+                    if held:
+                        inside.setdefault(attr, (stmt.lineno, held[0]))
+                    else:
+                        outside.setdefault(attr, stmt.lineno)
+
+        def visit(node, held):
+            if isinstance(node, _SCOPE_NODES):
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                acquired = [lid for lid, _l in
+                            self._with_locks(node, relpath, cls)]
+                for stmt in node.body:
+                    visit(stmt, held + acquired)
+                return
+            note(node, held)
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for child in ast.iter_child_nodes(meth.node):
+            visit(child, [])
+
+    # ------------------------------------------------------- output
+    def findings(self):
+        """[(relpath, RawFinding)], deduplicated and sorted."""
+        seen = set()
+        out = []
+        for rel, f in self._findings:
+            key = (rel, f.rule, f.line, f.message)
+            if key not in seen:
+                seen.add(key)
+                out.append((rel, f))
+        out.sort(key=lambda x: (x[0], x[1].line, x[1].rule))
+        return out
+
+    def static_edges(self):
+        """{(src LockId, dst LockId)} — for the witness cross-check."""
+        return set(self._edge_index)
+
+
+def _bounded(call):
+    """True iff queue.Queue(maxsize=...) has a nonzero bound."""
+    args = list(call.args)
+    for k in call.keywords:
+        if k.arg == "maxsize":
+            args = [k.value]
+    if not args:
+        return False
+    v = args[0]
+    if isinstance(v, ast.Constant) and v.value in (0, None):
+        return False
+    return True
+
+
+def check_project(files):
+    """Engine entry point: [(relpath, RawFinding)] for MX006-MX008
+    over the given (relpath, tree) pairs."""
+    return ConcurrencyModel(files).findings()

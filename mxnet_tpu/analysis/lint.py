@@ -68,8 +68,10 @@ def _parse_suppressions(lines):
     return by_line, file_wide
 
 
-def lint_file(path, relpath, registered_envs, select=None):
-    """All non-suppressed findings for one file."""
+def lint_file(path, relpath, registered_envs, select=None, parsed=None):
+    """All non-suppressed per-file findings for one file. `parsed`
+    (optional out-dict) receives relpath -> (tree, lines) so the
+    project-scope concurrency pass reuses the parse."""
     with open(path, encoding="utf-8") as f:
         src = f.read()
     lines = src.splitlines()
@@ -80,6 +82,8 @@ def lint_file(path, relpath, registered_envs, select=None):
                         f"syntax error: {e.msg}",
                         lines[(e.lineno or 1) - 1].strip()
                         if lines else "")]
+    if parsed is not None:
+        parsed[relpath] = (tree, lines)
     ctx = _rules.FileContext(
         relpath=relpath, tree=tree, lines=lines,
         registered_envs=registered_envs)
@@ -101,23 +105,59 @@ def lint_file(path, relpath, registered_envs, select=None):
     return out
 
 
-def lint_paths(paths, root=None, select=None, extra_registry_paths=()):
+def lint_paths(paths, root=None, select=None, extra_registry_paths=(),
+               concurrency=True):
     """Lint every .py file under `paths`.
 
     `root` anchors repo-relative paths (defaults to the common parent);
     the env registry for MX003 is collected from the scanned files plus
     `extra_registry_paths` (canonically mxnet_tpu/utils/__init__.py,
-    so linting a subdirectory still sees the full registry)."""
+    so linting a subdirectory still sees the full registry).
+    `concurrency` runs the project-scope MX006-MX008 pass (one pass
+    over all parsed files, not per-file)."""
     root = os.path.abspath(root or os.getcwd())
     scan = [os.path.abspath(p) for p in paths]
     registered = _rules.collect_registered_envs(
         scan + [os.path.abspath(p) for p in extra_registry_paths])
     findings = []
+    parsed = {}
     for path in _rules._iter_py(scan):
         rel = os.path.relpath(path, root).replace(os.sep, "/")
-        findings.extend(lint_file(path, rel, registered, select=select))
+        findings.extend(lint_file(path, rel, registered, select=select,
+                                  parsed=parsed))
+    if concurrency and (not select
+                        or set(select) & set(_rules.PROJECT_RULES)):
+        findings.extend(_project_findings(parsed, select=select))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
+
+
+def _project_findings(parsed, select=None):
+    """MX006-MX008 over the whole parsed file set, routed through the
+    same inline suppressions as per-file rules (the baseline applies
+    downstream in run(), identically)."""
+    try:  # normal package import
+        from . import concurrency as _conc
+    except ImportError:  # loaded standalone (tools/mxlint.py)
+        import concurrency as _conc
+    raw_findings = _conc.check_project(
+        [(rel, tree) for rel, (tree, _lines) in sorted(parsed.items())])
+    supp = {}
+    out = []
+    for rel, raw in raw_findings:
+        if select and raw.rule not in select:
+            continue
+        _tree, lines = parsed[rel]
+        if rel not in supp:
+            supp[rel] = _parse_suppressions(lines)
+        by_line, file_wide = supp[rel]
+        if raw.rule in file_wide or raw.rule in by_line.get(raw.line, ()):
+            continue
+        text = (lines[raw.line - 1].strip()
+                if 0 < raw.line <= len(lines) else "")
+        out.append(Finding(raw.rule, rel, raw.line, raw.col,
+                           raw.message, text))
+    return out
 
 
 # ---------------------------------------------------------------- baseline
@@ -199,11 +239,12 @@ def render_json(new, baselined):
 
 
 def run(paths, root=None, baseline_path=None, fmt="text", select=None,
-        show_baselined=False, extra_registry_paths=()):
+        show_baselined=False, extra_registry_paths=(), concurrency=True):
     """One full lint pass. Returns (exit_code, report_text):
     exit code 1 iff any non-baselined finding exists."""
     findings = lint_paths(paths, root=root, select=select,
-                          extra_registry_paths=extra_registry_paths)
+                          extra_registry_paths=extra_registry_paths,
+                          concurrency=concurrency)
     baseline = {}
     if baseline_path and os.path.exists(baseline_path):
         baseline = load_baseline(baseline_path)

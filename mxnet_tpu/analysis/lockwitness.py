@@ -1,0 +1,383 @@
+"""Runtime lock witness: the dynamic half of the concurrency pass.
+
+The static analyzer (concurrency.py) proves lock discipline for the
+acquisition orders it can see; the witness checks the orders that
+actually happen. Installed (opt-in), it replaces the
+`threading.Lock`/`threading.RLock` factories with wrappers that record
+each thread's live acquisition stack, accumulate the dynamic
+held-before graph (keyed by lock *creation site* — file:line of the
+constructor call), and detect genuine cycles the moment the second
+half of an inversion executes — long before the interleaving that
+would actually deadlock.
+
+Because `queue.Queue`, `threading.Condition()` and `threading.Event()`
+all construct their internal locks through the `threading` module
+namespace at call time, patching the two factory attributes covers
+every lock-like object the package creates — no per-class
+instrumentation.
+
+Modes (registered env `MXNET_LOCK_WITNESS`, or `install(mode=...)`):
+
+  ""  / "off"     disabled — `threading.Lock` is the original factory,
+                  zero patching, zero overhead
+  "1" / "record"  record the graph; inversions land in `violations()`
+  "raise"         additionally raise `LockOrderViolation` at the
+                  acquisition that completes a cycle (the acquired
+                  lock is released first, so nothing leaks)
+
+Wrapper/Condition compatibility: the plain-Lock wrapper deliberately
+does NOT expose `_release_save`/`_acquire_restore`/`_is_owned`, so a
+`Condition` built over it falls back to plain `acquire`/`release` —
+which route through the wrapper and keep the held-stack exact across
+`Condition.wait` (the wait's release pops, the wake's re-acquire
+pushes). The RLock wrapper DOES expose them, delegating to the real
+RLock while saving/restoring its own recursion count.
+
+`cross_check()` joins the dynamic graph back onto the static one via
+`ConcurrencyModel.lock_sites()` so the CI soak can flag any witnessed
+edge the static pass missed. Stdlib-only.
+"""
+from __future__ import annotations
+
+import _thread
+import os
+import sys
+import threading
+
+__all__ = [
+    "LockOrderViolation", "install", "uninstall", "install_from_env",
+    "is_installed", "reset", "held_before_edges", "violations",
+    "cross_check",
+]
+
+
+class LockOrderViolation(RuntimeError):
+    """Acquiring this lock completed a cycle in the held-before graph."""
+
+
+# witness state. `_state_lock` is a raw _thread lock (never wrapped,
+# never witnessed) guarding the shared graph; the per-thread held
+# stack lives in TLS and needs no lock.
+_state_lock = _thread.allocate_lock()
+_tls = threading.local()
+_edges = {}        # (src site, dst site) -> "thread-name" (first witness)
+_adj = {}          # src site -> set(dst site)
+_violations = []   # [(cycle path [site, ...], thread-name)]
+_enabled = False
+_mode = "record"
+_orig = None       # (threading.Lock, threading.RLock) while installed
+
+_SKIP_SUFFIXES = (os.sep + "threading.py", os.sep + "queue.py",
+                  os.sep + "lockwitness.py")
+
+
+def _creation_site():
+    """(filename, lineno) of the frame that called the lock factory,
+    skipping stdlib threading/queue internals and this module — a
+    `queue.Queue()` in user code is witnessed as the user line, and
+    every lock a class creates at one source line shares one site
+    (matching the static LockId granularity)."""
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not fn.endswith(_SKIP_SUFFIXES):
+            return (fn, f.f_lineno)
+        f = f.f_back
+    return ("<unknown>", 0)
+
+
+def _held():
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+def _note_attempt(site):
+    """Record the held->site edges at the ATTEMPT to acquire — before
+    blocking on the real lock. This is the lockdep discipline: the
+    interleaving that actually deadlocks never completes its second
+    acquisition, so completion-time recording would witness nothing;
+    attempt-time recording sees the cycle and (in 'raise' mode) raises
+    instead of letting the thread block — the would-be deadlock
+    becomes a diagnosed exception. A failed try-acquire still records
+    its edges; that over-approximation is exactly the latent order
+    information the witness exists to collect."""
+    if not _enabled:
+        return
+    held = _held()
+    fresh = [(h, site) for h in held
+             if h != site and (h, site) not in _edges]
+    if not fresh:
+        return
+    tname = threading.current_thread().name
+    cycle = None
+    with _state_lock:
+        for e in fresh:
+            if e in _edges:     # lost a race to another thread
+                continue
+            _edges[e] = tname
+            _adj.setdefault(e[0], set()).add(e[1])
+            c = _find_cycle(e[1], e[0])
+            if c is not None:
+                cycle = [e[0]] + c[:-1]   # c ends at e[0]; keep it once
+                _violations.append((cycle, tname))
+    if cycle is not None and _mode == "raise":
+        raise LockOrderViolation(
+            "lock-order cycle witnessed at runtime: "
+            + " -> ".join(f"{f}:{l}" for f, l in cycle)
+            + f" -> {cycle[0][0]}:{cycle[0][1]} (thread {tname}); "
+            "two threads interleaving these paths deadlock")
+
+
+def _push(site):
+    if _enabled:
+        _held().append(site)
+
+
+def _note_release(site):
+    if not _enabled:
+        return
+    held = getattr(_tls, "held", None)
+    if held:
+        # out-of-order release is legal; drop the newest matching entry
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == site:
+                del held[i]
+                return
+
+
+def _find_cycle(start, target):
+    """Path start -> ... -> target in _adj (caller holds _state_lock),
+    or None. With the new edge target -> start already inserted, a hit
+    means a cycle."""
+    stack = [(start, [start])]
+    seen = {start}
+    while stack:
+        node, path = stack.pop()
+        for nxt in _adj.get(node, ()):
+            if nxt == target:
+                return path + [nxt]
+            if nxt not in seen and len(path) < 16:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+# ------------------------------------------------------------- wrappers
+class _WitnessLock:
+    """Wraps a real plain lock. No `_release_save`/`_acquire_restore`/
+    `_is_owned` — see the module docstring (Condition compatibility)."""
+
+    __slots__ = ("_inner", "_site")
+
+    def __init__(self, inner, site):
+        self._inner = inner
+        self._site = site
+
+    def acquire(self, blocking=True, timeout=-1):
+        _note_attempt(self._site)          # may raise: nothing held yet
+        # the wrapper IS the with-statement target; the raw
+        # delegation below is the one place it's legitimate
+        rc = self._inner.acquire(blocking, timeout)  # mxlint: disable=MX004
+        if rc:
+            _push(self._site)
+        return rc
+
+    def release(self):
+        _note_release(self._site)
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def _at_fork_reinit(self):
+        # stdlib modules (concurrent.futures.thread) re-init their
+        # module-level locks in forked children through this hook
+        self._inner._at_fork_reinit()
+
+    def __enter__(self):
+        self.acquire()  # mxlint: disable=MX004 — __exit__ releases
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return (f"<WitnessLock {self._site[0]}:{self._site[1]} "
+                f"wrapping {self._inner!r}>")
+
+
+class _WitnessRLock:
+    """Wraps a real RLock; witnessed once per outermost acquire. The
+    recursion count is only ever touched while the inner lock is owned,
+    so it needs no extra guard."""
+
+    __slots__ = ("_inner", "_site", "_count")
+
+    def __init__(self, inner, site):
+        self._inner = inner
+        self._site = site
+        self._count = 0
+
+    def acquire(self, blocking=True, timeout=-1):
+        if not self._inner._is_owned():    # outermost acquire only
+            _note_attempt(self._site)      # may raise: nothing held yet
+        rc = self._inner.acquire(blocking, timeout)  # mxlint: disable=MX004
+        if rc:
+            self._count += 1
+            if self._count == 1:
+                _push(self._site)
+        return rc
+
+    def release(self):
+        if self._count == 1:
+            _note_release(self._site)
+        self._count -= 1
+        self._inner.release()
+
+    def _at_fork_reinit(self):
+        self._inner._at_fork_reinit()
+        self._count = 0
+
+    def __enter__(self):
+        self.acquire()  # mxlint: disable=MX004 — __exit__ releases
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # Condition integration: full release across wait(), restore after
+    def _release_save(self):
+        count = self._count
+        self._count = 0
+        if count:
+            _note_release(self._site)
+        return (count, self._inner._release_save())
+
+    def _acquire_restore(self, state):
+        count, inner_state = state
+        if count:
+            _note_attempt(self._site)
+        self._inner._acquire_restore(inner_state)
+        self._count = count
+        if count:
+            _push(self._site)
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+    def __repr__(self):
+        return (f"<WitnessRLock {self._site[0]}:{self._site[1]} "
+                f"wrapping {self._inner!r}>")
+
+
+def _lock_factory():
+    return _WitnessLock(_thread.allocate_lock(), _creation_site())
+
+
+def _rlock_factory():
+    return _WitnessRLock(_real_rlock(), _creation_site())
+
+
+# the real RLock factory, captured at import (before any patching)
+_real_rlock = threading.RLock
+
+
+# ------------------------------------------------------------ lifecycle
+def install(mode="record"):
+    """Patch the threading lock factories. Idempotent; a second call
+    just updates the mode. Locks created before install are invisible
+    to the witness (they keep the real types)."""
+    global _orig, _enabled, _mode
+    if mode not in ("record", "raise"):
+        raise ValueError(f"unknown witness mode {mode!r}")
+    _mode = mode
+    if _orig is None:
+        _orig = (threading.Lock, threading.RLock)
+        threading.Lock = _lock_factory
+        threading.RLock = _rlock_factory
+    _enabled = True
+
+
+def uninstall():
+    """Restore the real factories and stop recording. Locks created
+    while installed stay wrapped but become pass-throughs (the
+    `_enabled` flag gates every note)."""
+    global _orig, _enabled
+    _enabled = False
+    if _orig is not None:
+        threading.Lock, threading.RLock = _orig
+        _orig = None
+
+
+def install_from_env(env=None):
+    """Honor MXNET_LOCK_WITNESS ('' / 'off' = disabled, '1'/'record',
+    'raise'). Returns the active mode or None."""
+    val = (env if env is not None
+           else os.environ.get("MXNET_LOCK_WITNESS", "")).strip().lower()
+    if val in ("", "0", "off", "false"):
+        return None
+    mode = "raise" if val == "raise" else "record"
+    install(mode)
+    return mode
+
+
+def is_installed():
+    return _orig is not None
+
+
+def reset():
+    """Clear the recorded graph and violations (keeps patching)."""
+    with _state_lock:
+        _edges.clear()
+        _adj.clear()
+        del _violations[:]
+    _tls.held = []
+
+
+def held_before_edges():
+    """{(src site, dst site) -> first-witnessing thread name}; a site
+    is (filename, lineno) of the lock's constructor call."""
+    with _state_lock:
+        return dict(_edges)
+
+
+def violations():
+    """[(cycle [site, ...], thread name)] witnessed so far (every mode
+    records; 'raise' additionally throws at the closing acquisition)."""
+    with _state_lock:
+        return list(_violations)
+
+
+# ------------------------------------------------- static cross-check
+def cross_check(model, repo_root):
+    """Join the dynamic graph onto a static ConcurrencyModel: returns
+    (matched, unmatched) where `matched` is [(src LockId, dst LockId)]
+    dynamic edges confirmed or newly discovered relative to
+    `model.static_edges()` is left to the caller; `unmatched` is the
+    dynamic edges whose creation sites the static model has no LockId
+    for (locks it could not see)."""
+    sites = model.lock_sites()   # (relpath, line) -> LockId
+    root = os.path.abspath(repo_root)
+    matched, unmatched = [], []
+    for (a, b) in held_before_edges():
+        la = _site_to_lock(a, sites, root)
+        lb = _site_to_lock(b, sites, root)
+        if la is not None and lb is not None:
+            if la != lb:
+                matched.append((la, lb))
+        else:
+            unmatched.append((a, b))
+    return matched, unmatched
+
+
+def _site_to_lock(site, sites, root):
+    fn, line = site
+    try:
+        rel = os.path.relpath(os.path.abspath(fn), root)
+    except ValueError:
+        return None
+    return sites.get((rel.replace(os.sep, "/"), line))

@@ -343,9 +343,75 @@ def check_mx003(ctx):
 # --------------------------------------------------------------------------
 # MX004 — concurrency hygiene
 # --------------------------------------------------------------------------
+_COND_CTORS = {"threading.Condition", "multiprocessing.Condition"}
+_EVENT_CTORS = {"threading.Event", "multiprocessing.Event"}
+
+
+def _sync_prims(tree, imports):
+    """({self-attr}, {local name}) pairs for Condition and Event
+    objects constructed in this file."""
+    cond_self, cond_local, event_self, event_local = (
+        set(), set(), set(), set())
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        dn = _dotted(node.value.func, imports)
+        if dn not in _COND_CTORS and dn not in _EVENT_CTORS:
+            continue
+        is_cond = dn in _COND_CTORS
+        for tgt in node.targets:
+            if (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                (cond_self if is_cond else event_self).add(tgt.attr)
+            elif isinstance(tgt, ast.Name):
+                (cond_local if is_cond else event_local).add(tgt.id)
+    return cond_self, cond_local, event_self, event_local
+
+
 def check_mx004(ctx):
     imports = _import_map(ctx.tree)
     findings = []
+    cond_self, cond_local, event_self, event_local = _sync_prims(
+        ctx.tree, imports)
+
+    def prim_kind(recv):
+        if (isinstance(recv, ast.Attribute)
+                and isinstance(recv.value, ast.Name)
+                and recv.value.id == "self"):
+            if recv.attr in cond_self:
+                return "cond"
+            if recv.attr in event_self:
+                return "event"
+        elif isinstance(recv, ast.Name):
+            if recv.id in cond_local:
+                return "cond"
+            if recv.id in event_local:
+                return "event"
+        return None
+
+    # every Call node lexically inside a While body — the sanctioned
+    # home for Condition.wait (re-test the predicate after waking)
+    in_while = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.While):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    in_while.add(id(sub))
+
+    # hot-path coverage for the Event.wait check
+    manifest = HOT_PATH_MANIFEST.get(ctx.relpath)
+    hot_calls = set()
+    if manifest is not None:
+        for fn_node, qn in _qualnames(ctx.tree):
+            if manifest == "*" or any(
+                    qn == m or qn.startswith(m + ".")
+                    for m in manifest):
+                for sub in ast.walk(fn_node):
+                    if isinstance(sub, ast.Call):
+                        hot_calls.add(id(sub))
+
     for node in ast.walk(ctx.tree):
         if isinstance(node, ast.ExceptHandler) and node.type is None:
             findings.append(RawFinding(
@@ -371,6 +437,26 @@ def check_mx004(ctx):
                     "raw `.acquire()`: an exception before the matching "
                     "release() leaves the lock held forever; use "
                     "`with lock:`"))
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "wait"):
+                kind = prim_kind(node.func.value)
+                timed = (node.args or any(
+                    k.arg == "timeout" for k in node.keywords))
+                if kind == "cond" and id(node) not in in_while:
+                    findings.append(RawFinding(
+                        "MX004", node.lineno, node.col_offset,
+                        "`Condition.wait()` outside a `while`-predicate "
+                        "loop: wakeups can be spurious and notify_all "
+                        "races the predicate — always re-test in a loop "
+                        "(`while not pred: cond.wait(...)`)"))
+                elif (kind == "event" and not timed
+                        and id(node) in hot_calls):
+                    findings.append(RawFinding(
+                        "MX004", node.lineno, node.col_offset,
+                        "untimed `Event.wait()` in a hot-path-manifest "
+                        "function: if the setter dies this thread parks "
+                        "forever with no diagnostic; use a timeout and "
+                        "re-check liveness"))
     return findings
 
 
@@ -433,6 +519,17 @@ ALL_RULES = {
     "MX003": (check_mx003, "unregistered MXNET_* environment read"),
     "MX004": (check_mx004, "concurrency hygiene"),
     "MX005": (check_mx005, "nondeterministic draw / wall-clock key"),
+}
+
+#: project-scope rules — computed once over the whole tree by
+#: analysis.concurrency (they need the interprocedural call graph, not
+#: one file), but registered here so --select/--list-rules see a single
+#: rule namespace. The engine routes their findings through the same
+#: per-file suppressions and baseline as MX001-MX005.
+PROJECT_RULES = {
+    "MX006": "blocking call while holding a lock",
+    "MX007": "lock-order inversion (held-before cycle)",
+    "MX008": "attribute written both inside and outside its lock",
 }
 
 

@@ -121,9 +121,12 @@ class DevicePrefetchIter(DataIter):
 
     def _start(self, fill_timeout=10.0):
         self._stop = threading.Event()
-        self._exhausted = False
-        self._error = None
-        self._staged.clear()
+        with self._cond:
+            # a prior stager that outlived _halt's bounded join may
+            # still be alive and flips these flags under the cond
+            self._exhausted = False
+            self._error = None
+            self._staged.clear()
         self._thread = threading.Thread(
             target=self._stage_loop, args=(self._stop,), daemon=True)
         self._thread.start()
@@ -146,9 +149,10 @@ class DevicePrefetchIter(DataIter):
         if self._thread is not None:
             self._thread.join(timeout)
             self._thread = None
-        self._staged.clear()
-        self._exhausted = False
-        self._error = None
+        with self._cond:
+            self._staged.clear()
+            self._exhausted = False
+            self._error = None
 
     # ---------------------------------------------------------- consumer
     def next(self):

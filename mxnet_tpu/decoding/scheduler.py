@@ -238,9 +238,12 @@ class ContinuousScheduler:
         if seq.table is not None:
             self.engine.allocator.free(seq.table)
             seq.table = None
-        for row, s in enumerate(self._rows):
-            if s is seq:
-                self._rows[row] = None
+        with self._cond:
+            # rows are loop-thread-owned but read under the cond by
+            # depth(); publish the clear through the same lock
+            for row, s in enumerate(self._rows):
+                if s is seq:
+                    self._rows[row] = None
         if exc is not None:
             seq.future._fail(exc)
         else:
@@ -260,11 +263,11 @@ class ContinuousScheduler:
         if seq.table is not None:
             self.engine.allocator.free(seq.table)
             seq.table = None
-        for row, s in enumerate(self._rows):
-            if s is seq:
-                self._rows[row] = None
         seq.preempted = True
         with self._cond:
+            for row, s in enumerate(self._rows):
+                if s is seq:
+                    self._rows[row] = None
             self._waiting.append(seq)
         self.stats.note_preempted()
 
@@ -339,8 +342,9 @@ class ContinuousScheduler:
                         self._waiting.append(seq)
                     return
             seq.table = alloc.alloc(need)
-            row = self._rows.index(None)
-            self._rows[row] = seq
+            with self._cond:
+                row = self._rows.index(None)
+                self._rows[row] = seq
             t0 = _trace.now()
             first = self.engine.prefill(tokens, seq.table)
             dt = _trace.now() - t0

@@ -224,14 +224,20 @@ class CompiledGraph:
     def jit_train_step(self):
         fn = self._jit_train
         if fn is None:
+            # probe donation support before taking the build lock: the
+            # probe blocks on a device round-trip, and holding
+            # _build_lock across it would stall every concurrent
+            # jit_fwd/jit_train_step on this graph behind the device
+            donate_ok = donation_effective()
             with self._build_lock:
                 fn = self._jit_train
                 if fn is None:
-                    fn = self._jit_train = self._build_train_step()
+                    fn = self._jit_train = self._build_train_step(
+                        donate_ok)
                     _note_jit_build()
         return fn
 
-    def _build_train_step(self):
+    def _build_train_step(self, donate_ok):
         run_graph = self.run_graph
         grad_names = list(self.grad_names)
         mirror = self.mirror
@@ -259,7 +265,7 @@ class CompiledGraph:
         # backend honors it. arg/aux buffers CANNOT be donated here: on
         # the eager path they are the user-visible NDArrays of
         # arg_dict/grad_dict (the caller may read them after forward).
-        donate = (3,) if donation_effective() else ()
+        donate = (3,) if donate_ok else ()
         return jax.jit(train_step, donate_argnums=donate)
 
     # ----------------------------------------------------- head grads

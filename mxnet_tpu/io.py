@@ -189,6 +189,7 @@ class PrefetchingIter(DataIter):
         self.started = True
         self.current_batch = [None for _ in range(self.n_iter)]
         self.next_batch = [None for _ in range(self.n_iter)]
+        self._errors = [None for _ in range(self.n_iter)]
 
         def prefetch_func(self, i):
             while True:
@@ -199,6 +200,16 @@ class PrefetchingIter(DataIter):
                     self.next_batch[i] = self.iters[i].next()
                 except StopIteration:
                     self.next_batch[i] = None
+                except Exception as exc:  # noqa: BLE001 — re-raised
+                    # a dying producer must never strand the consumer:
+                    # publish the error and STILL signal data_ready, so
+                    # iter_next's wait() wakes and re-raises instead of
+                    # blocking forever on an event nobody will set
+                    self.next_batch[i] = None
+                    self._errors[i] = exc
+                    self.data_taken[i].clear()
+                    self.data_ready[i].set()
+                    break
                 self.data_taken[i].clear()
                 self.data_ready[i].set()
 
@@ -291,6 +302,7 @@ class PrefetchingIter(DataIter):
             raise MXNetError("PrefetchingIter is closed")
         for e in self.data_ready:
             e.wait()
+        self._raise_producer_error()
         for i in self.iters:
             i.reset()
         for e in self.data_ready:
@@ -298,11 +310,19 @@ class PrefetchingIter(DataIter):
         for e in self.data_taken:
             e.set()
 
+    def _raise_producer_error(self):
+        errs = [e for e in self._errors if e is not None]
+        if errs:
+            self.close()
+            raise MXNetError(
+                "prefetch producer thread died") from errs[0]
+
     def iter_next(self):
         if self._closed:
             return False
         for e in self.data_ready:
             e.wait()
+        self._raise_producer_error()
         if self.next_batch[0] is None:
             for i in self.next_batch:
                 assert i is None, "Number of entry mismatches between iterators"

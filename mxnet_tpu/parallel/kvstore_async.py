@@ -166,8 +166,16 @@ class KVStoreDistAsync(KVStoreTPU):
             target=beat, name="kv_heartbeat", daemon=True)
         self._hb_thread.start()
 
-    def close(self):
+    def close(self, timeout=10.0):
+        """Stop the heartbeat and (rank 0) co-hosted server threads.
+        The joins are BOUNDED: a thread wedged inside a coordination-
+        service RPC can no longer hang teardown (both are daemonic, so
+        a missed join only forfeits the orderly exit, not the
+        process)."""
         self._stop.set()
+        for t in (self._hb_thread, self._server):
+            if t is not None and t.is_alive():
+                t.join(timeout)
 
     # ------------------------------------------------------------ server
     def _ensure_server(self):

@@ -48,6 +48,9 @@ class Autotuner:
     def __init__(self, cache_path=None):
         self.cache_path = cache_path or _default_cache_path()
         self._lock = threading.Lock()
+        # records chosen by this process; every save persists the full
+        # set, so a lost disk write is healed by the next one
+        self._local = {}
 
     # ------------------------------------------------------ persistence
     def _load(self):
@@ -78,8 +81,14 @@ class Autotuner:
             platform = jax.default_backend()
         digest = symbol.canonical_signature()
         key = f"{digest}:{platform}"
-        with self._lock:
-            cached = self._load().get(key)
+        # disk I/O happens OUTSIDE self._lock: _load is a read of an
+        # atomically-replaced file and needs no exclusion, and holding
+        # a lock across filesystem latency stalls every other tuning
+        # thread. The lock guards only the in-memory merge below.
+        cached = self._load().get(key)
+        if cached is None:
+            with self._lock:
+                cached = self._local.get(key)
         if cached is not None and (cached.get("source") == "measured"
                                    or not measure):
             return cached
@@ -100,12 +109,19 @@ class Autotuner:
                 record["measured_forward_s"] = step_s
                 record["source"] = "measured"
         with self._lock:
-            table = self._load()
-            table[key] = record
-            try:
-                self._save(table)
-            except OSError:
-                pass  # read-only cache dir: tuning still works, unpersisted
+            self._local[key] = record
+            pending = dict(self._local)
+        # best-effort persistence outside the lock: merge this
+        # process's full record set over the current disk table and
+        # replace atomically. A concurrent external writer can win the
+        # race for one save, but the next save here re-merges
+        # everything in _local, so a lost record only costs a re-tune.
+        table = self._load()
+        table.update(pending)
+        try:
+            self._save(table)
+        except OSError:
+            pass  # read-only cache dir: tuning still works, unpersisted
         return record
 
     @staticmethod

@@ -269,6 +269,18 @@ class DynamicBatcher:
             self._closed = True
             self._cond.notify_all()
 
+    def drain_pending(self):
+        """Atomically remove and return every queued request. Teardown
+        owns failing the returned futures OUTSIDE the condition — the
+        batcher never invokes request callbacks under its own lock."""
+        with self._cond:
+            pending = [r for g in self._pending.values() for r in g]
+            for g in self._pending.values():
+                g.clear()
+            self._count = 0
+            self._cond.notify_all()
+        return pending
+
     def pop_expired(self, now=None):
         """Remove and return every queued request whose deadline has
         already passed. The worker calls this each wake-up, so an

@@ -314,15 +314,10 @@ class ModelServer:
             dm.close(drain=drain, timeout=timeout)
         for lane in lanes:
             if not drain:
-                # fail pending before the worker can flush them
-                with lane.batcher._cond:
-                    pending = [r for g in
-                               lane.batcher._pending.values()
-                               for r in g]
-                    for g in lane.batcher._pending.values():
-                        g.clear()
-                    lane.batcher._count = 0
-                for r in pending:
+                # fail pending before the worker can flush them; the
+                # batcher drains under its own cond, futures fail here
+                # outside it (a future callback may take other locks)
+                for r in lane.batcher.drain_pending():
                     r.future.set_exception(
                         ServerClosedError("server stopped"))
             lane.batcher.close()

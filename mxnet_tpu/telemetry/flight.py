@@ -77,12 +77,17 @@ def dump_flight_record(reason, exc=None, path=None):
         os.makedirs(d, exist_ok=True)
         path = os.path.join(
             d, f"flight-{os.getpid()}-{next(_seq)}.json")
-    rec = flight_record(reason, exc=exc)
-    tmp = f"{path}.tmp.{os.getpid()}"
     with _dump_lock:
-        with open(tmp, "w") as f:
-            json.dump(rec, f, default=str)
-        os.replace(tmp, path)  # atomic: never a torn record
+        # the lock serializes snapshot capture + rendering (two
+        # crashing threads each get a coherent record); the slow part
+        # — the disk write — happens OUTSIDE it, so one thread's dump
+        # never stalls behind another's fsync-speed I/O
+        rec = flight_record(reason, exc=exc)
+        payload = json.dumps(rec, default=str)
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "w") as f:
+        f.write(payload)
+    os.replace(tmp, path)  # atomic: never a torn record
     return path
 
 

@@ -344,3 +344,16 @@ register_env(
     "ServerBusyError (same backpressure contract as the one-shot "
     "serving tier).",
 )
+register_env(
+    "MXNET_LOCK_WITNESS", str, "",
+    "analysis: runtime lock witness "
+    "(mxnet_tpu.analysis.lockwitness). '' / 'off' = disabled (the "
+    "threading lock factories are untouched); '1' / 'record' = "
+    "record every thread's acquisition order into a dynamic "
+    "held-before graph, collecting lock-order cycles in "
+    "violations(); 'raise' = additionally raise LockOrderViolation "
+    "at the acquisition attempt that completes a cycle — the "
+    "would-be deadlock becomes a diagnosed exception instead of a "
+    "hang. On in the threaded test modules and the CI race-gate "
+    "soak (docs/analysis.md).",
+)

@@ -34,3 +34,33 @@ try:
     jax.config.update("jax_platforms", "cpu")
 except Exception:
     pass
+
+import pytest  # noqa: E402
+
+# Threaded test modules run under the runtime lock witness in raise
+# mode: a genuine lock-order cycle anywhere in serving/decoding/data/
+# telemetry surfaces as LockOrderViolation at the acquisition attempt
+# that completes it, instead of a rare hang. Witness-owned tests
+# (test_concurrency_analysis) manage install/uninstall themselves and
+# are excluded; everything else keeps the zero-overhead unpatched
+# factories.
+_WITNESS_MODULES = {
+    "test_serving", "test_decoding", "test_data_pipeline",
+    "test_telemetry",
+}
+
+
+@pytest.fixture(autouse=True)
+def _lock_witness(request):
+    if request.module.__name__ not in _WITNESS_MODULES:
+        yield
+        return
+    from mxnet_tpu.analysis import lockwitness
+
+    was_installed = lockwitness.is_installed()
+    lockwitness.install("raise")
+    try:
+        yield
+    finally:
+        if not was_installed:
+            lockwitness.uninstall()

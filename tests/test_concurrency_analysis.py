@@ -1,0 +1,422 @@
+"""Concurrency analysis: call graph, lock discovery, MX006-MX008
+triggers + suppressions, the upgraded MX004 wait rules, and the
+runtime lock witness (seeded inversion caught live; disabled path adds
+no patching). The static half is stdlib-only and is exercised in-
+process via the same standalone loading path tools/mxlint.py uses.
+"""
+import ast
+import os
+import sys
+import threading
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "mxnet_tpu", "analysis"))
+
+import callgraph  # noqa: E402
+import concurrency  # noqa: E402
+import lint  # noqa: E402
+import lockwitness  # noqa: E402
+import rules  # noqa: E402
+
+
+def _model(src, relpath="mxnet_tpu/mod.py"):
+    return concurrency.ConcurrencyModel([(relpath, ast.parse(src))])
+
+
+def _codes(model):
+    return [f.rule for _rel, f in model.findings()]
+
+
+# ------------------------------------------------------------ call graph
+def test_callgraph_resolves_methods_and_imports():
+    a = '''
+from mxnet_tpu.other import helper
+
+class Server:
+    def start(self):
+        self.loop()
+        helper()
+
+    def loop(self):
+        pass
+'''
+    b = '''
+def helper():
+    pass
+'''
+    g = callgraph.CallGraph([
+        ("mxnet_tpu/server.py", ast.parse(a)),
+        ("mxnet_tpu/other.py", ast.parse(b)),
+    ])
+    start = ("mxnet_tpu/server.py", "Server.start")
+    callees = {k for k, _line in g.callees(start)}
+    assert ("mxnet_tpu/server.py", "Server.loop") in callees
+    assert ("mxnet_tpu/other.py", "helper") in callees
+
+
+def test_callgraph_follows_attribute_types():
+    src = '''
+import threading
+
+class Inner:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def poke(self):
+        pass
+
+class Outer:
+    def __init__(self):
+        self.inner = Inner()
+
+    def run(self):
+        self.inner.poke()
+'''
+    g = callgraph.CallGraph([("mxnet_tpu/m.py", ast.parse(src))])
+    run = ("mxnet_tpu/m.py", "Outer.run")
+    assert ("mxnet_tpu/m.py", "Inner.poke") in {
+        k for k, _l in g.callees(run)}
+
+
+# -------------------------------------------------------- lock discovery
+def test_lock_registry_discovers_class_and_module_locks():
+    src = '''
+import threading
+
+_GLOBAL = threading.Lock()
+
+class Box:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._cond = threading.Condition()
+'''
+    m = _model(src)
+    kinds = {str(lid): info.kind for lid, info in m.locks.items()}
+    assert kinds == {
+        "mxnet_tpu/mod.py:_GLOBAL": "lock",
+        "mxnet_tpu/mod.py:Box._lock": "rlock",
+        "mxnet_tpu/mod.py:Box._cond": "condition",
+    }
+    # lock_sites joins creation line -> LockId for the witness
+    sites = m.lock_sites()
+    assert ("mxnet_tpu/mod.py", 4) in sites
+
+
+# ----------------------------------------------------------------- MX006
+def test_mx006_blocking_call_under_lock():
+    src = '''
+import threading
+import queue
+
+class W:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+
+    def bad(self):
+        with self._lock:
+            return self._q.get()
+
+    def good(self):
+        with self._lock:
+            return self._q.get(timeout=1.0)
+'''
+    m = _model(src)
+    assert _codes(m) == ["MX006"]
+    rel, f = m.findings()[0]
+    assert "Queue.get" in f.message and f.line == 12
+
+
+def test_mx006_interprocedural():
+    src = '''
+import threading
+import time
+
+class W:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def outer(self):
+        with self._lock:
+            self.inner()
+
+    def inner(self):
+        time.sleep(0.5)
+'''
+    m = _model(src)
+    assert _codes(m) == ["MX006"]
+    _rel, f = m.findings()[0]
+    assert "call chain" in f.message and "time.sleep" in f.message
+
+
+def test_mx006_suppression():
+    src = '''import threading
+import queue
+
+class W:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+
+    def bad(self):
+        with self._lock:
+            return self._q.get()  # mxlint: disable=MX006
+'''
+    parsed = {"mxnet_tpu/mod.py": (ast.parse(src), src.splitlines())}
+    assert lint._project_findings(parsed) == []
+
+
+# ----------------------------------------------------------------- MX007
+INVERSION_SRC = '''
+import threading
+
+class W:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def fwd(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def rev(self):
+        with self._b:
+            self.take_a()
+
+    def take_a(self):
+        with self._a:
+            pass
+'''
+
+
+def test_mx007_inversion_reports_both_paths():
+    m = _model(INVERSION_SRC)
+    assert _codes(m) == ["MX007"]
+    _rel, f = m.findings()[0]
+    assert "path A" in f.message and "path B" in f.message
+    assert "W.fwd" in f.message and "W.rev" in f.message
+
+
+def test_mx007_suppression_and_consistent_order_clean():
+    # the finding anchors at path A's acquisition (fwd's inner with)
+    sup = INVERSION_SRC.replace(
+        "        with self._a:\n            with self._b:\n",
+        "        with self._a:\n"
+        "            with self._b:  # mxlint: disable=MX007\n")
+    assert sup != INVERSION_SRC
+    parsed = {"mxnet_tpu/mod.py": (ast.parse(sup), sup.splitlines())}
+    assert lint._project_findings(parsed) == []
+    # same order in both methods -> no finding at all
+    clean = INVERSION_SRC.replace("with self._b:\n            self.take_a()",
+                                  "with self._a:\n            self.take_b()"
+                                  ).replace(
+        "def take_a(self):\n        with self._a:",
+        "def take_b(self):\n        with self._b:")
+    assert _codes(_model(clean)) == []
+
+
+# ----------------------------------------------------------------- MX008
+def test_mx008_write_outside_lock():
+    src = '''
+import threading
+
+class W:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def locked_write(self):
+        with self._lock:
+            self._n = 1
+
+    def unlocked_write(self):
+        self._n = 2
+'''
+    m = _model(src)
+    assert _codes(m) == ["MX008"]
+    _rel, f = m.findings()[0]
+    assert "_n" in f.message and f.line == 14
+
+
+def test_mx008_init_exempt_and_suppression():
+    src = '''
+import threading
+
+class W:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def locked_write(self):
+        with self._lock:
+            self._n = 1
+'''
+    assert _codes(_model(src)) == []
+    sup = '''import threading
+
+class W:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def locked_write(self):
+        with self._lock:
+            self._n = 1
+
+    def unlocked_write(self):
+        self._n = 2  # mxlint: disable=MX008
+'''
+    parsed = {"mxnet_tpu/mod.py": (ast.parse(sup), sup.splitlines())}
+    assert lint._project_findings(parsed) == []
+
+
+# --------------------------------------------------------- MX004 upgrade
+def _mx004(src, relpath="mxnet_tpu/mod.py"):
+    ctx = rules.FileContext(
+        relpath=relpath, tree=ast.parse(src), lines=src.splitlines(),
+        registered_envs=set())
+    return [f for f in rules.check_mx004(ctx)]
+
+
+def test_mx004_cond_wait_needs_while():
+    bad = '''
+import threading
+
+class W:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._ready = False
+
+    def wait_ready(self):
+        with self._cond:
+            if not self._ready:
+                self._cond.wait(1.0)
+'''
+    found = [f for f in _mx004(bad) if "while" in f.message]
+    assert len(found) == 1
+    good = bad.replace("if not self._ready:", "while not self._ready:")
+    assert not [f for f in _mx004(good) if "while" in f.message]
+
+
+def test_mx004_untimed_event_wait_on_hot_path():
+    src = '''
+import threading
+
+class DynamicBatcher:
+    def __init__(self):
+        self._evt = threading.Event()
+
+    def flush(self):
+        self._evt.wait()
+'''
+    # serving/batcher.py is '*' in the hot-path manifest
+    found = [f for f in _mx004(src, "mxnet_tpu/serving/batcher.py")
+             if "Event.wait" in f.message]
+    assert len(found) == 1
+    # same code off the manifest: clean
+    assert not [f for f in _mx004(src) if "Event.wait" in f.message]
+    timed = src.replace("self._evt.wait()", "self._evt.wait(0.5)")
+    assert not [f for f in _mx004(timed, "mxnet_tpu/serving/batcher.py")
+                if "Event.wait" in f.message]
+
+
+# --------------------------------------------------------------- witness
+def test_witness_disabled_path_adds_no_patching():
+    assert not lockwitness.is_installed()
+    orig_lock, orig_rlock = threading.Lock, threading.RLock
+    # env-driven install with the empty default is a no-op
+    assert lockwitness.install_from_env("") is None
+    assert lockwitness.install_from_env("off") is None
+    assert threading.Lock is orig_lock
+    assert threading.RLock is orig_rlock
+    lk = threading.Lock()
+    assert type(lk).__module__ == "_thread"
+
+
+def test_witness_records_and_raises_on_seeded_inversion():
+    lockwitness.install("raise")
+    try:
+        lockwitness.reset()
+        l1 = threading.Lock()
+        l2 = threading.Lock()
+        errs = []
+
+        def fwd():
+            try:
+                with l1:
+                    time.sleep(0.05)
+                    with l2:
+                        pass
+            except lockwitness.LockOrderViolation as e:
+                errs.append(e)
+
+        def rev():
+            time.sleep(0.02)
+            try:
+                with l2:
+                    with l1:
+                        pass
+            except lockwitness.LockOrderViolation as e:
+                errs.append(e)
+
+        t1 = threading.Thread(target=fwd, daemon=True)
+        t2 = threading.Thread(target=rev, daemon=True)
+        t1.start()
+        t2.start()
+        t1.join(10)
+        t2.join(10)
+        # attempt-time recording: the would-be deadlock resolves as a
+        # raised violation in one of the two threads, neither hangs
+        assert not t1.is_alive() and not t2.is_alive()
+        assert len(errs) == 1
+        assert "lock-order cycle" in str(errs[0])
+        assert lockwitness.violations()
+    finally:
+        lockwitness.uninstall()
+        lockwitness.reset()
+    assert not lockwitness.is_installed()
+
+
+def test_witness_condition_and_rlock_compat():
+    lockwitness.install("raise")
+    try:
+        lockwitness.reset()
+        r = threading.RLock()
+        with r:
+            with r:  # reentrant: no self-edge, no violation
+                pass
+        cond = threading.Condition()
+        flag = []
+
+        def waiter():
+            with cond:
+                while not flag:
+                    cond.wait(0.5)
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        with cond:
+            flag.append(1)
+            cond.notify_all()
+        t.join(10)
+        assert not t.is_alive()
+        assert not lockwitness.violations()
+    finally:
+        lockwitness.uninstall()
+        lockwitness.reset()
+
+
+def test_witness_cross_check_maps_sites_to_static_lockids():
+    src = INVERSION_SRC
+    relpath = "mxnet_tpu/mod.py"
+    m = _model(src, relpath)
+    sites = m.lock_sites()
+    # simulate a witnessed edge at the static creation lines
+    (line_a,) = [ln for (rel, ln), lid in sites.items()
+                 if lid.attr == "_a"]
+    lid = lockwitness._site_to_lock(
+        (os.path.join(ROOT, relpath), line_a), sites, ROOT)
+    assert lid is not None and lid.attr == "_a"

@@ -47,11 +47,17 @@ def main(argv=None):
                     help="also print baselined findings (text format)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
+    ap.add_argument("--no-concurrency", action="store_true",
+                    help="skip the project-scope MX006-MX008 pass "
+                         "(it builds a call graph over every scanned "
+                         "file; opt out in speed-sensitive hooks)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
         for code, (_fn, summary) in sorted(rules.ALL_RULES.items()):
             print(f"{code}  {summary}")
+        for code, summary in sorted(rules.PROJECT_RULES.items()):
+            print(f"{code}  {summary} [project-scope]")
         return 0
 
     select = {s.strip() for s in args.select.split(",") if s.strip()} \
@@ -64,7 +70,8 @@ def main(argv=None):
     if args.write_baseline:
         findings = lint.lint_paths(
             args.paths, root=ROOT,
-            select=select, extra_registry_paths=(REGISTRY_PATH,))
+            select=select, extra_registry_paths=(REGISTRY_PATH,),
+            concurrency=not args.no_concurrency)
         lint.write_baseline(findings, args.baseline)
         print(f"mxlint: wrote {len(findings)} finding(s) to "
               f"{args.baseline}")
@@ -75,7 +82,8 @@ def main(argv=None):
         baseline_path=None if args.no_baseline else args.baseline,
         fmt=args.format, select=select,
         show_baselined=args.show_baselined,
-        extra_registry_paths=(REGISTRY_PATH,))
+        extra_registry_paths=(REGISTRY_PATH,),
+        concurrency=not args.no_concurrency)
     print(report)
     return code
 

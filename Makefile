@@ -11,7 +11,8 @@ XLA8    := XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: all test nightly examples lint lint-check libs predict perl \
 	docs dryrun cache-check serving-check sync-check data-check \
-	passes-check telemetry-check decode-check race-check clean
+	passes-check telemetry-check decode-check race-check \
+	shard-check clean
 
 all: libs test
 
@@ -114,6 +115,14 @@ decode-check:
 # under MXNET_LOCK_WITNESS=raise
 race-check:
 	$(CPUENV) bash ci/check_concurrency.sh
+
+# sharding tier: test suite + runtime gates (bitwise training parity
+# across unsharded / dp-only / dp*tp*fsdp plans on exact arithmetic,
+# fsdp per-device storage <= 1/2 replicated, zero steady-state
+# retraces, pre-trace rejection of non-dividing explicit specs) +
+# storage/step-time bench gate on 8 virtual devices
+shard-check:
+	$(CPUENV) $(XLA8) bash ci/check_sharding.sh
 
 # multi-chip sharding dryrun (DP / SP+TP / PP / EP) on 8 virtual devices
 dryrun:

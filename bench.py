@@ -616,6 +616,100 @@ def _decode_bench(platform):
     })
 
 
+def _sharding_bench(platform):
+    """BENCH_MODE=sharding: plan-driven partitioned training A/B.
+
+    The same MLP trained under a replicated (dp-only) ShardingPlan and
+    under the combined {'data': 2, 'fsdp': 2, 'tp': 2} plan on the
+    8-device mesh: per-device parameter bytes (sharding metadata, the
+    fsdp win), steady-state step time for both arms, and trace growth
+    after warmup. Gate (ci/check_sharding.sh): fsdp bytes <= 1/2
+    replicated, zero steady-state traces."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import exec_cache
+    from mxnet_tpu.sharding import (ShardingPlan, device_param_bytes,
+                                    lower_stats)
+
+    import jax
+
+    if len(jax.devices()) < 8:
+        _emit({"mode": "sharding", "platform": platform,
+               "skipped": f"needs 8 devices, have {len(jax.devices())}"
+               " (XLA_FLAGS=--xla_force_host_platform_device_count=8)"})
+        return
+
+    batch, d_in, d_h, iters, warmup = 32, 64, 256, 10, 3
+
+    def build():
+        d = mx.sym.Variable("data")
+        h = mx.sym.FullyConnected(d, name="l0_up", num_hidden=d_h,
+                                  no_bias=True)
+        h = mx.sym.Activation(h, act_type="relu")
+        h = mx.sym.FullyConnected(h, name="l0_down", num_hidden=d_in,
+                                  no_bias=True)
+        return mx.sym.LinearRegressionOutput(h, name="lro")
+
+    rs = np.random.RandomState(0)
+    X = rs.uniform(-1, 1, (batch * 4, d_in)).astype("float32")
+    Y = rs.uniform(-1, 1, (batch * 4, d_in)).astype("float32")
+
+    def arm(plan):
+        it = mx.io.NDArrayIter(X, Y, batch_size=batch,
+                               label_name="lro_label")
+        mod = mx.mod.Module(build(), data_names=("data",),
+                            label_names=("lro_label",), sharding=plan)
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        mod.init_params(mx.initializer.Xavier())
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.01})
+
+        def epoch():
+            it.reset()
+            for b in it:
+                mod.forward_backward(b)
+                mod.update()
+        for _ in range(warmup):
+            epoch()
+        mod.sync()
+        t0, l0 = (exec_cache.cache_stats()["traces"],
+                  lower_stats()["jit_builds"])
+        tic = time.perf_counter()
+        for _ in range(iters):
+            epoch()
+        mod.sync()
+        steps = iters * (len(X) // batch)
+        step_us = (time.perf_counter() - tic) / steps * 1e6
+        traces_added = (exec_cache.cache_stats()["traces"] - t0
+                        + lower_stats()["jit_builds"] - l0)
+        fs = mod._fused_step
+        repl_bytes = sum(int(np.prod(v.shape)) * v.dtype.itemsize
+                         for v in fs.params.values())
+        return (round(step_us, 1), device_param_bytes(fs.params),
+                repl_bytes, traces_added)
+
+    dp_us, dp_dev_bytes, full_bytes, dp_traces = arm(
+        ShardingPlan({"data": 8}))
+    sh_us, sh_dev_bytes, _, sh_traces = arm(
+        ShardingPlan({"data": 2, "fsdp": 2, "tp": 2}))
+
+    _emit({
+        "mode": "sharding", "platform": platform, "batch": batch,
+        "mesh_dp": {"data": 8},
+        "mesh_sharded": {"data": 2, "fsdp": 2, "tp": 2},
+        "param_bytes_total": full_bytes,
+        "param_bytes_per_device_dp": dp_dev_bytes,
+        "param_bytes_per_device_sharded": sh_dev_bytes,
+        "storage_ratio": round(sh_dev_bytes / max(dp_dev_bytes, 1), 4),
+        "step_us_dp": dp_us,
+        "step_us_sharded": sh_us,
+        "traces_added": dp_traces + sh_traces,
+        "unit": "us/step",
+    })
+
+
 def main():
     # BENCH_XLA_FLAGS: extra XLA flags for A/B capture runs (e.g.
     # "--xla_tpu_enable_latency_hiding_scheduler=true"); appended
@@ -672,6 +766,8 @@ def main():
         return _passes_bench(jax.devices()[0].platform)
     if os.environ.get("BENCH_MODE", "train") == "decode":
         return _decode_bench(jax.devices()[0].platform)
+    if os.environ.get("BENCH_MODE", "train") == "sharding":
+        return _sharding_bench(jax.devices()[0].platform)
 
     import jax.numpy as jnp
     import numpy as np

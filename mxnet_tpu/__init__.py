@@ -74,6 +74,8 @@ from . import decoding
 from . import module
 from . import module as mod
 from . import parallel
+from . import sharding
+from .sharding import ShardingPlan
 from . import rnn
 from . import operator
 from . import test_utils

@@ -26,7 +26,8 @@ from . import graph_verify
 from . import callgraph
 from . import concurrency
 from . import lockwitness
-from .graph_verify import GraphIssue, GraphVerifyError, verify_graph
+from .graph_verify import (GraphIssue, GraphVerifyError, verify_graph,
+                           verify_sharding)
 from .lint import Finding, lint_file, lint_paths
 from .concurrency import ConcurrencyModel, LockId
 from .lockwitness import LockOrderViolation
@@ -35,6 +36,7 @@ __all__ = [
     "rules", "lint", "graph_verify",
     "callgraph", "concurrency", "lockwitness",
     "GraphIssue", "GraphVerifyError", "verify_graph",
+    "verify_sharding",
     "Finding", "lint_file", "lint_paths",
     "ConcurrencyModel", "LockId", "LockOrderViolation",
 ]

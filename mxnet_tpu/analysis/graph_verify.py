@@ -26,9 +26,17 @@ checks the NNVM pass pipeline would have:
                         Flatten / identity / BlockGrad): the fused
                         backward donates buffers (exec_cache), so the
                         aliased output can be invalidated in place
+  shard_divisibility    a ShardingPlan override pins a parameter dim
+                        to mesh axes whose product does not divide it
+                        (or names an axis absent from the mesh) — the
+                        jit would reject the NamedSharding deep inside
+                        lowering; verify_sharding names the parameter,
+                        the axis, and both sizes instead
 
 `Executor._build` calls this automatically under MXNET_GRAPH_VERIFY=1
-(tests/conftest.py turns it on for the whole suite).
+(tests/conftest.py turns it on for the whole suite);
+`Module.bind(..., sharding=plan)` calls `verify_sharding` before any
+trace happens.
 """
 from __future__ import annotations
 
@@ -54,7 +62,8 @@ class GraphVerifyError(MXNetError):
 @dataclass
 class GraphIssue:
     kind: str      # shape_contradiction | dtype_contradiction |
-    #                duplicate_arg | dead_node | donation_alias
+    #                duplicate_arg | dead_node | donation_alias |
+    #                shard_divisibility
     node: str      # offending node name
     message: str
 
@@ -102,6 +111,59 @@ def verify_graph(symbol, grad_names=None, dtypes=None, raise_on_issue=True,
         if not issues:
             issues += _check_shapes_dtypes(symbol, shapes, dtypes or {})
             issues += _check_donation_alias(symbol, grad_names or ())
+    if issues and raise_on_issue:
+        raise GraphVerifyError(issues)
+    return issues
+
+
+# ------------------------------------------------------------ sharding
+def verify_sharding(plan, shapes, raise_on_issue=True):
+    """Check a ShardingPlan's EXPLICIT overrides against concrete
+    parameter shapes, before any trace: every mesh axis an override
+    pins to a dim must exist in the plan's mesh and its (product)
+    size must divide that dim. Advisory rule-table specs are exempt —
+    `ShardingPlan.resolve` downgrades those silently; an override is
+    user intent and gets a named rejection instead of a jax lowering
+    error. Returns the GraphIssue list (raises GraphVerifyError when
+    `raise_on_issue` and any issue was found)."""
+    axis_sizes = plan.axis_sizes
+    issues = []
+    for name in sorted(shapes):
+        shape = tuple(shapes[name])
+        spec, explicit = plan.spec_for(name, ndim=len(shape))
+        if not explicit:
+            continue
+        dims = tuple(spec)
+        if len(dims) > len(shape):
+            issues.append(GraphIssue(
+                "shard_divisibility", name,
+                f"sharding override for {name!r} has {len(dims)} dim "
+                f"entries but the parameter has shape {shape}"))
+            continue
+        for pos, d in enumerate(dims):
+            if d is None:
+                continue
+            axes = d if isinstance(d, (tuple, list)) else (d,)
+            size = shape[pos]
+            for ax in axes:
+                n = axis_sizes.get(ax)
+                if n is None:
+                    issues.append(GraphIssue(
+                        "shard_divisibility", name,
+                        f"sharding override for {name!r} pins dim "
+                        f"{pos} to mesh axis {ax!r}, which is not in "
+                        f"the plan's mesh {axis_sizes}"))
+                    continue
+                if size % n != 0:
+                    issues.append(GraphIssue(
+                        "shard_divisibility", name,
+                        f"sharding override for {name!r} pins dim "
+                        f"{pos} (size {shape[pos]}) to mesh axis "
+                        f"{ax!r} of size {n}: {size} % {n} != 0 — "
+                        f"axis size must divide the dim"))
+                    size = 0  # suppress cascading per-axis noise
+                    break
+                size //= n
     if issues and raise_on_issue:
         raise GraphVerifyError(issues)
     return issues

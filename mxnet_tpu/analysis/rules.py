@@ -99,6 +99,15 @@ HOT_PATH_MANIFEST = {
         "DecodeStats.note_step", "DecodeStats.note_prefill",
         "DecodeStats.note_preempted", "DecodeStats.note_pool",
     ),
+    # sharding plan resolution + jit lowering (PR 11): resolve/digest
+    # run inside every bind (ahead of the exec-cache lookup) and the
+    # lower helpers run inside the fused-step trace — metadata only,
+    # never a device fetch
+    "mxnet_tpu/sharding/plan.py": (
+        "ShardingPlan.resolve", "ShardingPlan.named_shardings",
+        "ShardingPlan.digest", "ShardingPlan.compute_spec",
+    ),
+    "mxnet_tpu/sharding/lower.py": "*",
 }
 
 # Methods that force a host<->device round-trip (MX001).

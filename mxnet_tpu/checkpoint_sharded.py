@@ -49,6 +49,14 @@ def _tree(fs):
     }
 
 
+def _spec_meta(fs):
+    """{param: 'axis,axis'} from the fused step's bound specs."""
+    from .sharding.spec import spec_to_str
+
+    specs = getattr(fs, "_param_specs", None) or {}
+    return {n: spec_to_str(specs[n]) for n in sorted(specs)}
+
+
 def _data_state_file(path):
     # one state file PER PROCESS: each host's loader covers a different
     # shard, so each checkpoints (and restores) its own position
@@ -72,6 +80,11 @@ def save_sharded(mod, path, data_iter=None):
         "format": _FORMAT,
         "t": int(fs._t),
         "num_update": int(fs._opt.num_update),
+        # per-parameter storage layout at save time (spec_to_str of
+        # the bound plan/attr specs). Informational on load — orbax
+        # reshards onto the CURRENT layout — but recorded so a restore
+        # under different specs is visible, not silent.
+        "sharding": _spec_meta(fs),
     }
     if jax.process_index() == 0:
         import json
@@ -112,6 +125,20 @@ def load_sharded(mod, path, data_iter=None):
         raise MXNetError(f"unrecognized checkpoint format in {path}")
     if "t" not in meta or "num_update" not in meta:
         raise MXNetError(f"incomplete checkpoint meta in {meta_path}")
+    saved_specs = meta.get("sharding")
+    if saved_specs:
+        current = _spec_meta(fs)
+        changed = {n: (saved_specs[n], current[n])
+                   for n in saved_specs
+                   if n in current and current[n] != saved_specs[n]}
+        if changed:
+            import logging
+
+            logging.getLogger(__name__).info(
+                "restoring under different sharding specs (orbax "
+                "reshards on read): %s",
+                {n: f"{old} -> {new}"
+                 for n, (old, new) in sorted(changed.items())})
     target = jax.tree_util.tree_map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
                                        sharding=x.sharding)

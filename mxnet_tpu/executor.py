@@ -43,10 +43,15 @@ class Executor:
     outputs/arg_dict/reshape/monitor."""
 
     def __init__(self, symbol, ctx, args, args_grad, grad_req, aux_states,
-                 group2ctx=None, shared_exec=None):
+                 group2ctx=None, shared_exec=None, sharding=None):
         self._symbol = symbol
         self._ctx = ctx
         self._group2ctx = group2ctx or {}
+        # `sharding` is a ShardingPlan (or None): its digest joins the
+        # exec-cache key below, so rebinding one symbol under a
+        # different plan never lands on a compiled program whose
+        # in/out shardings were baked for another mesh/rule set
+        self._sharding_plan = sharding
         self.arg_dict = dict(args)
         self.grad_dict = dict(args_grad or {})
         self.aux_dict = dict(aux_states or {})
@@ -143,6 +148,8 @@ class Executor:
             tuple((n, self._grad_req.get(n, "null"))
                   for n in self._arg_names),
             tuple(self._grad_names),
+            (self._sharding_plan.digest()
+             if self._sharding_plan is not None else None),
             mirror,
         )
         if (shared_exec is not None

@@ -39,8 +39,10 @@ class FeedForward(object):
                  epoch_size=None, optimizer="sgd",
                  initializer=init.Uniform(0.01), numpy_batch_size=128,
                  arg_params=None, aux_params=None,
-                 allow_extra_params=False, begin_epoch=0, **kwargs):
+                 allow_extra_params=False, begin_epoch=0,
+                 sharding=None, **kwargs):
         self.symbol = symbol
+        self.sharding = sharding  # optional sharding.ShardingPlan
         self.ctx = ctx if ctx is not None else [cpu()]
         if not isinstance(self.ctx, (list, tuple)):
             self.ctx = [self.ctx]
@@ -76,6 +78,7 @@ class FeedForward(object):
             self.symbol, data_names=[d.name for d in data.provide_data],
             label_names=label_names or None, context=self.ctx,
             logger=logger or logging.getLogger(),
+            sharding=self.sharding,
         )
         mod.fit(
             data, eval_data=eval_data, eval_metric=eval_metric,

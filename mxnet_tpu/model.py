@@ -20,9 +20,11 @@ from . import symbol as sym
 from .base import MXNetError
 
 
-def _create_kvstore(kvstore, num_device, arg_params):
+def _create_kvstore(kvstore, num_device, arg_params, plan=None):
     """Create kvstore + decide whether to update on it (reference
-    model.py:40-66)."""
+    model.py:40-66). A sharding.ShardingPlan is attached to plan-aware
+    stores (kvstore('tpu')): their push/pull then pin values to the
+    plan's mesh instead of hopping through host."""
     from . import kvstore as kvs
 
     update_on_kvstore = True
@@ -47,6 +49,8 @@ def _create_kvstore(kvstore, num_device, arg_params):
         raise TypeError("kvstore must be KVStore, str or None")
     if kv is None:
         update_on_kvstore = False
+    elif plan is not None and hasattr(kv, "attach_plan"):
+        kv.attach_plan(plan)
     return (kv, update_on_kvstore)
 
 

@@ -69,12 +69,13 @@ class FusedTrainStep:
     def __init__(self, executor, optimizer, param_names, label_names=(),
                  mesh=None, data_axis="data", compute_dtype=None,
                  param_specs=None, data_specs=None, batch_scale=None,
-                 logger=logging):
+                 logger=logging, plan=None):
         self._ex = executor
         self._opt = optimizer
         self._logger = logger
         self._mesh = mesh
         self._data_axis = data_axis
+        self._plan = plan
         self._param_specs = dict(param_specs or {})
         self._data_specs = dict(data_specs or {})
         self._compute_dtype = (
@@ -173,6 +174,13 @@ class FusedTrainStep:
                     if n in self._data_specs else None)
                 for n in self._data_names
             }
+            # fsdp gather-before-use: parameters whose COMPUTE layout
+            # differs from storage (the plan's fsdp axis drops inside
+            # the step) get pinned via with_sharding_constraint in fwd;
+            # its vjp transpose IS the reduce-scatter after grad.
+            from ..sharding.lower import gather_shardings
+
+            self._gather_sh = gather_shardings(plan, self._param_specs)
             self.params = {
                 n: self._put(v, self._param_sh[n])
                 for n, v in self.params.items()
@@ -192,6 +200,7 @@ class FusedTrainStep:
             self._batch_sh = None
             self._param_sh = None
             self._data_sh = None
+            self._gather_sh = {}
 
         self._multi_cache = {}     # (k, stacked) -> jitted k-step loop
         self._multi_compiled = {}  # (k, stacked) -> AOT executable
@@ -264,6 +273,21 @@ class FusedTrainStep:
         labels = self._label_names
         bucket = self._bucket_plan()
         self._bucket_active = bucket is not None
+        gsh = self._gather_sh
+        mesh = self._mesh
+
+        def gather_c(tree):
+            """Pin fsdp-stored params to their compute layout inside
+            the trace (gather-before-use); the vjp transpose of this
+            constraint is the reduce-scatter of the gradients."""
+            if not gsh:
+                return tree
+            from ..sharding.lower import constrain
+
+            return {
+                k: (constrain(v, mesh, gsh[k]) if k in gsh else v)
+                for k, v in tree.items()
+            }
 
         def cast_c(x):
             """master -> compute dtype (params, auxs, float data).
@@ -289,10 +313,11 @@ class FusedTrainStep:
                 for k, v in data.items()
             }
             auxs_c = {k: cast_c(v) for k, v in auxs.items()}
-            frozen_c = {k: cast_c(v) for k, v in frozen_p.items()}
+            frozen_c = gather_c({k: cast_c(v)
+                                 for k, v in frozen_p.items()})
 
             def fwd(tp):
-                tp_c = {k: cast_c(v) for k, v in tp.items()}
+                tp_c = gather_c({k: cast_c(v) for k, v in tp.items()})
                 return run(
                     {**frozen_c, **tp_c, **data_c}, auxs_c, rng, True
                 )
@@ -402,7 +427,13 @@ class FusedTrainStep:
                 self._repl if self._nproc > 1 else None,
                 self._param_sh, state_sh, aux_sh,
             )
-        return jax.jit(step, **kwargs)
+        from ..sharding.lower import jit_sharded
+
+        return jit_sharded(
+            step,
+            in_shardings=kwargs.get("in_shardings"),
+            out_shardings=kwargs.get("out_shardings"),
+            donate_argnums=kwargs["donate_argnums"])
 
     # -------------------------------------------------------------- run
     def _place_data(self, data_vals):
@@ -530,7 +561,13 @@ class FusedTrainStep:
                 self._repl if self._nproc > 1 else None,
                 self._param_sh, state_sh, aux_sh,
             )
-        fn = jax.jit(multi, **kwargs)
+        from ..sharding.lower import jit_sharded
+
+        fn = jit_sharded(
+            multi,
+            in_shardings=kwargs.get("in_shardings"),
+            out_shardings=kwargs.get("out_shardings"),
+            donate_argnums=kwargs["donate_argnums"])
         self._multi_cache[key] = fn
         return fn
 

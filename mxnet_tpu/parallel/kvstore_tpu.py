@@ -33,6 +33,8 @@ Module uses when given a sharded executor (parallel/dp_step.py).
 """
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
@@ -47,12 +49,14 @@ from ..ndarray import NDArray
 from .._dist_bootstrap import maybe_init_distributed  # noqa: F401
 
 _BARRIER_PSUM = None
+_BARRIER_MESH = None  # (mesh, jitted sum) — the pmap-free barrier
 
 
 def _barrier_psum():
     """The barrier's pmapped psum, bound once: re-wrapping a fresh
     lambda in jax.pmap on every `_barrier()` call would retrace each
-    time (mxlint MX002)."""
+    time (mxlint MX002). FALLBACK path — the default barrier is the
+    mesh jit below (MXNET_SHARD_KV_MESH)."""
     global _BARRIER_PSUM
     if _BARRIER_PSUM is None:
         _BARRIER_PSUM = jax.pmap(
@@ -60,11 +64,52 @@ def _barrier_psum():
     return _BARRIER_PSUM
 
 
+def _barrier_mesh():
+    """Mesh-jit barrier program, bound once: a 1-D mesh over ALL
+    devices and a jitted sum whose input shards over it and whose
+    output replicates — the same forced rendezvous as the pmap psum,
+    lowered through the one jit chokepoint (sharding.lower) instead of
+    pmap. Returns (mesh, input NamedSharding, fn)."""
+    global _BARRIER_MESH
+    if _BARRIER_MESH is None:
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from ..sharding.lower import jit_sharded
+
+        mesh = Mesh(np.asarray(jax.devices()), ("dev",))
+        in_sh = NamedSharding(mesh, P("dev"))
+        fn = jit_sharded(jnp.sum, in_shardings=in_sh,
+                         out_shardings=NamedSharding(mesh, P()))
+        _BARRIER_MESH = (mesh, in_sh, fn)
+    return _BARRIER_MESH
+
+
 class KVStoreTPU(KVStore):
     def __init__(self, kv_type="tpu"):
         super().__init__(kv_type)
         maybe_init_distributed()
         self._barrier_count = 0
+        self._plan = None  # ShardingPlan, via attach_plan
+
+    def attach_plan(self, plan):
+        """Bind a sharding.ShardingPlan: pushed/pulled values are then
+        pinned to the plan's mesh (replicated) — semantically the
+        identity, but it keeps kvstore traffic on the mesh data plane
+        (an async reshard instead of a host hop) when the training step
+        itself is mesh-jitted. Module.init_optimizer calls this when a
+        plan is bound."""
+        self._plan = plan
+
+    def _pin_replicated(self, nd):
+        """merged/stored value -> same value pinned replicated on the
+        plan's mesh (no-op data-wise; async dispatch, no host sync)."""
+        if self._plan is None or jax.process_count() > 1:
+            return nd
+        from ..sharding.lower import constrain
+
+        return NDArray(constrain(nd._data, self._plan.mesh),
+                       ctx=nd.context)
 
     # --------------------------------------------------- dist push/pull
     _first_collective_done = False
@@ -267,10 +312,24 @@ class KVStoreTPU(KVStore):
                         merged = self._host_sum(merged)
                 else:
                     merged = self._host_sum(merged)
+            merged = self._pin_replicated(merged)
             if self._updater is not None:
                 self._updater(_str_key(k), merged, self._store[k])
             else:
                 merged.copyto(self._store[k])
+
+    def pull(self, key, out=None, priority=0):
+        """Broadcast stored values into the out arrays; with a plan
+        attached the stored value is first pinned replicated on the
+        plan's mesh (the mesh-path no-op — the copy then never leaves
+        the mesh data plane)."""
+        if self._plan is not None and jax.process_count() == 1:
+            keys, _ = _ctype_key_value(key, out)
+            for k in keys:
+                if k in self._store:
+                    self._store[k] = self._pin_replicated(
+                        self._store[k])
+        return super().pull(key, out=out, priority=priority)
 
     @property
     def rank(self):
@@ -282,13 +341,34 @@ class KVStoreTPU(KVStore):
         """(reference kvstore_dist.h:157 ps::NumWorkers)"""
         return jax.process_count()
 
-    def _barrier(self):
+    def _barrier(self, force=False):
         """(reference kvstore_dist.h:144 Postoffice::Barrier).
 
-        A tiny psum across all devices forces every process to reach this
-        point before any proceeds."""
-        if jax.process_count() == 1:
+        A tiny all-device reduction forces every process to reach this
+        point before any proceeds. Default implementation is the
+        mesh jit (`_barrier_mesh`) — in/out_shardings over a 1-D
+        all-device mesh, no pmap; MXNET_SHARD_KV_MESH=0 restores the
+        legacy pmapped psum. `force=True` runs the collective even
+        single-process (the mesh path is then exercisable in tests
+        without jax.distributed)."""
+        if jax.process_count() == 1 and not force:
             return
+        if os.environ.get("MXNET_SHARD_KV_MESH", "1") not in (
+                "0", "false", "off"):
+            try:
+                import numpy as np
+
+                _mesh, in_sh, fn = _barrier_mesh()
+                ones = np.ones((jax.local_device_count(),), np.float32)
+                if jax.process_count() > 1:
+                    x = jax.make_array_from_process_local_data(
+                        in_sh, ones)
+                else:
+                    x = jax.device_put(ones, in_sh)
+                jax.block_until_ready(fn(x))
+                return
+            except Exception:  # pragma: no cover - env-specific
+                pass  # legacy pmap barrier below
         x = jnp.ones((jax.local_device_count(),))
         jax.block_until_ready(_barrier_psum()(x))
 

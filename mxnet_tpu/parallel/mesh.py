@@ -23,6 +23,10 @@ MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 PIPE_AXIS = "pipe"
 EXPERT_AXIS = "expert"
+# GSPMD rule-layer axes (mxnet_tpu.sharding): ZeRO-style parameter
+# sharding and tensor parallelism over ONE mesh with 'data'.
+FSDP_AXIS = "fsdp"
+TP_AXIS = "tp"
 
 
 def data_parallel_mesh(n_devices=None):

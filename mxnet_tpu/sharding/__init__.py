@@ -1,0 +1,25 @@
+"""mxnet_tpu.sharding — GSPMD-style named-axis partitioning.
+
+One mesh ("data", "fsdp", "tp"), a rule table resolving parameter
+names to PartitionSpecs (spec.py), a ShardingPlan binding mesh + rules
+to a concrete Symbol's arg/aux/grad/optimizer-state trees (plan.py),
+and jit lowering with in/out_shardings + donate_argnums replacing pmap
+(lower.py). Entry points: ``Module(..., sharding=plan)`` /
+``Module.bind(..., sharding=plan)`` / ``FeedForward(...,
+sharding=plan)``. See docs/sharding.md.
+"""
+from .spec import (DATA_AXIS, DEFAULT_LAYOUT, DEFAULT_RULES, FSDP_AXIS,
+                   TP_AXIS, SpecLayout, parameter_spec_from_name,
+                   rules_digest, spec_to_str)
+from .plan import ShardingPlan
+from .lower import (constrain, device_param_bytes, gather_shardings,
+                    jit_sharded, lower_stats, reset_stats)
+
+__all__ = [
+    "DATA_AXIS", "FSDP_AXIS", "TP_AXIS",
+    "SpecLayout", "DEFAULT_LAYOUT", "DEFAULT_RULES",
+    "parameter_spec_from_name", "rules_digest", "spec_to_str",
+    "ShardingPlan",
+    "jit_sharded", "constrain", "gather_shardings",
+    "device_param_bytes", "lower_stats", "reset_stats",
+]

@@ -1,0 +1,109 @@
+"""jit lowering with in/out_shardings + donate_argnums — the pmap
+replacement (SNIPPETS.md [1]/[3] pjit idiom).
+
+Every sharded program in the stack lowers through `jit_sharded`: the
+fused train step (parallel/dp_step.py), the kvstore('tpu') mesh
+barrier, and ad-hoc callers. One chokepoint means ONE place that
+guarantees the pmap-free invariants: donation is always threaded
+through, meshless calls degrade to plain jit, and every build is
+counted (`lower_stats` — the shard tier's retrace gate reads it the
+way the exec-cache gates read `execCacheStats`).
+
+All helpers here are hot-path safe (mxlint HOT_PATH_MANIFEST): no
+device fetch, no blocking wait — `constrain` dispatches asynchronously
+and `device_param_bytes` reads sharding METADATA only.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+_lock = threading.Lock()
+_stats = {"jit_builds": 0, "constraints": 0}
+
+
+def lower_stats():
+    """Snapshot of lowering counters (builds must be zero in steady
+    state — each retrace would show up here)."""
+    with _lock:
+        return dict(_stats)
+
+
+def reset_stats():
+    with _lock:
+        for k in _stats:
+            _stats[k] = 0
+
+
+def jit_sharded(fn, in_shardings=None, out_shardings=None,
+                donate_argnums=(), static_argnums=None):
+    """jax.jit with the sharded-training calling convention. None
+    shardings are omitted (meshless fallback = plain jit), donation is
+    passed through, and the build is counted."""
+    kwargs = {}
+    if donate_argnums:
+        kwargs["donate_argnums"] = tuple(donate_argnums)
+    if static_argnums is not None:
+        kwargs["static_argnums"] = static_argnums
+    if in_shardings is not None:
+        kwargs["in_shardings"] = in_shardings
+    if out_shardings is not None:
+        kwargs["out_shardings"] = out_shardings
+    with _lock:
+        _stats["jit_builds"] += 1
+    return jax.jit(fn, **kwargs)
+
+
+def constrain(x, mesh, spec=None):
+    """Pin `x` to NamedSharding(mesh, spec). Inside a trace this is
+    `with_sharding_constraint` (a GSPMD hint compiled into the
+    program); on a concrete array it is an async device_put reshard.
+    mesh=None is the no-op fallback — callers keep one code path."""
+    if mesh is None:
+        return x
+    sh = spec if isinstance(spec, NamedSharding) else NamedSharding(
+        mesh, spec if spec is not None else PartitionSpec())
+    with _lock:
+        _stats["constraints"] += 1
+    if isinstance(x, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(x, sh)
+    return jax.device_put(x, sh)
+
+
+def gather_shardings(plan, param_specs):
+    """{name: NamedSharding} of the COMPUTE layout (fsdp axis dropped)
+    for every param whose storage spec differs from it — the
+    gather-before-use set the fused step pins inside its trace. Empty
+    when the plan has no fsdp axis or constraining is disabled."""
+    if plan is None or not plan.constrain_compute \
+            or not plan.uses_fsdp():
+        return {}
+    mesh = plan.mesh
+    out = {}
+    for name, spec in param_specs.items():
+        cspec = plan.compute_spec(spec)
+        if tuple(cspec) != tuple(spec):
+            out[name] = NamedSharding(mesh, cspec)
+    return out
+
+
+def device_param_bytes(params):
+    """Per-device bytes of a {name: jax.Array} tree, from sharding
+    metadata (shard_shape) — no device traffic. The fsdp acceptance
+    gate compares this against the replicated footprint."""
+    total = 0
+    for v in params.values():
+        shape = tuple(v.shape)
+        sh = getattr(v, "sharding", None)
+        if sh is not None:
+            try:
+                shape = tuple(sh.shard_shape(shape))
+            except Exception:
+                pass
+        n = 1
+        for d in shape:
+            n *= int(d)
+        total += n * v.dtype.itemsize
+    return int(total)

@@ -1,0 +1,240 @@
+"""ShardingPlan: one mesh + one rule table, bound to a concrete model.
+
+The plan is the single object a user hands to `Module(...,
+sharding=plan)` / `Module.bind(..., sharding=plan)` /
+`FeedForward(..., sharding=plan)`. It owns:
+
+  - the mesh ({axis: size} built lazily via parallel.mesh.make_mesh,
+    or a prebuilt jax Mesh),
+  - the rule layer (spec.SpecLayout + user overrides by glob),
+  - resolution of every parameter/input name to a fitted
+    PartitionSpec / NamedSharding (advisory rules downgrade axes that
+    are absent or do not divide; explicit overrides are enforced by
+    analysis.graph_verify.verify_sharding BEFORE any trace),
+  - fsdp semantics: storage specs keep the fsdp axis (parameters and
+    optimizer state live sharded, reduce-scatter after grad falls out
+    of the jit's sharded out_shardings); `compute_spec` drops it, and
+    the fused step pins parameters to it inside the trace —
+    gather-before-use as an explicit with_sharding_constraint
+    (MXNET_SHARD_CONSTRAIN_COMPUTE),
+  - a stable `digest()` that joins the exec-cache key so resharded
+    rebinds of one symbol never collide on a compiled program.
+
+The batch shards over every data-like axis in the mesh ('data' and
+'fsdp' together — fsdp devices consume distinct batch rows, which is
+what makes it ZeRO data parallelism rather than tensor parallelism).
+"""
+from __future__ import annotations
+
+import hashlib
+import math
+import os
+
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .spec import (DEFAULT_LAYOUT, parameter_spec_from_name,
+                   rules_digest, spec_to_str)
+
+
+def _fsdp_min_size():
+    # registered as MXNET_SHARD_FSDP_MIN_SIZE in mxnet_tpu.utils; read
+    # raw to keep plan resolution import-light
+    try:
+        return int(os.environ.get("MXNET_SHARD_FSDP_MIN_SIZE", "0"))
+    except ValueError:
+        return 0
+
+
+class ShardingPlan:
+    """Mesh + rules, resolvable against a Symbol's parameter trees.
+
+    `mesh` is {axis: size} (built lazily on first `.mesh` access so a
+    plan can be constructed before jax devices exist) or a prebuilt
+    `jax.sharding.Mesh`. `overrides` maps parameter-name globs to
+    PartitionSpecs (or the string syntax of
+    parallel.mesh.parse_partition_spec); exact names outrank globs.
+    """
+
+    def __init__(self, mesh, layout=None, overrides=None,
+                 constrain_compute=None):
+        if hasattr(mesh, "axis_names"):        # a prebuilt Mesh
+            self._mesh = mesh
+            self._axis_sizes = dict(
+                zip(mesh.axis_names, mesh.devices.shape))
+        else:
+            self._mesh = None
+            self._axis_sizes = {str(k): int(v)
+                                for k, v in dict(mesh).items()}
+            if any(v < 1 for v in self._axis_sizes.values()):
+                raise ValueError(
+                    f"mesh axis sizes must be >= 1: {self._axis_sizes}")
+        self.layout = layout or DEFAULT_LAYOUT
+        self.overrides = dict(overrides or {})
+        if constrain_compute is None:
+            constrain_compute = os.environ.get(
+                "MXNET_SHARD_CONSTRAIN_COMPUTE", "1") not in (
+                "0", "false", "off")
+        self.constrain_compute = bool(constrain_compute)
+        self._resolved = {}        # name -> fitted PartitionSpec
+        self._explicit = set()     # names resolved from an override
+
+    # ------------------------------------------------------------ mesh
+    @property
+    def axis_sizes(self):
+        """{axis: size} — available without building the device mesh
+        (verify_sharding runs off this, pre-trace)."""
+        return dict(self._axis_sizes)
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            from ..parallel.mesh import make_mesh
+
+            self._mesh = make_mesh(self._axis_sizes)
+        return self._mesh
+
+    def adopt_mesh(self, mesh):
+        """Bind to an externally-built Mesh (Module does this so the
+        plan and the fused step share ONE mesh object). Axis names and
+        sizes must match the plan's."""
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        if sizes != self._axis_sizes:
+            raise ValueError(
+                f"mesh {sizes} does not match the plan's axes "
+                f"{self._axis_sizes}")
+        self._mesh = mesh
+
+    def device_count(self):
+        return math.prod(self._axis_sizes.values()) \
+            if self._axis_sizes else 1
+
+    # ---------------------------------------------------- batch inputs
+    def batch_axes(self):
+        """Mesh axes the batch dim shards over: 'data' and 'fsdp'
+        together when both exist (fsdp ranks consume distinct rows)."""
+        return tuple(a for a in (self.layout.data_axis,
+                                 self.layout.fsdp_axis)
+                     if a in self._axis_sizes)
+
+    def input_spec(self, name, ndim=1):
+        """Fitted spec for a data/label input: an override wins,
+        otherwise dim 0 over the batch axes."""
+        if self.overrides:
+            spec, explicit = parameter_spec_from_name(
+                name, self.layout, self.overrides, ndim=None)
+            if explicit:
+                return spec
+        axes = self.batch_axes()
+        if not axes or ndim < 1:
+            return PartitionSpec()
+        dim0 = axes[0] if len(axes) == 1 else axes
+        return PartitionSpec(dim0, *([None] * (ndim - 1)))
+
+    # ------------------------------------------------------ parameters
+    def spec_for(self, name, ndim=None):
+        """(raw spec, explicit) straight from the rule layer — NOT
+        fitted to a shape; resolve() is the fitting step."""
+        return parameter_spec_from_name(
+            name, self.layout, self.overrides, ndim=ndim)
+
+    def _fit(self, spec, shape, explicit, name):
+        """Fit one raw spec to a concrete shape. Advisory (rule/
+        fallback) axes drop when absent from the mesh, non-dividing, or
+        below the fsdp min-size knob; explicit specs pass through
+        untouched (verify_sharding owns rejecting bad ones, with the
+        parameter named)."""
+        dims = list(tuple(spec))[:len(shape)]
+        dims += [None] * (len(shape) - len(dims))
+        if explicit:
+            return PartitionSpec(*dims)
+        min_sz = _fsdp_min_size()
+        small = (min_sz > 0
+                 and math.prod(shape or (1,)) < min_sz)
+        out = []
+        for d, size in zip(dims, shape):
+            axes = d if isinstance(d, tuple) else (d,)
+            kept = []
+            for ax in axes:
+                if ax is None:
+                    continue
+                n = self._axis_sizes.get(ax)
+                if n is None or n < 2:
+                    continue
+                if size % n != 0:
+                    continue
+                if small and ax == self.layout.fsdp_axis:
+                    continue
+                kept.append(ax)
+                size //= n
+            out.append(tuple(kept) if len(kept) > 1
+                       else (kept[0] if kept else None))
+        while out and out[-1] is None:
+            out.pop()
+        return PartitionSpec(*out)
+
+    def resolve(self, shapes):
+        """Fit the rule table against {param_name: shape}; returns
+        {name: PartitionSpec} (replicated entries included) and caches
+        it. Explicit-override names are recorded in `explicit_names`."""
+        for name, shape in shapes.items():
+            shape = tuple(shape)
+            raw, explicit = self.spec_for(name, ndim=len(shape))
+            if explicit:
+                self._explicit.add(name)
+            self._resolved[name] = self._fit(raw, shape, explicit, name)
+        return {n: self._resolved[n] for n in shapes}
+
+    @property
+    def explicit_names(self):
+        return set(self._explicit)
+
+    def named_shardings(self, shapes):
+        """{name: NamedSharding} over the built mesh (resolves first)."""
+        specs = self.resolve(shapes)
+        mesh = self.mesh
+        return {n: NamedSharding(mesh, s) for n, s in specs.items()}
+
+    # --------------------------------------------------- fsdp compute
+    def compute_spec(self, spec):
+        """Storage spec -> compute spec: the fsdp axis is removed
+        (gather-before-use); every other axis stays (tp compute IS
+        sharded)."""
+        fsdp = self.layout.fsdp_axis
+        dims = []
+        for d in tuple(spec):
+            axes = [a for a in (d if isinstance(d, tuple) else (d,))
+                    if a is not None and a != fsdp]
+            dims.append(tuple(axes) if len(axes) > 1
+                        else (axes[0] if axes else None))
+        while dims and dims[-1] is None:
+            dims.pop()
+        return PartitionSpec(*dims)
+
+    def uses_fsdp(self):
+        return self._axis_sizes.get(self.layout.fsdp_axis, 1) > 1
+
+    # ----------------------------------------------------- cache key
+    def digest(self):
+        """Stable hash of everything that changes the compiled program:
+        mesh axis names+sizes, the rule configuration, and the compute-
+        constraint mode. Joins `Executor._cache_key` so two binds of one
+        symbol under different plans never share a CompiledGraph."""
+        h = hashlib.sha1()
+        h.update(repr(sorted(self._axis_sizes.items())).encode())
+        h.update(rules_digest(self.layout, self.overrides).encode())
+        h.update(b"constrain" if self.constrain_compute else b"free")
+        return h.hexdigest()
+
+    def describe(self, shapes=None):
+        """Human-readable rule dump (docs/sharding.md walkthrough)."""
+        lines = [f"mesh: {self._axis_sizes}"]
+        for name, spec in sorted((shapes and self.resolve(shapes)
+                                  or self._resolved).items()):
+            tag = " (override)" if name in self._explicit else ""
+            lines.append(f"  {name}: {spec_to_str(spec)}{tag}")
+        return "\n".join(lines)
+
+    def __repr__(self):
+        return (f"ShardingPlan(mesh={self._axis_sizes}, "
+                f"overrides={len(self.overrides)}, "
+                f"digest={self.digest()[:12]})")

@@ -1,0 +1,195 @@
+"""Parameter-name → PartitionSpec rule layer (ROADMAP item 3).
+
+The reference framework places parameters by listing devices
+(context lists + `__ctx_group__` attrs); the GSPMD story replaces both
+with ONE mesh of named axes and a table of rules mapping each parameter
+NAME to a `PartitionSpec` over those axes (the SNIPPETS.md [2] shape:
+a frozen `SpecLayout` of role methods plus `parameter_spec_from_name`).
+
+Three axes cover the composed data/model/fsdp story:
+
+  data   pure data parallelism — batch dim 0 shards over it
+  fsdp   ZeRO-style parameter sharding: storage (and optimizer state)
+         shard over it, compute gathers before use and reduce-scatters
+         gradients after (plan.py wires the semantics)
+  tp     tensor parallelism — embeddings / projection output dims
+         split over it (NOTE: mxnet FullyConnected weights are
+         (out, in), so "column parallel" puts `tp` on dim 0)
+
+Resolution order for one parameter name (first match wins):
+
+  1. user overrides, exact (glob-free) patterns first
+  2. user overrides with wildcards, in insertion order
+  3. DEFAULT_RULES (role globs -> SpecLayout methods), in order
+  4. fallback: dim 0 over `fsdp` ("replicated-or-fsdp otherwise" —
+     plan.py drops the axis again for params it cannot divide)
+
+Default-rule and fallback specs are ADVISORY: `ShardingPlan.resolve`
+silently downgrades any axis that is absent from the mesh or does not
+divide the dim. Override specs are USER INTENT: a non-dividing override
+is rejected by `analysis.graph_verify.verify_sharding` before any
+trace (see docs/sharding.md).
+"""
+from __future__ import annotations
+
+import fnmatch
+import hashlib
+from dataclasses import dataclass
+
+from jax.sharding import PartitionSpec
+
+# Canonical axis names (parallel/mesh.py re-exports them alongside the
+# legacy data/model/seq/pipe/expert set).
+DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
+TP_AXIS = "tp"
+
+
+@dataclass(frozen=True)
+class SpecLayout:
+    """Frozen table of role -> PartitionSpec rules over named axes.
+
+    Instantiate with different axis names to retarget the same rules
+    (e.g. tp_axis='model' to reuse a legacy 'model' mesh axis)."""
+
+    data_axis: str = DATA_AXIS
+    fsdp_axis: str = FSDP_AXIS
+    tp_axis: str = TP_AXIS
+
+    # ---------------------------------------------------- weight roles
+    def embeddings(self):
+        """(vocab, d_model) tables: vocab over fsdp+tp together."""
+        return PartitionSpec((self.fsdp_axis, self.tp_axis), None)
+
+    def qkv_projection(self):
+        """Attention in-projections, (out, in): out over tp, in over
+        fsdp — column-parallel compute, fsdp storage."""
+        return PartitionSpec(self.tp_axis, self.fsdp_axis)
+
+    def output_projection(self):
+        """Output heads / attention out-projections: split on tp."""
+        return PartitionSpec(self.tp_axis, self.fsdp_axis)
+
+    def ffn_up(self):
+        """FFN up-projection, (d_ff, d_model): column-parallel."""
+        return PartitionSpec(self.tp_axis, self.fsdp_axis)
+
+    def ffn_down(self):
+        """FFN down-projection: fsdp storage only (row-parallel tp
+        would split the contraction and change reduction order)."""
+        return PartitionSpec(self.fsdp_axis, None)
+
+    def layer_norm(self):
+        """1-D scale/shift vectors: fsdp storage."""
+        return PartitionSpec(self.fsdp_axis)
+
+    def bias(self):
+        """1-D biases: fsdp storage (tiny ones downgrade via
+        MXNET_SHARD_FSDP_MIN_SIZE)."""
+        return PartitionSpec(self.fsdp_axis)
+
+    def replicated(self):
+        return PartitionSpec()
+
+    def activations(self):
+        """(batch, seq, d_model) activations: batch over data, model
+        dim over tp (used by with_sharding_constraint hints, not by
+        the parameter table)."""
+        return PartitionSpec(self.data_axis, None, self.tp_axis)
+
+    def fallback(self, ndim=None):
+        """Everything else: replicated-or-fsdp (dim 0 over fsdp when
+        the tensor has dims; scalars replicate)."""
+        if not ndim:
+            return PartitionSpec()
+        return PartitionSpec(self.fsdp_axis,
+                             *([None] * (ndim - 1)))
+
+
+DEFAULT_LAYOUT = SpecLayout()
+
+# (glob over the parameter name, SpecLayout role method). Checked in
+# order, first match wins — more specific globs go first.
+DEFAULT_RULES = (
+    ("*embed*_weight", "embeddings"),
+    ("*_qkv_weight", "qkv_projection"),
+    ("*_query_weight", "qkv_projection"),
+    ("*_key_weight", "qkv_projection"),
+    ("*_value_weight", "qkv_projection"),
+    ("*_attn_out_weight", "output_projection"),
+    ("*_head_weight", "output_projection"),
+    ("*_w1_weight", "ffn_up"),
+    ("*_up_weight", "ffn_up"),
+    ("*_w2_weight", "ffn_down"),
+    ("*_down_weight", "ffn_down"),
+    ("*_gamma", "layer_norm"),
+    ("*_beta", "layer_norm"),
+    ("*_bias", "bias"),
+)
+
+
+def spec_to_str(spec):
+    """Serialize a PartitionSpec into the Symbol `__sharding__` string
+    syntax (parallel/mesh.py parse_partition_spec round-trips it):
+    per-dim entries comma-separated, multi-axis dims joined with '+',
+    unsharded dims as 'None'."""
+    if spec is None:
+        return "None"
+    parts = []
+    for dim in tuple(spec):
+        if dim is None:
+            parts.append("None")
+        elif isinstance(dim, (tuple, list)):
+            parts.append("+".join(str(a) for a in dim))
+        else:
+            parts.append(str(dim))
+    return ",".join(parts) if parts else "None"
+
+
+def _as_spec(value):
+    from ..parallel.mesh import parse_partition_spec
+
+    return parse_partition_spec(value)
+
+
+def parameter_spec_from_name(param_name, layout=None, overrides=None,
+                             ndim=None):
+    """Resolve one parameter name to its PartitionSpec through the rule
+    table. Returns (spec, explicit): `explicit` is True iff a user
+    override matched — explicit specs are enforced (verify_sharding
+    rejects non-dividing ones), rule/fallback specs downgrade silently
+    in `ShardingPlan.resolve`."""
+    layout = layout or DEFAULT_LAYOUT
+    if overrides:
+        # exact patterns outrank wildcard patterns regardless of
+        # insertion order; within each class, insertion order wins
+        exact = [(p, s) for p, s in overrides.items()
+                 if not any(ch in p for ch in "*?[")]
+        globby = [(p, s) for p, s in overrides.items()
+                  if any(ch in p for ch in "*?[")]
+        for pat, s in exact:
+            if pat == param_name:
+                return _as_spec(s), True
+        for pat, s in globby:
+            if fnmatch.fnmatchcase(param_name, pat):
+                return _as_spec(s), True
+    for pat, role in DEFAULT_RULES:
+        if fnmatch.fnmatchcase(param_name, pat):
+            return getattr(layout, role)(), False
+    return layout.fallback(ndim), False
+
+
+def rules_digest(layout=None, overrides=None):
+    """Stable content hash of one rule configuration (layout axes +
+    default table + overrides). Deterministic across processes and
+    interpreter runs — it enters the exec-cache key via
+    `ShardingPlan.digest`, so it must NOT hash object identities."""
+    layout = layout or DEFAULT_LAYOUT
+    h = hashlib.sha1()
+    h.update(repr((layout.data_axis, layout.fsdp_axis,
+                   layout.tp_axis)).encode())
+    h.update(repr(DEFAULT_RULES).encode())
+    for pat in sorted(overrides or {}):
+        h.update(pat.encode())
+        h.update(spec_to_str(_as_spec((overrides or {})[pat])).encode())
+    return h.hexdigest()

@@ -404,7 +404,8 @@ class Symbol:
 
     # ------------------------------------------------------------- binding
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
-                    group2ctx=None, shared_exec=None, **kwargs):
+                    group2ctx=None, shared_exec=None, sharding=None,
+                    **kwargs):
         from .executor import Executor
 
         ctx = ctx or current_context()
@@ -442,7 +443,7 @@ class Symbol:
         }
         return Executor(
             self, ctx, args, grads, req, aux, group2ctx=group2ctx,
-            shared_exec=shared_exec
+            shared_exec=shared_exec, sharding=sharding
         )
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",

@@ -345,6 +345,29 @@ register_env(
     "serving tier).",
 )
 register_env(
+    "MXNET_SHARD_KV_MESH", bool, True,
+    "sharding: kvstore('tpu') barrier runs as a mesh jit (1-D "
+    "all-device mesh, in/out_shardings, no pmap). 0 restores the "
+    "legacy pmapped-psum barrier — a fallback for backends where "
+    "the mesh program is unavailable.",
+)
+register_env(
+    "MXNET_SHARD_FSDP_MIN_SIZE", int, 0,
+    "sharding: parameters with fewer elements than this keep the "
+    "fsdp axis OFF when resolved by advisory rules (tiny "
+    "biases/norm scales cost more to reshard than they save in "
+    "storage). 0 = shard everything the rules say; explicit "
+    "overrides are never downgraded.",
+)
+register_env(
+    "MXNET_SHARD_CONSTRAIN_COMPUTE", bool, True,
+    "sharding: pin fsdp-stored parameters to their compute layout "
+    "(fsdp axis dropped) inside the fused step trace — explicit "
+    "gather-before-use; the vjp transpose of the constraint is the "
+    "reduce-scatter of the gradients. 0 leaves the layout to the "
+    "GSPMD propagator.",
+)
+register_env(
     "MXNET_LOCK_WITNESS", str, "",
     "analysis: runtime lock witness "
     "(mxnet_tpu.analysis.lockwitness). '' / 'off' = disabled (the "

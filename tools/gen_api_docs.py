@@ -46,6 +46,8 @@ MODULES = [
     ("mxnet_tpu.visualization", "network plots/summaries"),
     ("mxnet_tpu.models", "model zoo builders"),
     ("mxnet_tpu.parallel", "mesh/sharding primitives"),
+    ("mxnet_tpu.sharding",
+     "named-axis partitioning: one mesh, rule table, jit lowering"),
 ]
 
 

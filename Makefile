@@ -12,7 +12,7 @@ XLA8    := XLA_FLAGS=--xla_force_host_platform_device_count=8
 .PHONY: all test nightly examples lint lint-check libs predict perl \
 	docs dryrun cache-check serving-check sync-check data-check \
 	passes-check telemetry-check decode-check race-check \
-	shard-check clean
+	shard-check profiling-check bench-diff clean
 
 all: libs test
 
@@ -123,6 +123,18 @@ race-check:
 # storage/step-time bench gate on 8 virtual devices
 shard-check:
 	$(CPUENV) $(XLA8) bash ci/check_sharding.sh
+
+# profiling tier: test suite + runtime gates (deviceStats covers every
+# cached executable after warmup, zero steady-state traces/records
+# under instrumentation, calibrated_cost measured-backed for served
+# graphs, HBM pre-flight warns/raises before any trace)
+profiling-check:
+	$(CPUENV) bash ci/check_profiling.sh
+
+# regression diff of two bench captures (nonzero exit on >10% drops):
+#   make bench-diff OLD=BENCH_r04.json NEW=BENCH_r05.json
+bench-diff:
+	$(PY) tools/benchdiff.py $(OLD) $(NEW)
 
 # multi-chip sharding dryrun (DP / SP+TP / PP / EP) on 8 virtual devices
 dryrun:

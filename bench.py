@@ -616,6 +616,89 @@ def _decode_bench(platform):
     })
 
 
+def _profiling_bench(platform):
+    """BENCH_MODE=profiling: the device-side observability ledger.
+
+    Warms a small serving grid with profiling on and reports the
+    accounting itself: per-executable HBM footprint / compile seconds
+    from deviceStats, the deviceStats<->execCache coverage join
+    (every cached executable must carry a record), and the
+    calibrated-vs-analytic step-cost comparison from the
+    CalibrationStore — the numbers ci/check_profiling.py gates and
+    tools/benchdiff.py diffs across capture runs."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import exec_cache, profiling, serving
+    from mxnet_tpu.passes import cost_model
+
+    vocab, embed, classes = 1000, 32, 16
+    buckets = (8, 16)
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.Embedding(data, input_dim=vocab, output_dim=embed,
+                           name="embed")
+    net = mx.sym.mean(net, axis=1)
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc")
+    shapes, _, _ = net.infer_shape(data=(1, buckets[-1]))
+    rs = np.random.RandomState(0)
+    params = {n: mx.nd.array(rs.normal(0, 0.1, s).astype("float32"))
+              for n, s in zip(net.list_arguments(), shapes)
+              if n != "data"}
+
+    profiling.reset_device_stats()
+    exec_cache.clear()
+    exec_cache.reset_stats()
+    t0 = time.perf_counter()
+    registry = serving.ModelRegistry()
+    model = registry.load("bench_prof", net.tojson(), params,
+                          input_specs={"data": ("L",)},
+                          input_dtypes={"data": "int32"},
+                          batch_buckets=(1, 4),
+                          length_buckets=buckets)
+    warmup_s = time.perf_counter() - t0
+
+    snap = profiling.device_stats()
+    recs = snap.get("executables", {})
+    totals = snap.get("totals", {})
+    cache_digests = exec_cache.entry_digests()
+    covered = sum(1 for d in cache_digests
+                  if any(r["digest"] == d for r in recs.values()))
+    largest = model.spec.all_buckets()[-1]
+    cc = cost_model.calibrated_cost(
+        net, {"data": tuple(largest)}, platform=platform)
+
+    _emit({
+        "mode": "profiling", "platform": platform,
+        "metric": f"profiling_ledger_{platform}",
+        "value": totals.get("count", 0),
+        "unit": "executables",
+        "warmup_s": round(warmup_s, 3),
+        "compile_s": totals.get("compile_s", 0.0),
+        "trace_s": totals.get("trace_s", 0.0),
+        "hbm_peak_bytes": totals.get("hbm_peak_bytes", 0),
+        "exec_cache_entries": len(cache_digests),
+        "exec_cache_covered": covered,
+        "executables": {
+            key: {f: r[f] for f in ("kind", "hbm_bytes", "arg_bytes",
+                                    "temp_bytes", "compile_s", "flops")}
+            for key, r in sorted(recs.items())
+        },
+        # calibrated vs analytic: once warmup harvested a measured
+        # forward, source flips to "measured" and the ratio says how
+        # far the analytic byte model sits from reality
+        "cost_source": cc["source"],
+        "cost_est_s": cc["est_s"],
+        "cost_analytic_s": cc["analytic_s"],
+        "cost_measured_s": cc["measured_s"],
+        "cost_measured_vs_analytic": round(
+            cc["measured_s"] / cc["analytic_s"], 3)
+        if cc["measured_s"] and cc["analytic_s"] else None,
+        "fallbacks": totals.get("fallbacks", 0),
+        "compile_errors": totals.get("compile_errors", 0),
+    })
+
+
 def _sharding_bench(platform):
     """BENCH_MODE=sharding: plan-driven partitioned training A/B.
 
@@ -768,6 +851,8 @@ def main():
         return _decode_bench(jax.devices()[0].platform)
     if os.environ.get("BENCH_MODE", "train") == "sharding":
         return _sharding_bench(jax.devices()[0].platform)
+    if os.environ.get("BENCH_MODE", "train") == "profiling":
+        return _profiling_bench(jax.devices()[0].platform)
 
     import jax.numpy as jnp
     import numpy as np

@@ -108,6 +108,17 @@ HOT_PATH_MANIFEST = {
         "ShardingPlan.digest", "ShardingPlan.compute_spec",
     ),
     "mxnet_tpu/sharding/lower.py": "*",
+    # executable accounting (PR 12): the instrumented-jit wrapper sits
+    # on EVERY dispatch of every profiled program, and the stats
+    # snapshots serve /metrics scrapes — bookkeeping only, never a
+    # device fetch (the one sanctioned device read, memory_analysis,
+    # happens at compile time inside _capture, off the hot path)
+    "mxnet_tpu/profiling/device_stats.py": (
+        "InstrumentedJit.__call__", "device_stats", "records_for",
+    ),
+    "mxnet_tpu/profiling/timeline.py": (
+        "timeline_stats", "aggregate_device_events",
+    ),
 }
 
 # Methods that force a host<->device round-trip (MX001).

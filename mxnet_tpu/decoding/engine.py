@@ -86,6 +86,27 @@ class DecodeEngine:
         self._copy_fn = None
         self._trace_counts = {}
         self._warm = False
+        # executable-accounting key: the decode grid is a function of
+        # (model config, batch, paging layout, kernel) — deterministic
+        # within a process, which is all deviceStats needs
+        import hashlib as _hashlib
+
+        self._digest = _hashlib.sha1(repr(
+            (cfg, self.max_batch, self.page_size, self.num_pages,
+             self.kernel_name)).encode()).hexdigest()[:12]
+
+    def _instrument(self, fn, kind):
+        """Route one grid program through profiling's executable
+        accounting (deviceStats). Transparent: the wrapper dispatches
+        through the SAME compiled executable a raw jit would build, so
+        trace counts (`_note_trace`) are unchanged."""
+        try:
+            from .. import profiling as _profiling
+
+            return _profiling.instrument(fn, digest=self._digest,
+                                         kind=kind)
+        except Exception:
+            return fn
 
     # ------------------------------------------------------ properties
     @property
@@ -134,7 +155,8 @@ class DecodeEngine:
                 lengths, active, cfg=cfg, attn=attn)
 
         donate = (2, 3) if self._donate else ()
-        return jax.jit(impl, donate_argnums=donate)
+        return self._instrument(jax.jit(impl, donate_argnums=donate),
+                                f"decode@{bucket}")
 
     def _build_prefill_fn(self, length_bucket):
         cfg = self.cfg
@@ -158,7 +180,8 @@ class DecodeEngine:
                 cfg=cfg, attn_fn=attn_fn)
 
         donate = (3, 4) if self._donate else ()
-        return jax.jit(impl, donate_argnums=donate)
+        return self._instrument(jax.jit(impl, donate_argnums=donate),
+                                f"prefill@{length_bucket}")
 
     def _build_copy_fn(self):
         def impl(pool, src, dst):
@@ -166,7 +189,8 @@ class DecodeEngine:
             return pool.at[:, dst].set(pool[:, src])
 
         donate = (0,) if self._donate else ()
-        return jax.jit(impl, donate_argnums=donate)
+        return self._instrument(jax.jit(impl, donate_argnums=donate),
+                                "copy_page")
 
     # ---------------------------------------------------------- warmup
     def warmup(self):
@@ -198,8 +222,42 @@ class DecodeEngine:
                 np.zeros((b,), np.int32),
                 np.zeros((b,), bool))
             out.block_until_ready()
+        self._harvest_calibration()
         self._warm = True
         return self
+
+    def _harvest_calibration(self):
+        """One TIMED warm decode step per bucket into the profiling
+        CalibrationStore (programs are warm — real steady-state
+        seconds, one extra masked step per bucket at warmup time; the
+        grid stays cold-path only)."""
+        import time as _time
+
+        try:
+            from .. import profiling as _profiling
+
+            if not _profiling.profiling_enabled():
+                return
+            store = _profiling.calibration_store()
+            platform = jax.default_backend()
+            b = self.max_batch
+            for bucket in self.page_buckets:
+                t0 = _time.perf_counter()
+                out, self._k, self._v = self._decode_fns[bucket](
+                    self._params,
+                    np.zeros((b,), np.int32), self._k, self._v,
+                    np.zeros((b, bucket), np.int32),
+                    np.zeros((b,), np.int32),
+                    np.zeros((b,), bool))
+                out.block_until_ready()
+                seconds = _time.perf_counter() - t0
+                store.record(self._digest, platform,
+                             f"decode_step[{bucket}]", seconds)
+                if bucket == self.page_buckets[-1]:
+                    store.record(self._digest, platform, "decode_step",
+                                 seconds)
+        except Exception:
+            pass  # calibration is advisory; warmup must never fail
 
     # -------------------------------------------------------- hot path
     def prefill(self, token_ids, table):

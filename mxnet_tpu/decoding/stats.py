@@ -52,6 +52,10 @@ _TOKEN_LATENCY_MS = _treg.histogram(
     "mxnet_tpu_decode_token_latency_ms",
     "Per-token decode-step latency",
     buckets=(0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000))
+_PREFILL_LATENCY_MS = _treg.histogram(
+    "mxnet_tpu_decode_prefill_latency_ms",
+    "Per-prompt prefill latency (time-to-first-token's device half)",
+    buckets=(1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000))
 
 
 def _register(key, stats):
@@ -144,6 +148,7 @@ class DecodeStats:
             if readmission:
                 self.readmissions += 1
         _TOKENS.inc(tokens, phase="prefill", model=self._key)
+        _PREFILL_LATENCY_MS.observe(seconds * 1e3, model=self._key)
 
     def note_step(self, live_rows, seconds):
         """One continuous-decode step: `live_rows` tokens emitted."""

@@ -103,6 +103,14 @@ def clear():
         _raw_sigs.clear()
 
 
+def entry_digests():
+    """Digests of the live cache entries, in LRU order — the join key
+    against profiling's deviceStats records (ci/check_profiling.py
+    asserts every entry has a device record after warmup)."""
+    with _lock:
+        return [e.digest for e in _table.values()]
+
+
 def note_graph_replay():
     with _lock:
         _stats["graph_replays"] += 1
@@ -119,7 +127,7 @@ def count_shared_hit():
         _stats["shared_hits"] += 1
 
 
-def lookup_or_build(key, builder, raw_sig=None):
+def lookup_or_build(key, builder, raw_sig=None, canonical_fn=None):
     """Return the cached CompiledGraph for `key`, building (and
     LRU-inserting) it with `builder()` on a miss. Building happens under
     the lock: it is pure Python closure construction — the actual jax
@@ -128,7 +136,11 @@ def lookup_or_build(key, builder, raw_sig=None):
     `raw_sig` is a hash of the caller's PRE-canonicalization graph
     signature: a hit whose raw_sig was never seen on that entry means
     two distinct build orders converged onto one compiled program
-    through the pass pipeline — counted as `canonical_collisions`."""
+    through the pass pipeline — counted as `canonical_collisions`.
+
+    `canonical_fn` (miss only) supplies the graph's canonical digest:
+    it lands on the entry so profiling's `deviceStats` records and the
+    `CalibrationStore` key by the same id the autotuner uses."""
     with _lock:
         if _enabled():
             entry = _table.get(key)
@@ -145,6 +157,18 @@ def lookup_or_build(key, builder, raw_sig=None):
         _stats["misses"] += 1
         _stats["traces"] += 1
         entry = builder()
+        # per-entry identity for the profiling layer: `digest` is this
+        # ENTRY (graph + shapes + grad config), `canonical` the graph
+        # family shared with the tuner/calibration key space
+        import hashlib as _hashlib
+
+        entry.digest = _hashlib.sha1(
+            repr(key).encode()).hexdigest()[:12]
+        if canonical_fn is not None:
+            try:
+                entry.canonical = canonical_fn()
+            except Exception:
+                entry.canonical = None
         if _enabled():
             _table[key] = entry
             if raw_sig is not None:
@@ -187,8 +211,9 @@ class CompiledGraph:
     constructs the train-step program."""
 
     __slots__ = ("run_graph", "plan", "var_names", "aux_set",
-                 "grad_names", "mirror", "_jit_fwd", "_jit_train",
-                 "_head_shapes", "_default_ones", "_build_lock")
+                 "grad_names", "mirror", "digest", "canonical",
+                 "_jit_fwd", "_jit_train", "_head_shapes",
+                 "_default_ones", "_build_lock")
 
     def __init__(self, run_graph, plan, var_names, aux_set, grad_names,
                  mirror):
@@ -198,11 +223,28 @@ class CompiledGraph:
         self.aux_set = aux_set
         self.grad_names = list(grad_names)
         self.mirror = mirror
+        self.digest = None     # entry id (lookup_or_build stamps it)
+        self.canonical = None  # canonical graph digest (tuner keyspace)
         self._jit_fwd = {}
         self._jit_train = None
         self._head_shapes = None
         self._default_ones = None
         self._build_lock = threading.Lock()
+
+    def _instrument(self, fn, kind):
+        """Route a freshly-built per-mode jit through the profiling
+        layer (executable accounting); unkeyed entries (direct
+        CompiledGraph construction in tests) stay raw."""
+        if self.digest is None:
+            return fn
+        try:
+            from . import profiling as _profiling
+
+            return _profiling.instrument(fn, digest=self.digest,
+                                         kind=kind,
+                                         canonical=self.canonical)
+        except Exception:
+            return fn
 
     # ------------------------------------------------------- programs
     def jit_fwd(self, is_train):
@@ -217,7 +259,9 @@ class CompiledGraph:
                     def fwd(a, x, r, _run=run, _m=mode):
                         return _run(a, x, r, _m)
 
-                    fn = self._jit_fwd[mode] = jax.jit(fwd)
+                    fn = self._jit_fwd[mode] = self._instrument(
+                        jax.jit(fwd),
+                        "fwd_train" if mode else "fwd")
                     _note_jit_build()
         return fn
 
@@ -232,8 +276,9 @@ class CompiledGraph:
             with self._build_lock:
                 fn = self._jit_train
                 if fn is None:
-                    fn = self._jit_train = self._build_train_step(
-                        donate_ok)
+                    fn = self._jit_train = self._instrument(
+                        self._build_train_step(donate_ok),
+                        "train_step")
                     _note_jit_build()
         return fn
 

@@ -152,6 +152,26 @@ class Executor:
              if self._sharding_plan is not None else None),
             mirror,
         )
+        # HBM pre-flight BEFORE any program is looked up or traced:
+        # strict mode turns an over-cap bind into an exception with
+        # zero traces executed (mxnet_tpu.profiling.preflight)
+        from . import profiling as _profiling
+
+        if _profiling.profiling_enabled():
+            try:
+                _profiling.preflight_bind(
+                    self._opt_symbol,
+                    {n: (tuple(a.shape), a.dtype)
+                     for n, a in self.arg_dict.items()},
+                    self._grad_req,
+                    auxs={n: (tuple(a.shape), a.dtype)
+                          for n, a in self.aux_dict.items()},
+                    plan=self._sharding_plan)
+            except _profiling.HBMPreflightError:
+                raise
+            except Exception:
+                pass  # estimation failure must never block a bind
+
         if (shared_exec is not None
                 and getattr(shared_exec, "_cache_key", None)
                 == self._cache_key
@@ -161,7 +181,9 @@ class Executor:
             return
         self._compiled = _exec_cache.lookup_or_build(
             self._cache_key, self._trace_graph,
-            raw_sig=hash(raw_key))
+            raw_sig=hash(raw_key),
+            canonical_fn=lambda: _passes.canonical_digest(
+                self._opt_symbol))
 
     def _trace_graph(self):
         """Build the pure run_graph program + node plan for this bind's
@@ -224,7 +246,13 @@ class Executor:
                     kwargs["rng"] = jax.random.fold_in(rng, node_idx)
                 if opdef.needs_mode:
                     kwargs["is_train"] = is_train
-                res = opdef.fn(*in_vals, **kwargs)
+                # named_scope stamps the node name into HLO
+                # op_metadata, which the XLA device trace copies into
+                # its event args — profiling.timeline attributes
+                # device time back to graph nodes through it. Pure
+                # trace-time cost; compiled code is unchanged.
+                with jax.named_scope(nname):
+                    res = opdef.fn(*in_vals, **kwargs)
                 if not isinstance(res, tuple):
                     res = (res,)
                 for i in range(n_out):

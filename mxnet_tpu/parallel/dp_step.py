@@ -433,7 +433,21 @@ class FusedTrainStep:
             step,
             in_shardings=kwargs.get("in_shardings"),
             out_shardings=kwargs.get("out_shardings"),
-            donate_argnums=kwargs["donate_argnums"])
+            donate_argnums=kwargs["donate_argnums"],
+            digest=self._profiling_digest(), kind="fused_step")
+
+    def _profiling_digest(self):
+        """Executable-accounting key for this step's programs: the
+        executor's exec-cache entry digest, plus the sharding-plan
+        digest when one governs the layout (the same symbol under two
+        plans is two different executables)."""
+        digest = getattr(self._ex._compiled, "digest", None)
+        if digest and self._plan is not None:
+            try:
+                digest = f"{digest}+{self._plan.digest()[:8]}"
+            except Exception:
+                pass
+        return digest
 
     # -------------------------------------------------------------- run
     def _place_data(self, data_vals):
@@ -567,7 +581,9 @@ class FusedTrainStep:
             multi,
             in_shardings=kwargs.get("in_shardings"),
             out_shardings=kwargs.get("out_shardings"),
-            donate_argnums=kwargs["donate_argnums"])
+            donate_argnums=kwargs["donate_argnums"],
+            digest=self._profiling_digest(),
+            kind=f"fused_multi[{int(k)}]")
         self._multi_cache[key] = fn
         return fn
 

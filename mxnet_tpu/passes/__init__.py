@@ -38,6 +38,8 @@ def canonical_digest(symbol):
     cross-process analog of `Symbol.structure_key()` (which contains
     unpicklable leaves). Runs the full default pipeline, so any two
     graphs the pipeline maps to one canonical form share a digest.
-    Keys the tuning cache (tuner.py)."""
-    js = optimize(symbol).tojson()
+    Keys the tuning cache (tuner.py). Stats are suppressed: this is a
+    KEY computation, not bind-time optimization work, so
+    graphPassStats stays a ledger of real pipeline runs."""
+    js = optimize(symbol, collect_stats=False).tojson()
     return hashlib.sha256(js.encode("utf-8")).hexdigest()[:16]

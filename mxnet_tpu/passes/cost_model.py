@@ -109,6 +109,68 @@ def graph_costs(symbol, **input_shapes):
     }
 
 
+# ----------------------------------------------- time estimates (s)
+# HBM-class streaming bandwidth by platform — the same byte-model
+# constants the autotuner's analytic multistep choice uses
+_PLATFORM_BANDWIDTH = {"tpu": 8e11}
+_DEFAULT_BANDWIDTH = 2e11
+
+
+def analytic_step_s(symbol, input_shapes, platform):
+    """Analytic wall-seconds estimate of one forward: the graph
+    streams its tile-padded bytes at the platform's HBM-class
+    bandwidth (the byte term dominates on TPU for the memory-bound
+    majority; the flop term is folded into the same constants)."""
+    costs = graph_costs(symbol, **{k: tuple(v)
+                                   for k, v in input_shapes.items()})
+    bandwidth = _PLATFORM_BANDWIDTH.get(platform, _DEFAULT_BANDWIDTH)
+    return max(costs["padded_bytes"] / bandwidth, 1e-7)
+
+
+def calibrated_cost(symbol, input_shapes, platform=None,
+                    kind="forward", store=None):
+    """Best available step-time estimate, measured-first.
+
+    Preference order is PINNED (ci/check_profiling.py asserts it):
+      1. a measured record in the CalibrationStore for (canonical
+         digest, platform, kind) — real device seconds harvested
+         during serving/decoding warmup or fit epochs,
+      2. the analytic byte model (`analytic_step_s`).
+
+    Returns {"est_s", "source" ("measured"|"analytic"), "analytic_s",
+    "measured_s", "digest", "platform", "kind"} — both estimates are
+    always present when computable, `est_s` is the preferred one."""
+    if platform is None:
+        import jax
+
+        platform = jax.default_backend()
+    digest = symbol.canonical_signature()
+    if store is None:
+        from ..profiling import calibration_store
+
+        store = calibration_store()
+    measured = store.measured_seconds(digest, platform, kind)
+    try:
+        analytic = analytic_step_s(symbol, input_shapes, platform)
+    except Exception:
+        analytic = None  # uninferable shapes: measured-only or nothing
+    if measured is not None:
+        est, source = measured, "measured"
+    elif analytic is not None:
+        est, source = analytic, "analytic"
+    else:
+        est, source = None, "none"
+    return {
+        "est_s": est,
+        "source": source,
+        "analytic_s": analytic,
+        "measured_s": measured,
+        "digest": digest,
+        "platform": platform,
+        "kind": kind,
+    }
+
+
 # ------------------------------------------------------- layout choice
 def _conv_pool_tensors(symbol, input_shapes):
     """(shape, dtype) of every data/output tensor at 2-D Convolution /

@@ -126,7 +126,7 @@ class PassManager:
     """Runs a pass list over a Graph with per-pass compaction,
     validation, and verification."""
 
-    def __init__(self, passes=None, verify=True):
+    def __init__(self, passes=None, verify=True, collect_stats=True):
         names = list(passes) if passes is not None else default_pipeline()
         unknown = [n for n in names if n not in _PASS_REGISTRY]
         if unknown:
@@ -135,13 +135,20 @@ class PassManager:
                 f"{list_passes()} (MXNET_GRAPH_PASSES)")
         self.passes = [(n, _PASS_REGISTRY[n][0]) for n in names]
         self.verify = verify
+        # collect_stats=False for KEY computation (canonical_digest):
+        # the pipeline runs only to name the graph family, not to
+        # optimize a bind — graphPassStats must stay a ledger of real
+        # bind-time pipeline work (MXNET_GRAPH_PASSES=0 pins 0 runs
+        # even though digests still canonicalize)
+        self.collect_stats = collect_stats
 
     def run(self, graph):
         from ..analysis.graph_verify import verify_graph
 
-        with _STATS_LOCK:
-            _stats["pipeline_runs"] += 1
-            _stats["nodes_in"] += len(graph)
+        if self.collect_stats:
+            with _STATS_LOCK:
+                _stats["pipeline_runs"] += 1
+                _stats["nodes_in"] += len(graph)
         for name, fn in self.passes:
             t0 = time.perf_counter()
             try:
@@ -153,28 +160,32 @@ class PassManager:
                 issues = (verify_graph(graph, raise_on_issue=False)
                           if self.verify else [])
             except MXNetError:
-                with _STATS_LOCK:
-                    _stats["verify_failures"] += 1
+                if self.collect_stats:
+                    with _STATS_LOCK:
+                        _stats["verify_failures"] += 1
                 raise
             dt_us = int((time.perf_counter() - t0) * 1e6)
-            with _STATS_LOCK:
-                _stats["pass_time_us"][name] = (
-                    _stats["pass_time_us"].get(name, 0) + dt_us)
-                counter = _PASS_COUNTERS.get(name)
-                if counter:
-                    _stats[counter] += applied
-                if name != "dce":
-                    _stats["nodes_eliminated"] += swept
-            if issues:
+            if self.collect_stats:
                 with _STATS_LOCK:
-                    _stats["verify_failures"] += 1
+                    _stats["pass_time_us"][name] = (
+                        _stats["pass_time_us"].get(name, 0) + dt_us)
+                    counter = _PASS_COUNTERS.get(name)
+                    if counter:
+                        _stats[counter] += applied
+                    if name != "dce":
+                        _stats["nodes_eliminated"] += swept
+            if issues:
+                if self.collect_stats:
+                    with _STATS_LOCK:
+                        _stats["verify_failures"] += 1
                 detail = "; ".join(
                     f"[{i.kind}] {i.message}" for i in issues)
                 raise MXNetError(
                     f"graph pass {name!r} produced an invalid graph: "
                     f"{detail}")
-        with _STATS_LOCK:
-            _stats["nodes_out"] += len(graph)
+        if self.collect_stats:
+            with _STATS_LOCK:
+                _stats["nodes_out"] += len(graph)
         return graph
 
 
@@ -191,11 +202,12 @@ def pipeline_spec():
     return [p.strip() for p in raw.split(",") if p.strip()]
 
 
-def optimize(symbol, passes=None, verify=True):
+def optimize(symbol, passes=None, verify=True, collect_stats=True):
     """Run the pipeline over a Symbol, returning the optimized Symbol.
     (The Graph-level API is `PassManager.run` directly.)"""
     graph = Graph.from_symbol(symbol)
-    PassManager(passes, verify=verify).run(graph)
+    PassManager(passes, verify=verify,
+                collect_stats=collect_stats).run(graph)
     return graph.to_symbol()
 
 

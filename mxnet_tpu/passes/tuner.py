@@ -89,8 +89,21 @@ class Autotuner:
         if cached is None:
             with self._lock:
                 cached = self._local.get(key)
-        if cached is not None and (cached.get("source") == "measured"
-                                   or not measure):
+        if cached is not None and cached.get("source") == "measured":
+            return cached
+        # calibration upgrade: a measured forward time harvested into
+        # the CalibrationStore (profiling) refines an analytic record
+        # for free — no on-device measurement run needed here
+        calibrated_s = _calibration_forward_s(digest, platform)
+        if cached is not None and not measure:
+            if (calibrated_s is not None
+                    and cached.get("source") == "analytic"):
+                record = dict(cached)
+                record["multistep_k"] = _k_for_window(calibrated_s)
+                record["measured_forward_s"] = calibrated_s
+                record["source"] = "calibrated"
+                self._persist(key, record)
+                return record
             return cached
 
         shapes = {k: tuple(v) for k, v in input_shapes.items()}
@@ -108,21 +121,29 @@ class Autotuner:
                 record["multistep_k"] = _k_for_window(step_s)
                 record["measured_forward_s"] = step_s
                 record["source"] = "measured"
+        elif calibrated_s is not None:
+            record["multistep_k"] = _k_for_window(calibrated_s)
+            record["measured_forward_s"] = calibrated_s
+            record["source"] = "calibrated"
+        self._persist(key, record)
+        return record
+
+    def _persist(self, key, record):
+        """Adopt `record` locally and best-effort save: merge this
+        process's full record set over the current disk table and
+        replace atomically. A concurrent external writer can win the
+        race for one save, but the next save here re-merges
+        everything in _local, so a lost record only costs a re-tune."""
         with self._lock:
             self._local[key] = record
             pending = dict(self._local)
-        # best-effort persistence outside the lock: merge this
-        # process's full record set over the current disk table and
-        # replace atomically. A concurrent external writer can win the
-        # race for one save, but the next save here re-merges
-        # everything in _local, so a lost record only costs a re-tune.
+        # disk merge OUTSIDE the lock (MX006: no I/O under locks)
         table = self._load()
         table.update(pending)
         try:
             self._save(table)
         except OSError:
             pass  # read-only cache dir: tuning still works, unpersisted
-        return record
 
     @staticmethod
     def _batch_of(shapes):
@@ -133,16 +154,29 @@ class Autotuner:
 
     @staticmethod
     def _analytic_multistep(symbol, shapes, platform):
-        """Steps per fused dispatch from the byte model: assume the
-        graph streams its padded bytes at the platform's HBM-class
-        bandwidth, and fuse enough steps to fill the dispatch window.
-        CPU keeps k=1 (dispatch is cheap, debuggability wins)."""
+        """Steps per fused dispatch from the byte model
+        (cost_model.analytic_step_s): fuse enough steps to fill the
+        dispatch window. CPU keeps k=1 (dispatch is cheap,
+        debuggability wins)."""
         if platform == "cpu":
             return 1
-        costs = _cm.graph_costs(symbol, **shapes)
-        bandwidth = 8e11 if platform == "tpu" else 2e11
-        est_step_s = max(costs["padded_bytes"] / bandwidth, 1e-7)
-        return _k_for_window(est_step_s)
+        from . import cost_model as _cm
+
+        return _k_for_window(
+            _cm.analytic_step_s(symbol, shapes, platform))
+
+
+def _calibration_forward_s(digest, platform):
+    """Measured forward seconds for (digest, platform) from the
+    profiling CalibrationStore, or None (store missing/empty — the
+    pre-calibration behavior is exactly the old analytic path)."""
+    try:
+        from ..profiling import calibration_store
+
+        return calibration_store().measured_seconds(
+            digest, platform, "forward")
+    except Exception:
+        return None
 
 
 def _k_for_window(step_s):

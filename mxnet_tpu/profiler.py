@@ -188,8 +188,14 @@ class scope:
 def _collect_device_events(trace_dir):
     """Chrome trace events from the newest jax/XLA capture under
     trace_dir (jax writes plugins/profile/<run>/<host>.trace.json.gz in
-    chrome trace-event format). Device pids are offset by 1000 so they
-    appear as separate processes next to the host (pid 0) timeline."""
+    chrome trace-event format — one file PER HOST, several in a
+    multi-host/multi-device capture). All files of the newest run
+    directory are merged; device pids map into a per-source-file lane
+    (file i, source pid p -> 1000*(i+1)+p, bumped past collisions) so
+    two devices that both call themselves pid 2 in different files
+    stay separate processes next to the host (pid 0) timeline instead
+    of silently merging. Single-file captures keep the historical
+    pid+1000 mapping exactly."""
     import glob
     import gzip
 
@@ -197,24 +203,39 @@ def _collect_device_events(trace_dir):
         trace_dir, "**", "*.trace.json.gz"), recursive=True)
     if not paths:
         return []
-    newest = max(paths, key=os.path.getmtime)
-    try:
-        with gzip.open(newest, "rt") as f:
-            device = json.load(f)
-    except Exception:
-        return []
+    # the newest RUN, not the newest file: a capture writes sibling
+    # per-host files into one run directory
+    run_dir = os.path.dirname(max(paths, key=os.path.getmtime))
+    run_paths = sorted(p for p in paths
+                       if os.path.dirname(p) == run_dir)
     # shift device timestamps onto the host timeline: the capture's ts
     # are relative to its own start, which dump-time recorded as
     # trace_t0_us on the host clock
     base = _state.get("trace_t0_us", 0.0)
     out = []
-    for ev in device.get("traceEvents", []):
-        ev = dict(ev)
-        if isinstance(ev.get("pid"), int):
-            ev["pid"] = ev["pid"] + 1000
-        if isinstance(ev.get("ts"), (int, float)):
-            ev["ts"] = ev["ts"] + base
-        out.append(ev)
+    pid_map = {}        # (file_idx, src_pid) -> output pid
+    taken = set()
+    for file_idx, path in enumerate(run_paths):
+        try:
+            with gzip.open(path, "rt") as f:
+                device = json.load(f)
+        except Exception:
+            continue  # a torn/partial file must not drop the others
+        for ev in device.get("traceEvents", []):
+            ev = dict(ev)
+            pid = ev.get("pid")
+            if isinstance(pid, int):
+                lane = pid_map.get((file_idx, pid))
+                if lane is None:
+                    lane = 1000 * (file_idx + 1) + pid
+                    while lane in taken:
+                        lane += 1000
+                    taken.add(lane)
+                    pid_map[(file_idx, pid)] = lane
+                ev["pid"] = lane
+            if isinstance(ev.get("ts"), (int, float)):
+                ev["ts"] = ev["ts"] + base
+            out.append(ev)
     return out
 
 
@@ -294,6 +315,21 @@ def dump_profile(device_trace_dir=None):
     buffered events nor leaves a torn/partial profile behind."""
     with _lock:
         events = list(_events)
+    # device events are collected BEFORE the view snapshot: feeding
+    # them into the timeline aggregator first means the
+    # deviceTimelineStats view embedded in THIS dump already reflects
+    # the capture the same file carries (previously the per-op
+    # aggregation lagged one dump behind its own events)
+    device_events = []
+    if device_trace_dir:
+        device_events = _collect_device_events(device_trace_dir)
+        if device_events:
+            try:
+                from .profiling import ingest_device_events
+
+                ingest_device_events(device_events)
+            except Exception:
+                pass  # aggregation is advisory; the dump must land
     trace = {"traceEvents": [], "displayTimeUnit": "ms"}
     _ensure_silo_views()
     for key, snap in _telemetry.view_items():
@@ -307,9 +343,7 @@ def dump_profile(device_trace_dir=None):
             "name": name, "cat": cat, "ph": "E",
             "ts": e * 1e6, "pid": 0, "tid": 0,
         })
-    if device_trace_dir:
-        trace["traceEvents"].extend(
-            _collect_device_events(device_trace_dir))
+    trace["traceEvents"].extend(device_events)
     filename = _state["filename"]
     tmp = f"{filename}.tmp.{os.getpid()}"
     try:

@@ -1,0 +1,156 @@
+"""CalibrationStore: measured step/forward seconds, persisted.
+
+The autotuner persists tuning CHOICES; this store persists tuning
+EVIDENCE — (canonical digest, platform, kind) → measured wall seconds,
+harvested for free at points where the framework is already timing
+warm executions: `serving.ServedModel.warmup()` (one timed forward per
+bucket), `decoding.DecodeEngine.warmup()` (one timed decode step per
+bucket), and the `fit` epoch loop (epoch seconds / batches). ROADMAP
+item 2's "measured records fed back into the cost model":
+`cost_model.calibrated_cost()` reads this store and prefers a measured
+record over its analytic estimate.
+
+Persistence mirrors the tuner exactly: one JSON table at
+MXNET_CALIBRATION_CACHE (default ~/.cache/mxnet_tpu/calibration.json),
+loads are plain reads of an atomically-replaced file, saves re-merge
+this process's full record set over the disk table and `os.replace` —
+concurrent writers can each lose one race, never corrupt the file.
+Repeat observations of one key fold by EWMA (alpha 0.3): calibration
+tracks drift without thrashing on a single noisy measurement."""
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+_EWMA_ALPHA = 0.3
+
+
+def _default_cache_path():
+    from ..utils import getenv
+
+    return os.path.expanduser(str(getenv("MXNET_CALIBRATION_CACHE")))
+
+
+class CalibrationStore:
+    """(digest, platform, kind) -> {"seconds", "samples", ...}.
+
+    `kind` namespaces what was measured: "forward" (serving-style
+    inference step), "decode_step", "prefill", "fit_step" — plus
+    bucket-qualified variants ("forward[8x128]") when the harvest
+    point knows its padding bucket."""
+
+    def __init__(self, cache_path=None):
+        self.cache_path = cache_path or _default_cache_path()
+        self._lock = threading.Lock()
+        self._local = {}  # this process's records (full-set merge save)
+
+    # ------------------------------------------------------ persistence
+    def _load(self):
+        try:
+            with open(self.cache_path) as f:
+                table = json.load(f)
+            return table if isinstance(table, dict) else {}
+        except (OSError, ValueError):
+            return {}
+
+    def _save(self, table):
+        tmp = f"{self.cache_path}.tmp.{os.getpid()}"
+        os.makedirs(os.path.dirname(self.cache_path) or ".",
+                    exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(table, f, indent=2, sort_keys=True)
+        os.replace(tmp, self.cache_path)
+
+    @staticmethod
+    def _key(digest, platform, kind):
+        return f"{digest}:{platform}:{kind}"
+
+    # ---------------------------------------------------------- surface
+    def record(self, digest, platform, kind, seconds, meta=None):
+        """Fold one measurement in and persist. `seconds` <= 0 or a
+        falsy digest is dropped (a timing that failed upstream)."""
+        if not digest or not platform or seconds is None:
+            return None
+        seconds = float(seconds)
+        if seconds <= 0:
+            return None
+        key = self._key(digest, platform, kind)
+        with self._lock:
+            prev = self._local.get(key)
+            if prev is None:
+                prev = self._load().get(key)
+            if prev and prev.get("samples"):
+                folded = (_EWMA_ALPHA * seconds
+                          + (1 - _EWMA_ALPHA) * float(prev["seconds"]))
+                rec = {
+                    "digest": digest, "platform": platform,
+                    "kind": kind, "seconds": folded,
+                    "samples": int(prev["samples"]) + 1,
+                }
+            else:
+                rec = {"digest": digest, "platform": platform,
+                       "kind": kind, "seconds": seconds, "samples": 1}
+            if meta:
+                rec["meta"] = dict(meta)
+            self._local[key] = rec
+            pending = dict(self._local)
+        # disk merge outside the lock (the tuner's convention): holding
+        # a lock across filesystem latency is an MX006 violation and a
+        # real stall for every other harvest point
+        table = self._load()
+        table.update(pending)
+        try:
+            self._save(table)
+        except OSError:
+            pass  # read-only cache dir: in-memory store still serves
+        return rec
+
+    def lookup(self, digest, platform, kind="forward"):
+        """Record for the exact (digest, platform, kind), or None."""
+        key = self._key(digest, platform, kind)
+        with self._lock:
+            rec = self._local.get(key)
+        if rec is None:
+            rec = self._load().get(key)
+        return dict(rec) if rec else None
+
+    def measured_seconds(self, digest, platform, kind="forward"):
+        rec = self.lookup(digest, platform, kind)
+        return float(rec["seconds"]) if rec else None
+
+    def records(self, digest=None):
+        """All records (disk ∪ local, local wins), optionally filtered
+        by canonical digest."""
+        table = self._load()
+        with self._lock:
+            table.update(self._local)
+        if digest is not None:
+            table = {k: v for k, v in table.items()
+                     if v.get("digest") == digest}
+        return table
+
+    def clear(self):
+        """Drop local records and the persisted table (tests)."""
+        with self._lock:
+            self._local.clear()
+        try:
+            os.unlink(self.cache_path)
+        except OSError:
+            pass
+
+
+_default = None
+_default_lock = threading.Lock()
+
+
+def calibration_store():
+    """The process-wide store every automatic harvest point writes to
+    (path re-resolves when MXNET_CALIBRATION_CACHE changed — tests
+    repoint it per-tmpdir)."""
+    global _default
+    path = _default_cache_path()
+    with _default_lock:
+        if _default is None or _default.cache_path != path:
+            _default = CalibrationStore(path)
+        return _default

@@ -1,0 +1,235 @@
+"""HBM pre-flight: "will this bind fit?" answered BEFORE the OOM.
+
+`preflight_bind` runs inside `Executor._build` before any tracing: it
+estimates the bind's device-memory footprint from information that is
+free at that point — argument/aux buffer bytes, gradient buffers
+(grad_req != null), optimizer state as a multiple of gradient bytes
+(MXNET_PROFILING_OPT_FACTOR, default 2.0 = Adam's m+v), and
+activations as the tile-padded output bytes of every non-variable
+node (doubled under training for the saved forward values) — and
+compares it against the device memory cap. Footprint over cap emits a
+structured `HBMPreflightWarning` carrying the full report with
+parameter-level attribution; MXNET_PROFILING_HBM_STRICT=1 upgrades it
+to `HBMPreflightError`. Either way ZERO device programs were traced —
+the whole point is to answer before XLA commits memory.
+
+The cap comes from MXNET_PROFILING_DEVICE_MEM_BYTES when set (tests,
+or machines where jax under-reports), else `device.memory_stats()`
+(`bytes_limit`); CPU jax returns None there, so on CPU the check
+silently records the report and never warns — exactly the degraded
+behavior a host-memory backend wants.
+
+A sharded bind divides each parameter's bytes by the product of the
+mesh-axis sizes its fitted PartitionSpec actually uses (best-effort;
+an unresolvable name stays replicated = conservative)."""
+from __future__ import annotations
+
+import math
+import os
+import threading
+import warnings
+
+_lock = threading.Lock()
+_last = None  # most recent report dict (deviceStats embeds it)
+
+_TOP_PARAMS = 8
+
+
+class HBMPreflightWarning(UserWarning):
+    """Estimated bind footprint exceeds the device memory cap. The
+    `report` attribute holds the full breakdown (same dict as
+    `last_preflight()`)."""
+
+    def __init__(self, report):
+        self.report = report
+        gib = 1 << 30
+        super().__init__(
+            "HBM pre-flight: bind footprint ~"
+            f"{report['total_bytes'] / gib:.2f} GiB exceeds device "
+            f"memory {report['cap_bytes'] / gib:.2f} GiB "
+            f"(params {report['param_bytes'] / gib:.2f} + grads "
+            f"{report['grad_bytes'] / gib:.2f} + opt "
+            f"{report['opt_bytes'] / gib:.2f} + activations "
+            f"{report['activation_bytes'] / gib:.2f}); largest: "
+            + ", ".join(f"{n}={b / gib:.3f}GiB"
+                        for n, b in report["top_params"]))
+
+
+class HBMPreflightError(RuntimeError):
+    """Strict-mode pre-flight failure (MXNET_PROFILING_HBM_STRICT=1)."""
+
+    def __init__(self, report):
+        self.report = report
+        super().__init__(str(HBMPreflightWarning(report)))
+
+
+def _strict():
+    # registered in mxnet_tpu.utils; raw read keeps bind import-light
+    return os.environ.get("MXNET_PROFILING_HBM_STRICT", "0").lower() \
+        in ("1", "true", "on")
+
+
+def _opt_factor():
+    try:
+        return float(os.environ.get("MXNET_PROFILING_OPT_FACTOR",
+                                    "2.0"))
+    except ValueError:
+        return 2.0
+
+
+def _device_cap():
+    """Device memory cap in bytes, or None when unknowable (CPU)."""
+    env = os.environ.get("MXNET_PROFILING_DEVICE_MEM_BYTES")
+    if env:
+        try:
+            cap = int(env)
+            return cap if cap > 0 else None
+        except ValueError:
+            pass
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats()
+        if stats:
+            return int(stats.get("bytes_limit", 0)) or None
+    except Exception:
+        pass
+    return None
+
+
+def _nbytes(shape, dtype):
+    import numpy as np
+
+    n = np.dtype(dtype).itemsize
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def _shard_divisor(plan, name, ndim):
+    """Product of mesh-axis sizes the plan's fitted spec for `name`
+    uses — the per-device storage divisor. 1 (replicated) on any
+    failure: over-estimating is the safe direction for a pre-flight."""
+    if plan is None:
+        return 1
+    try:
+        spec = plan.spec_for(name, ndim)
+        sizes = plan.axis_sizes
+        div = 1
+        for entry in tuple(spec):
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            for ax in axes:
+                if ax is not None:
+                    div *= int(sizes.get(ax, 1))
+        return max(div, 1)
+    except Exception:
+        return 1
+
+
+def _activation_bytes(symbol, input_shapes, training):
+    """Tile-padded bytes of every non-variable node output — the live
+    intermediate set XLA must place somewhere. Training doubles it:
+    the backward pass keeps forward values alive (mirror off)."""
+    from ..passes import cost_model as _cm
+    from ..symbol import _graph_infer, _topo
+
+    known = {k: tuple(v) for k, v in input_shapes.items()}
+    shapes, dtypes = _graph_infer(symbol._outputs, known, {},
+                                  partial=True)
+    total = 0
+    for n in _topo(symbol._outputs):
+        if n.is_variable:
+            continue
+        params = n.op.normalize_params(n.attrs)
+        for i in range(n.op.resolved_num_outputs(params)):
+            s = shapes.get((n, i))
+            if s is None:
+                continue
+            dt = dtypes.get((n, i)) or "float32"
+            total += _cm.padded_elems(s, dt) * _np_itemsize(dt)
+    return total * (2 if training else 1)
+
+
+def _np_itemsize(dtype):
+    import numpy as np
+
+    return np.dtype(dtype).itemsize
+
+
+def preflight_bind(symbol, args, grad_req, auxs=None, plan=None,
+                   data_names=()):
+    """Estimate a bind's footprint and warn/raise when it exceeds the
+    device cap (module docstring). `args`/`auxs` map name -> (shape,
+    dtype); `grad_req` maps name -> req string; `data_names` marks
+    inputs excluded from the parameter attribution table. Returns the
+    report dict (also kept as `last_preflight()`); never traces."""
+    auxs = auxs or {}
+    params = {}          # name -> per-device bytes
+    grad_bytes = 0
+    data_like = set(data_names)
+    for name, (shape, dtype) in args.items():
+        b = _nbytes(shape, dtype)
+        b //= _shard_divisor(plan, name, len(shape))
+        params[name] = b
+        if grad_req.get(name, "null") != "null":
+            grad_bytes += b
+    for name, (shape, dtype) in auxs.items():
+        params[name] = (_nbytes(shape, dtype)
+                        // _shard_divisor(plan, name, len(shape)))
+    param_bytes = sum(params.values())
+    opt_bytes = int(grad_bytes * _opt_factor()) if grad_bytes else 0
+    training = any(v != "null" for v in grad_req.values())
+    try:
+        act_bytes = _activation_bytes(
+            symbol, {n: s for n, (s, _) in args.items()}, training)
+    except Exception:
+        act_bytes = 0  # uninferable graph: report what is known
+    # batch-sharded activations: every data-like mesh axis splits them
+    if plan is not None:
+        try:
+            sizes = plan.axis_sizes
+            div = max(
+                math.prod(sizes.get(a, 1) for a in plan.batch_axes()),
+                1)
+            act_bytes //= div
+        except Exception:
+            pass
+
+    total = param_bytes + grad_bytes + opt_bytes + act_bytes
+    cap = _device_cap()
+    attributable = {n: b for n, b in params.items()
+                    if n not in data_like}
+    top = sorted(attributable.items(), key=lambda kv: -kv[1])
+    report = {
+        "param_bytes": param_bytes,
+        "grad_bytes": grad_bytes,
+        "opt_bytes": opt_bytes,
+        "activation_bytes": act_bytes,
+        "total_bytes": total,
+        "cap_bytes": cap,
+        "fits": (cap is None) or (total <= cap),
+        "training": training,
+        "sharded": plan is not None,
+        "top_params": top[:_TOP_PARAMS],
+        "n_params": len(params),
+    }
+    global _last
+    with _lock:
+        _last = report
+    if cap is not None and total > cap:
+        if _strict():
+            raise HBMPreflightError(report)
+        warnings.warn(HBMPreflightWarning(report), stacklevel=3)
+    return report
+
+
+def last_preflight():
+    """Most recent pre-flight report (None before any bind)."""
+    with _lock:
+        return dict(_last) if _last is not None else None
+
+
+def reset_preflight():
+    global _last
+    with _lock:
+        _last = None

@@ -1,0 +1,155 @@
+"""Op-level device timelines: XLA trace durations → graph nodes.
+
+The profiler's device capture (`profiler._collect_device_events`)
+yields raw Chrome trace events: one `ph=="X"` slice per executed HLO,
+named after the fused computation, with the original op path in the
+event args (`long_name` / `tf_op` / `name` metadata XLA copies from
+HLO op_metadata). The executor wraps every graph op in
+`jax.named_scope(node_name)`, so that path carries OUR node names:
+`jit(run_graph)/convolution0/convolution.3` attributes to
+`convolution0`.
+
+`aggregate_device_events` folds slices into per-node totals;
+`ingest_device_events` accumulates across captures into the
+process-wide table behind the `deviceTimelineStats` registry view
+(/statusz top-K table, dump_profile embed). Attribution never
+round-trips the device: it is pure JSON crunching at dump time."""
+from __future__ import annotations
+
+import os
+import threading
+
+from ..telemetry import register_view as _register_view
+
+_lock = threading.Lock()
+# node label -> {"count", "total_us", "max_us"}
+_ops: "dict[str, dict]" = {}
+_totals = {"events": 0, "captures": 0, "device_pids": set()}
+
+_DEFAULT_TOPK = 20
+
+# metadata keys XLA variously uses for the HLO op path, best first
+_PATH_KEYS = ("long_name", "tf_op", "name", "op_name", "hlo_op")
+
+
+def _topk():
+    try:
+        return max(1, int(os.environ.get("MXNET_PROFILING_TOPK",
+                                         _DEFAULT_TOPK)))
+    except ValueError:
+        return _DEFAULT_TOPK
+
+
+def attribute_event(ev):
+    """Graph-node label for one trace slice: first path segment of the
+    op metadata that is neither a jit wrapper nor an xla detail —
+    with the executor's named_scope, that IS the node name. Falls back
+    to the slice's own name (the fusion label)."""
+    args = ev.get("args") or {}
+    for key in _PATH_KEYS:
+        path = args.get(key)
+        if not isinstance(path, str) or not path:
+            continue
+        for seg in path.split("/"):
+            seg = seg.strip()
+            if not seg or seg.startswith(("jit(", "jvp(", "vjp(",
+                                          "transpose(", "pjit")):
+                continue
+            # first segment under the jit wrappers: the named_scope
+            # node name when present, else the raw HLO id — both are
+            # the most framework-meaningful label available
+            return seg
+    name = ev.get("name")
+    return str(name) if name else None
+
+
+def aggregate_device_events(events):
+    """Fold Chrome trace slices into {label: {count, total_us,
+    max_us}}. Only complete slices (ph=='X' with a dur) carry device
+    time; everything else (metadata, counters, B/E host pairs) is
+    ignored."""
+    out = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        dur = ev.get("dur")
+        if not isinstance(dur, (int, float)) or dur < 0:
+            continue
+        label = attribute_event(ev)
+        if not label:
+            continue
+        rec = out.get(label)
+        if rec is None:
+            rec = out[label] = {"count": 0, "total_us": 0.0,
+                                "max_us": 0.0}
+        rec["count"] += 1
+        rec["total_us"] += float(dur)
+        if dur > rec["max_us"]:
+            rec["max_us"] = float(dur)
+    return out
+
+
+def ingest_device_events(events):
+    """Merge one capture's slices into the process-wide table (the
+    profiler calls this from dump_profile, so the view snapshot in the
+    same dump already includes the capture being written)."""
+    agg = aggregate_device_events(events)
+    pids = {ev.get("pid") for ev in events
+            if isinstance(ev.get("pid"), int)}
+    with _lock:
+        for label, rec in agg.items():
+            cur = _ops.get(label)
+            if cur is None:
+                _ops[label] = dict(rec)
+            else:
+                cur["count"] += rec["count"]
+                cur["total_us"] += rec["total_us"]
+                if rec["max_us"] > cur["max_us"]:
+                    cur["max_us"] = rec["max_us"]
+        _totals["events"] += sum(r["count"] for r in agg.values())
+        _totals["captures"] += 1 if events else 0
+        _totals["device_pids"] |= pids
+    return agg
+
+
+def timeline_stats():
+    """`deviceTimelineStats` view: top-K ops by total device time.
+    {"ops": {label: {count, total_us, max_us, mean_us}}, "totals":
+    {...}}; empty until a capture was ingested."""
+    with _lock:
+        if not _ops:
+            return {}
+        items = sorted(_ops.items(), key=lambda kv: -kv[1]["total_us"])
+        k = _topk()
+        ops = {}
+        for label, rec in items[:k]:
+            ops[label] = {
+                "count": rec["count"],
+                "total_us": round(rec["total_us"], 3),
+                "max_us": round(rec["max_us"], 3),
+                "mean_us": round(rec["total_us"] / rec["count"], 3),
+            }
+        return {
+            "ops": ops,
+            "totals": {
+                "distinct_ops": len(_ops),
+                "shown": len(ops),
+                "events": _totals["events"],
+                "captures": _totals["captures"],
+                "devices": len(_totals["device_pids"]),
+                "device_time_us": round(
+                    sum(r["total_us"] for r in _ops.values()), 3),
+            },
+        }
+
+
+def reset_timeline():
+    with _lock:
+        _ops.clear()
+        _totals["events"] = 0
+        _totals["captures"] = 0
+        _totals["device_pids"] = set()
+
+
+_register_view("deviceTimelineStats", timeline_stats,
+               prom_prefix="device_timeline", omit_empty=True)

@@ -55,7 +55,10 @@ class ServedModel:
 
     def warmup(self):
         """Pre-trace every bucket: one zero-batch forward per grid
-        cell. Idempotent."""
+        cell, then one TIMED forward per cell harvested into the
+        profiling CalibrationStore (the program is warm, so the timing
+        is a real steady-state measurement and costs one extra
+        forward per bucket — warmup-time only). Idempotent."""
         if self._warm:
             return self
         for batch, length in self.spec.all_buckets():
@@ -69,9 +72,42 @@ class ServedModel:
             # finishes before get_output returns
             for i in range(pred.num_outputs):
                 pred.get_output(i)
+            self._harvest_calibration(pred, batch, length)
         self._warm = True
         self.stats.mark_warmup_done()
         return self
+
+    def _harvest_calibration(self, pred, batch, length):
+        """Time one warm forward of this bucket into the
+        CalibrationStore under the graph's canonical digest: the
+        largest bucket also writes the plain "forward" kind the
+        autotuner and cost_model.calibrated_cost read."""
+        try:
+            from .. import profiling as _profiling
+
+            if not _profiling.profiling_enabled():
+                return
+            canonical = getattr(pred._exec._compiled, "canonical",
+                                None)
+            if not canonical:
+                return
+            import time as _time
+
+            import jax as _jax
+
+            t0 = _time.perf_counter()
+            pred.forward()
+            for i in range(pred.num_outputs):
+                pred.get_output(i)  # settle: time includes the compute
+            seconds = _time.perf_counter() - t0
+            store = _profiling.calibration_store()
+            platform = _jax.default_backend()
+            store.record(canonical, platform,
+                         f"forward[{batch}x{length}]", seconds)
+            if (batch, length) == tuple(self.spec.all_buckets()[-1]):
+                store.record(canonical, platform, "forward", seconds)
+        except Exception:
+            pass  # calibration is advisory; warmup must never fail
 
     def infer(self, feed, batch, length):
         """Run one assembled batch; returns the raw padded outputs."""

@@ -38,10 +38,18 @@ def reset_stats():
 
 
 def jit_sharded(fn, in_shardings=None, out_shardings=None,
-                donate_argnums=(), static_argnums=None):
+                donate_argnums=(), static_argnums=None, digest=None,
+                kind="sharded"):
     """jax.jit with the sharded-training calling convention. None
     shardings are omitted (meshless fallback = plain jit), donation is
-    passed through, and the build is counted."""
+    passed through, and the build is counted.
+
+    `digest` (optional) routes the program through the profiling
+    layer's executable accounting under `digest:kind` — the fused
+    train step passes its plan digest so sharded executables land in
+    `deviceStats` next to the exec-cache ones. Callers that drive the
+    AOT protocol themselves (`.lower(...).compile()`) are recorded at
+    their compile call; plain callers on first dispatch."""
     kwargs = {}
     if donate_argnums:
         kwargs["donate_argnums"] = tuple(donate_argnums)
@@ -53,7 +61,16 @@ def jit_sharded(fn, in_shardings=None, out_shardings=None,
         kwargs["out_shardings"] = out_shardings
     with _lock:
         _stats["jit_builds"] += 1
-    return jax.jit(fn, **kwargs)
+    jitted = jax.jit(fn, **kwargs)
+    if digest:
+        try:
+            from ..profiling import instrument
+
+            jitted = instrument(jitted, digest=digest, kind=kind,
+                                label=getattr(fn, "__name__", None))
+        except Exception:
+            pass
+    return jitted
 
 
 def constrain(x, mesh, spec=None):

@@ -368,6 +368,61 @@ register_env(
     "GSPMD propagator.",
 )
 register_env(
+    "MXNET_PROFILING", bool, True,
+    "profiling: device-side executable accounting "
+    "(mxnet_tpu.profiling). Every framework-built jit compiles "
+    "ahead-of-time on first call per signature, records "
+    "memory_analysis/cost_analysis/compile time into the "
+    "deviceStats view, and dispatches through the captured "
+    "executable (one compile — no extra work). 0 restores raw jit "
+    "dispatch everywhere and skips all recording "
+    "(docs/observability.md).",
+)
+register_env(
+    "MXNET_PROFILING_HBM_STRICT", bool, False,
+    "profiling: escalate the HBM pre-flight warning to "
+    "HBMPreflightError — a bind whose estimated footprint (params + "
+    "grads + optimizer state + activations) exceeds the device "
+    "memory cap fails BEFORE tracing instead of OOMing after "
+    "(mxnet_tpu.profiling.preflight).",
+)
+register_env(
+    "MXNET_PROFILING_DEVICE_MEM_BYTES", int, 0,
+    "profiling: device memory cap in bytes for the HBM pre-flight. "
+    "0 = ask the backend (device.memory_stats()['bytes_limit']); "
+    "CPU jax reports nothing, so on CPU the pre-flight records its "
+    "report without warning unless this override is set (it is how "
+    "the tests fake a small device).",
+)
+register_env(
+    "MXNET_PROFILING_OPT_FACTOR", str, "2.0",
+    "profiling: optimizer-state bytes per gradient byte assumed by "
+    "the HBM pre-flight (2.0 = Adam's two moments; 1.0 for "
+    "momentum-SGD; 0 for plain SGD).",
+)
+register_env(
+    "MXNET_PROFILING_TOPK", int, 20,
+    "profiling: rows in the per-op device-time top-K table of the "
+    "deviceTimelineStats view (/statusz, dump_profile).",
+)
+register_env(
+    "MXNET_PROFILING_MAX_SIGS", int, 64,
+    "profiling: per-wrapped-jit cap on AOT-captured input "
+    "signatures; signatures beyond the cap dispatch through the raw "
+    "jit uncaptured (a guard against unbounded shape churn, which "
+    "would itself be the bug to fix).",
+)
+register_env(
+    "MXNET_CALIBRATION_CACHE", str,
+    "~/.cache/mxnet_tpu/calibration.json",
+    "profiling: CalibrationStore persistence — measured step/forward "
+    "seconds keyed by canonical graph digest + platform + kind, "
+    "harvested automatically during serving/decoding warmup and fit "
+    "epochs; cost_model.calibrated_cost() prefers these over the "
+    "analytic estimate. Delete the file to re-calibrate "
+    "(docs/observability.md).",
+)
+register_env(
     "MXNET_LOCK_WITNESS", str, "",
     "analysis: runtime lock witness "
     "(mxnet_tpu.analysis.lockwitness). '' / 'off' = disabled (the "

@@ -24,6 +24,16 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 # (shape/dtype contradictions, duplicate args, donation aliasing)
 # before tracing. Subprocesses inherit it through os.environ.
 os.environ.setdefault("MXNET_GRAPH_VERIFY", "1")
+# Calibration harvests (serving/decode warmups, Module.fit) persist
+# measured timings to MXNET_CALIBRATION_CACHE; point the suite at a
+# throwaway path so tests neither read the developer's ~/.cache table
+# nor leave their toy-graph timings behind for real runs.
+import tempfile as _tempfile  # noqa: E402
+
+os.environ.setdefault(
+    "MXNET_CALIBRATION_CACHE",
+    os.path.join(_tempfile.mkdtemp(prefix="mx_test_calib_"),
+                 "calibration.json"))
 
 # The axon sitecustomize (TPU tunnel) force-selects jax_platforms
 # "axon,cpu" at interpreter start, overriding JAX_PLATFORMS; pin the

@@ -101,6 +101,67 @@ def test_collect_device_events_rebase(tmp_path):
     assert "ts" not in by_name["process_name"]
 
 
+def test_collect_device_events_multi_file(tmp_path):
+    """A multi-host/multi-device capture writes SIBLING per-host files
+    into one run directory, and each file numbers its own devices from
+    scratch — two devices that both call themselves pid 2 must land in
+    distinct lanes (previously only the newest file was read and
+    colliding pids would have merged). A torn file is skipped without
+    dropping the others, and files of an OLDER run are ignored."""
+    import gzip
+    import os as _os
+
+    from mxnet_tpu import profiler
+
+    run_dir = tmp_path / "plugins" / "profile" / "run2"
+    run_dir.mkdir(parents=True)
+
+    def write(name, events):
+        with gzip.open(str(run_dir / name), "wt") as f:
+            json.dump({"traceEvents": events}, f)
+
+    write("a.trace.json.gz",
+          [{"name": "fusion_a", "pid": 2, "ph": "X",
+            "ts": 1.0, "dur": 2.0}])
+    write("b.trace.json.gz",
+          [{"name": "fusion_b", "pid": 2, "ph": "X",
+            "ts": 3.0, "dur": 4.0},
+           {"name": "copy_b", "pid": 3, "ph": "X",
+            "ts": 5.0, "dur": 1.0}])
+    with open(str(run_dir / "c.trace.json.gz"), "wb") as f:
+        f.write(b"not gzip at all")  # torn capture file
+
+    # an older sibling run: must not contribute events
+    old_run = tmp_path / "plugins" / "profile" / "run1"
+    old_run.mkdir()
+    with gzip.open(str(old_run / "stale.trace.json.gz"), "wt") as f:
+        json.dump({"traceEvents": [
+            {"name": "stale", "pid": 2, "ph": "X",
+             "ts": 0.0, "dur": 9.0}]}, f)
+    _os.utime(str(old_run / "stale.trace.json.gz"), (1, 1))
+
+    old_base = profiler._state.get("trace_t0_us")
+    profiler._state["trace_t0_us"] = 100.0
+    try:
+        out = profiler._collect_device_events(str(tmp_path))
+    finally:
+        if old_base is None:
+            profiler._state.pop("trace_t0_us", None)
+        else:
+            profiler._state["trace_t0_us"] = old_base
+
+    by_name = {e["name"]: e for e in out}
+    assert "stale" not in by_name
+    # file 0 keeps the historical +1000 lane; file 1's identically
+    # numbered device gets its own +2000 lane
+    assert by_name["fusion_a"]["pid"] == 1002
+    assert by_name["fusion_b"]["pid"] == 2002
+    assert by_name["copy_b"]["pid"] == 2003
+    pids = {e["pid"] for e in out}
+    assert len(pids) == 3
+    assert by_name["fusion_a"]["ts"] == 101.0  # rebased onto host
+
+
 def test_collect_device_events_empty_dir(tmp_path):
     from mxnet_tpu import profiler
 

@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""benchdiff: compare two bench result files, fail on regressions.
+
+    python tools/benchdiff.py BENCH_r04.json BENCH_r05.json
+    make bench-diff OLD=BENCH_r04.json NEW=BENCH_r05.json
+
+Accepts either raw `bench.py` output (one JSON record per line) or the
+capture wrapper the BENCH_r*.json snapshots use ({"tail": "...stderr +
+the JSON line(s)..."}). Records join on their "metric" key; for each
+metric present in both files the primary "value" is compared
+higher-is-better and a fixed set of secondary keys (latency
+percentiles, compile seconds, HBM footprint, dispatch overhead)
+lower-is-better. Any relative regression beyond the threshold (10%
+default, --threshold / BENCHDIFF_THRESHOLD) makes the exit status
+nonzero — the CI contract: a capture that got slower, hungrier or
+laggier cannot land silently.
+
+Metrics present in only one file are listed but never fail the diff:
+benches grow modes over time and a new metric has no baseline.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# secondary per-record keys where SMALLER is better (the primary
+# "value" is throughput-like: bigger is better)
+LOWER_IS_BETTER = (
+    "p50_ms", "p99_ms", "p50_token_ms", "p99_token_ms",
+    "compile_s", "hbm_peak_bytes", "dispatch_overhead_us",
+    "padding_waste", "stall_fraction",
+)
+
+
+def _records_from_text(text):
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and rec.get("metric"):
+            out[rec["metric"]] = rec  # last run of a metric wins
+    return out
+
+
+def load_records(path):
+    """{metric: record} from a bench output file (either format)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict) and "metric" in doc:
+        return {doc["metric"]: doc}
+    if isinstance(doc, dict) and isinstance(doc.get("tail"), str):
+        return _records_from_text(doc["tail"])
+    return _records_from_text(text)
+
+
+def _ratio(old, new):
+    if not isinstance(old, (int, float)) or \
+            not isinstance(new, (int, float)) or old == 0:
+        return None
+    return new / old
+
+
+def diff_records(old, new, threshold):
+    """(report_lines, regressions) comparing {metric: record} maps."""
+    lines, regressions = [], []
+    for metric in sorted(set(old) | set(new)):
+        if metric == "bench_error":
+            continue  # a failed run carries no comparable numbers
+        if metric not in old:
+            lines.append(f"  + {metric} (new, no baseline)")
+            continue
+        if metric not in new:
+            lines.append(f"  - {metric} (gone from new file)")
+            continue
+        o, n = old[metric], new[metric]
+        checks = [("value", o.get("value"), n.get("value"), True,
+                   o.get("unit", ""))]
+        for key in LOWER_IS_BETTER:
+            if key in o and key in n:
+                checks.append((key, o[key], n[key], False, key))
+        for key, ov, nv, higher_better, unit in checks:
+            r = _ratio(ov, nv)
+            if r is None:
+                continue
+            delta = r - 1.0
+            bad = (delta < -threshold) if higher_better \
+                else (delta > threshold)
+            mark = " <-- REGRESSION" if bad else ""
+            lines.append(
+                f"  {metric}.{key}: {ov:g} -> {nv:g} "
+                f"({delta:+.1%}){mark}")
+            if bad:
+                regressions.append(f"{metric}.{key}")
+    return lines, regressions
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="diff two bench result files; nonzero exit on "
+                    ">threshold regressions")
+    ap.add_argument("old", help="baseline bench output / BENCH_*.json")
+    ap.add_argument("new", help="candidate bench output / BENCH_*.json")
+    ap.add_argument(
+        "--threshold", type=float,
+        default=float(os.environ.get("BENCHDIFF_THRESHOLD", "0.10")),
+        help="relative regression tolerance (default 0.10)")
+    args = ap.parse_args(argv)
+
+    old = load_records(args.old)
+    new = load_records(args.new)
+    if not old:
+        print(f"benchdiff: no bench records in {args.old}")
+        return 2
+    if not new:
+        print(f"benchdiff: no bench records in {args.new}")
+        return 2
+
+    lines, regressions = diff_records(old, new, args.threshold)
+    print(f"benchdiff: {args.old} -> {args.new} "
+          f"(threshold {args.threshold:.0%})")
+    for line in lines:
+        print(line)
+    if regressions:
+        print(f"benchdiff: FAIL — {len(regressions)} regression(s): "
+              + ", ".join(regressions))
+        return 1
+    print("benchdiff: OK — no regressions beyond threshold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

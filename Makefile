@@ -12,7 +12,7 @@ XLA8    := XLA_FLAGS=--xla_force_host_platform_device_count=8
 .PHONY: all test nightly examples lint lint-check libs predict perl \
 	docs dryrun cache-check serving-check sync-check data-check \
 	passes-check telemetry-check decode-check race-check \
-	shard-check profiling-check bench-diff clean
+	shard-check profiling-check numerics-check bench-diff clean
 
 all: libs test
 
@@ -130,6 +130,13 @@ shard-check:
 # graphs, HBM pre-flight warns/raises before any trace)
 profiling-check:
 	$(CPUENV) bash ci/check_profiling.sh
+
+# numerics tier: test suite + runtime gates (injected NaN detected at
+# the seeded step within one drain interval, attributed to the op fed
+# by the poisoned parameter, durable flight record, host-sync budget
+# unchanged with numerics on) + paired A/B overhead bench gate
+numerics-check:
+	$(CPUENV) bash ci/check_numerics.sh
 
 # regression diff of two bench captures (nonzero exit on >10% drops):
 #   make bench-diff OLD=BENCH_r04.json NEW=BENCH_r05.json

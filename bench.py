@@ -793,6 +793,108 @@ def _sharding_bench(platform):
     })
 
 
+def _numerics_bench(platform):
+    """BENCH_MODE=numerics: run-health sentinel overhead A/B.
+
+    The same fused MLP training loop with the numerics sentinel OFF
+    and ON (NumericsMonitor, drain interval 10). Both arms live
+    side by side and each repeat times them back to back in
+    alternating order, so host-load drift hits both equally; the
+    reported overhead is the median of the paired per-repeat
+    differences, which is robust where a single off-then-on pass is
+    not. Design target (`target_pct`) is <=3% — on TPU the row's
+    reductions fuse into the step; on the CPU CI runner per-kernel
+    dispatch makes the floor higher, so the gate
+    (ci/check_numerics.sh) holds a looser regression backstop that
+    still catches a reintroduced per-step blocking sync (those cost
+    +100% or more)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.numerics import NumericsMonitor
+
+    batch, d_in, d_h, classes = 1024, 256, 512, 16
+    warmup, repeats, epochs_per_sample = 2, 10, 2
+
+    def build():
+        d = mx.sym.Variable("data")
+        h = mx.sym.FullyConnected(d, name="fc1", num_hidden=d_h)
+        h = mx.sym.Activation(h, act_type="relu", name="relu1")
+        h = mx.sym.FullyConnected(h, name="fc2", num_hidden=classes)
+        return mx.sym.SoftmaxOutput(h, name="softmax")
+
+    rs = np.random.RandomState(0)
+    X = rs.uniform(-1, 1, (batch * 2, d_in)).astype("float32")
+    Y = rs.randint(0, classes, (batch * 2,)).astype("float32")
+    batches = len(X) // batch
+
+    def setup(numerics_on):
+        it = mx.io.NDArrayIter(X, Y, batch_size=batch)
+        mod = mx.mod.Module(build(), context=mx.cpu())
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        mod.init_params(mx.initializer.Xavier())
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.01})
+        mon = None
+        if numerics_on:
+            mon = NumericsMonitor(interval=10)
+            mon.attach(mod)
+        return it, mod, mon
+
+    def epoch(it, mod, mon):
+        it.reset()
+        for b in it:
+            if mon is not None:
+                mon.note_batch(b)
+            mod.forward_backward(b)
+            mod.update()
+            if mon is not None:
+                mon.after_batch(mod)
+
+    arms = {"off": setup(False), "on": setup(True)}
+    for it, mod, mon in arms.values():
+        for _ in range(warmup):
+            epoch(it, mod, mon)
+        mod.sync()
+
+    samples = {"off": [], "on": []}
+    for rep in range(repeats):
+        order = ("off", "on") if rep % 2 == 0 else ("on", "off")
+        for k in order:
+            it, mod, mon = arms[k]
+            mod.sync()
+            tic = time.perf_counter()
+            for _ in range(epochs_per_sample):
+                epoch(it, mod, mon)
+            mod.sync()
+            us = ((time.perf_counter() - tic)
+                  / (epochs_per_sample * batches) * 1e6)
+            samples[k].append(us)
+
+    _, mod_on, mon = arms["on"]
+    mon.drain(mod_on)
+    rows = len(mon.history)
+    assert rows > 0, "sentinel drained no rows"
+
+    step_us_off = float(np.median(samples["off"]))
+    step_us_on = float(np.median(samples["on"]))
+    paired = [on - off
+              for off, on in zip(samples["off"], samples["on"])]
+    overhead = float(np.median(paired)) / step_us_off * 100.0
+
+    _emit({
+        "mode": "numerics", "platform": platform, "batch": batch,
+        "interval": 10,
+        "step_us_off": round(step_us_off, 1),
+        "step_us_on": round(step_us_on, 1),
+        "overhead_pct": round(overhead, 2),
+        "target_pct": 3.0,
+        "rows_drained": rows,
+        "unit": "us/step",
+    })
+
+
 def main():
     # BENCH_XLA_FLAGS: extra XLA flags for A/B capture runs (e.g.
     # "--xla_tpu_enable_latency_hiding_scheduler=true"); appended
@@ -853,6 +955,8 @@ def main():
         return _sharding_bench(jax.devices()[0].platform)
     if os.environ.get("BENCH_MODE", "train") == "profiling":
         return _profiling_bench(jax.devices()[0].platform)
+    if os.environ.get("BENCH_MODE", "train") == "numerics":
+        return _numerics_bench(jax.devices()[0].platform)
 
     import jax.numpy as jnp
     import numpy as np

@@ -89,6 +89,8 @@ from . import checkpoint_sharded
 from .checkpoint_sharded import load_sharded, save_sharded
 from . import monitor as _monitor_mod
 from .monitor import Monitor
+from . import numerics
+from .numerics import NumericsMonitor
 from . import profiler
 from . import analysis
 from . import passes

@@ -57,7 +57,18 @@ HOT_PATH_MANIFEST = {
     # fused train step (PR 1): the whole step is one donated XLA launch
     "mxnet_tpu/parallel/dp_step.py": (
         "FusedTrainStep.step", "FusedTrainStep.run_steps",
-        "FusedTrainStep._place_data",
+        "FusedTrainStep._place_data", "FusedTrainStep._absorb",
+    ),
+    # monitor (numerics PR): tic fences once, toc drains once — no
+    # per-tensor fetches on the fit loop
+    "mxnet_tpu/monitor.py": (
+        "Monitor.tic", "Monitor.toc", "Monitor.toc_print",
+        "Monitor._on_tensor", "Monitor._render_batch",
+    ),
+    # numerics run-health hot hooks (numerics PR): note_batch keeps a
+    # reference; after_batch only counts steps between drains
+    "mxnet_tpu/numerics/__init__.py": (
+        "NumericsMonitor.note_batch", "NumericsMonitor.after_batch",
     ),
     # device-resident metric accumulation (PR 3)
     "mxnet_tpu/metric.py": ("EvalMetric.update_device",),

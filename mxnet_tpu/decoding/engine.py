@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import profiler as _profiler
+from .. import utils as _utils
 from ..serving.batcher import pick_bucket
 from . import config as _cfg
 from . import attention as _attn
@@ -86,6 +88,11 @@ class DecodeEngine:
         self._copy_fn = None
         self._trace_counts = {}
         self._warm = False
+        # MXNET_NUMERICS_DECODE_GUARD: each decode step also returns a
+        # device scalar counting active rows with NaN/Inf logits;
+        # scalars accumulate here and drain in one fetch (drain_guard)
+        self._guard = bool(_utils.getenv("MXNET_NUMERICS_DECODE_GUARD"))
+        self._guard_pending = []
         # executable-accounting key: the decode grid is a function of
         # (model config, batch, paging layout, kernel) — deterministic
         # within a process, which is all deviceStats needs
@@ -143,16 +150,46 @@ class DecodeEngine:
         # only, so this COUNTS TRACES (see module docstring)
         self._trace_counts[name] = self._trace_counts.get(name, 0) + 1
 
+    # -------------------------------------------------- numerics guard
+    _GUARD_CAP = 1024  # device scalars between drains
+
+    def _run_decode(self, fn, *args):
+        """Dispatch one decode program; absorb the guard scalar (still
+        on device — zero sync) when the guard is enabled."""
+        res = fn(*args)
+        if not self._guard:
+            out, self._k, self._v = res
+            return out
+        out, self._k, self._v, bad = res
+        self._guard_pending.append(bad)
+        if len(self._guard_pending) > self._GUARD_CAP:
+            del self._guard_pending[:-self._GUARD_CAP]
+        return out
+
+    def drain_guard(self):
+        """Pending nonfinite-logit counts -> host in ONE blocking fetch
+        (counted in hostSyncStats); [] (no fetch) when empty or the
+        guard is off. The scheduler drains on an interval and feeds
+        nonzero counts into DecodeStats (`decodingStats` view)."""
+        if not self._guard_pending:
+            return []
+        pending, self._guard_pending = self._guard_pending, []
+        host = jax.device_get(pending)
+        _profiler.count_host_sync("blocking_fetches")
+        _profiler.count_host_sync("metric_fetches")
+        return [int(v) for v in host]
+
     # -------------------------------------------------------- builders
     def _build_decode_fn(self, bucket):
         cfg, attn = self.cfg, self._attn
+        guard = self._guard
 
         def impl(params, tokens, k_pages, v_pages, page_table,
                  lengths, active):
             self._note_trace(f"decode@{bucket}")
             return _model.decode_forward(
                 params, tokens, k_pages, v_pages, page_table,
-                lengths, active, cfg=cfg, attn=attn)
+                lengths, active, cfg=cfg, attn=attn, with_stats=guard)
 
         donate = (2, 3) if self._donate else ()
         return self._instrument(jax.jit(impl, donate_argnums=donate),
@@ -215,14 +252,15 @@ class DecodeEngine:
         for bucket in self.page_buckets:
             self._decode_fns[bucket] = self._build_decode_fn(bucket)
             b = self.max_batch
-            out, self._k, self._v = self._decode_fns[bucket](
-                self._params,
+            out = self._run_decode(
+                self._decode_fns[bucket], self._params,
                 np.zeros((b,), np.int32), self._k, self._v,
                 np.zeros((b, bucket), np.int32),
                 np.zeros((b,), np.int32),
                 np.zeros((b,), bool))
             out.block_until_ready()
         self._harvest_calibration()
+        self._guard_pending = []  # warmup rows are all-masked noise
         self._warm = True
         return self
 
@@ -243,8 +281,8 @@ class DecodeEngine:
             b = self.max_batch
             for bucket in self.page_buckets:
                 t0 = _time.perf_counter()
-                out, self._k, self._v = self._decode_fns[bucket](
-                    self._params,
+                out = self._run_decode(
+                    self._decode_fns[bucket], self._params,
                     np.zeros((b,), np.int32), self._k, self._v,
                     np.zeros((b, bucket), np.int32),
                     np.zeros((b,), np.int32),
@@ -284,10 +322,9 @@ class DecodeEngine:
         configured bucket. Returns next tokens as a host (B,) array
         (the stream/EOS sync — one fetch per step, by design)."""
         bucket = page_table.shape[1]
-        fn = self._decode_fns[bucket]
-        out, self._k, self._v = fn(
-            self._params, tokens, self._k, self._v, page_table,
-            lengths, active)
+        out = self._run_decode(
+            self._decode_fns[bucket], self._params, tokens,
+            self._k, self._v, page_table, lengths, active)
         return np.asarray(out)
 
     def copy_page(self, src, dst):

@@ -177,13 +177,16 @@ def prefill_forward(params, tokens, length, k_pages, v_pages,
 
 # ---------------------------------------------------------------- decode
 def decode_forward(params, tokens, k_pages, v_pages, page_table,
-                   lengths, active, *, cfg, attn):
+                   lengths, active, *, cfg, attn, with_stats=False):
     """One decode step over the full fixed-shape batch.
 
     tokens (B,) int32 last emitted token per row; lengths (B,) tokens
     already in cache; active (B,) bool. Inactive rows write to / read
     from the scratch page and their outputs are ignored by the host.
-    Returns (next_tokens (B,), k_pages, v_pages).
+    Returns (next_tokens (B,), k_pages, v_pages); with_stats=True
+    (the MXNET_NUMERICS_DECODE_GUARD path) appends a scalar count of
+    ACTIVE rows whose logits hold any NaN/Inf — computed inside the
+    jit, so the guard adds zero host syncs to the step.
     """
     page_size = k_pages.shape[2]
     b = tokens.shape[0]
@@ -204,5 +207,10 @@ def decode_forward(params, tokens, k_pages, v_pages, page_table,
         x = x + _mlp(params, i, _rms(x, params[f"l{i}.ln2"]))
     x = _rms(x, params["ln_f"])
     logits = x @ params["embed"].T
-    return jnp.argmax(logits, axis=-1).astype(jnp.int32), \
-        k_pages, v_pages
+    next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if with_stats:
+        bad_rows = jnp.any(~jnp.isfinite(logits), axis=-1)
+        nonfinite = jnp.sum(
+            jnp.where(active, bad_rows, False).astype(jnp.int32))
+        return next_tokens, k_pages, v_pages, nonfinite
+    return next_tokens, k_pages, v_pages

@@ -425,6 +425,12 @@ class ContinuousScheduler:
             s.length += 1
             self._handle_token(s, int(out[row]))
         self.stats.note_pool()
+        if engine._guard and self.stats.steps % 16 == 0:
+            # interval drain of the logits guard (one fetch per 16
+            # steps); counts surface in decodingStats/nonfinite_*
+            for n in engine.drain_guard():
+                if n:
+                    self.stats.note_nonfinite(n)
 
     # -------------------------------------------------------------- loop
     def _loop(self):

@@ -56,6 +56,10 @@ _PREFILL_LATENCY_MS = _treg.histogram(
     "mxnet_tpu_decode_prefill_latency_ms",
     "Per-prompt prefill latency (time-to-first-token's device half)",
     buckets=(1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000))
+_NONFINITE = _treg.counter(
+    "mxnet_tpu_decode_nonfinite_logits_total",
+    "Active rows whose decode logits held NaN/Inf "
+    "(MXNET_NUMERICS_DECODE_GUARD)")
 
 
 def _register(key, stats):
@@ -113,6 +117,8 @@ class DecodeStats:
             self.prefill_tokens = 0
             self.decode_tokens = 0
             self.steps = 0
+            self.nonfinite_logit_steps = 0
+            self.nonfinite_logits = 0
             self.traces_at_warmup = None
             self._prefill_s = 0.0
             self._decode_s = 0.0
@@ -163,6 +169,14 @@ class DecodeStats:
             _TOKEN_LATENCY_MS.observe(
                 seconds / live_rows * 1e3, model=self._key)
 
+    def note_nonfinite(self, rows, steps=1):
+        """Guard trip: `rows` active rows produced NaN/Inf logits
+        across `steps` decode steps (MXNET_NUMERICS_DECODE_GUARD)."""
+        with self._lock:
+            self.nonfinite_logit_steps += steps
+            self.nonfinite_logits += rows
+        _NONFINITE.inc(rows, model=self._key)
+
     def note_preempted(self, n=1):
         with self._lock:
             self.preemptions += n
@@ -200,6 +214,8 @@ class DecodeStats:
                 "prefill_tokens": self.prefill_tokens,
                 "decode_tokens": self.decode_tokens,
                 "steps": self.steps,
+                "nonfinite_logit_steps": self.nonfinite_logit_steps,
+                "nonfinite_logits": self.nonfinite_logits,
                 "prefill_tokens_per_s": round(
                     self.prefill_tokens / self._prefill_s, 1)
                 if self._prefill_s else 0.0,

@@ -21,6 +21,12 @@ rebuild to close the gap with checkpoint-and-restart orchestration.
 - `FaultInjector` (env MXNET_TPU_FAULT_INJECT="epoch:N" or "step:N")
   kills training at epoch N / global step N — the fault-injection
   harness used by the resume tests and ci/check_input_stall.py.
+- MXNET_TPU_FAULT_INJECT="nan:step:N[:param]" is the NUMERICS fault:
+  instead of killing the process it poisons one gradient tensor with
+  NaN on-device at fused step N (parse_nan_inject, consumed by
+  FusedTrainStep at trace time). The run keeps going — the point is
+  to exercise mxnet_tpu.numerics detection + first-bad-op attribution
+  (ci/check_numerics.py).
 """
 from __future__ import annotations
 
@@ -50,6 +56,26 @@ def latest_checkpoint(prefix):
 def data_state_path(prefix):
     """Where fit_auto_resume persists the input-stream position."""
     return prefix + "-data-state.json"
+
+
+def parse_nan_inject(spec=None):
+    """Parse the numerics fault spec 'nan:step:N[:param]' from `spec`
+    or MXNET_TPU_FAULT_INJECT. Returns (step, param_or_None), or None
+    when the spec is absent/not a nan fault. The kill-style 'epoch:N' /
+    'step:N' specs return None here, and 'nan:...' harmlessly matches
+    neither branch of FaultInjector — the two consumers are disjoint."""
+    if spec is None:
+        spec = os.environ.get("MXNET_TPU_FAULT_INJECT", "")
+    parts = spec.split(":")
+    if len(parts) < 3 or parts[0] != "nan" or parts[1] != "step":
+        return None
+    try:
+        step = int(parts[2])
+    except ValueError:
+        raise MXNetError(f"bad nan fault spec {spec!r}: step must be "
+                         "an integer ('nan:step:N[:param]')")
+    param = parts[3] if len(parts) > 3 and parts[3] else None
+    return (step, param)
 
 
 class FaultInjector(object):
@@ -158,6 +184,18 @@ def fit_auto_resume(module, train_data, prefix, num_epoch,
             injected.note_step()
 
         batch_cbs.append(step_cb)
+
+    if "numerics" not in fit_kwargs:
+        from . import numerics as _numerics
+        from . import utils as _utils
+
+        if _utils.getenv("MXNET_NUMERICS"):
+            # auto-resumed runs get a run log next to the checkpoints
+            # by default: the log's open() writes a resume marker, so
+            # one JSONL file tells the whole kill/restart story
+            fit_kwargs["numerics"] = _numerics.NumericsMonitor(
+                run_log=_utils.getenv("MXNET_NUMERICS_RUNLOG")
+                or (prefix + "-runlog.jsonl"))
 
     def epoch_cb(epoch, symbol, arg, aux):
         _model.save_checkpoint(

@@ -1,0 +1,191 @@
+"""mxnet_tpu.numerics — device-resident training-run health.
+
+Answers the question that pages people on a training fleet: "the loss
+went NaN at step 40k — which op, which step, what did the norms look
+like before it". Three layers:
+
+  sentinel     per-step stats row computed INSIDE the fused train step
+               (sentinel.py; FusedTrainStep.enable_sentinel), drained
+               in ONE device_get per log interval — zero new
+               steady-state host syncs
+  rules        EWMA spike / nonfinite / dead / exploding-group
+               detection over drained rows (rules.py), with first-bad-
+               op attribution through the executor's eager monitored
+               pass on a nonfinite trip (attribution.py), dumped into
+               a crash flight record (telemetry/flight.py)
+  run log      append-only JSONL record of the run (runlog.py) plus
+               the `numericsStats` telemetry view / Prometheus gauges
+               (stats.py)
+
+`NumericsMonitor` is the facade `fit` drives: enabled explicitly
+(``mod.fit(..., numerics=NumericsMonitor(...))``) or ambiently via
+``MXNET_NUMERICS=1`` (knobs: ``MXNET_NUMERICS_INTERVAL``, ``_HISTORY``,
+``_RUNLOG``, ``_SPIKE``, ``_ATTRIBUTION`` — docs/observability.md
+"Run health").
+"""
+from __future__ import annotations
+
+import logging
+from collections import deque
+
+from .. import utils as _utils
+from ..telemetry import flight as _flight
+from . import attribution as _attribution
+from . import rules as _rules
+from . import runlog as _runlog
+from . import sentinel as _sentinel
+from . import stats as _stats
+from .rules import AnomalyDetector, NumericsAnomaly
+from .runlog import RunEventLog, read_events
+from .sentinel import SentinelSpec
+
+__all__ = [
+    "NumericsMonitor", "NumericsAnomaly", "AnomalyDetector",
+    "SentinelSpec", "RunEventLog", "read_events", "from_fit_arg",
+]
+
+
+class NumericsMonitor:
+    """Run-health driver for one training run.
+
+    Attach to a Module after its optimizer is initialized (fit does
+    this); per batch, `note_batch` keeps the step inputs for
+    attribution (a reference — zero copies, zero syncs) and
+    `after_batch` drains the device-side sentinel rows every
+    `interval` steps. `interval <= 0` drains only at epoch ends.
+    """
+
+    def __init__(self, interval=None, history=None, run_log=None,
+                 spike=None, attribution=None, detector=None,
+                 logger=None):
+        self.interval = (int(interval) if interval is not None
+                         else _utils.getenv("MXNET_NUMERICS_INTERVAL"))
+        hist = (int(history) if history is not None
+                else _utils.getenv("MXNET_NUMERICS_HISTORY"))
+        if run_log is None:
+            run_log = _utils.getenv("MXNET_NUMERICS_RUNLOG") or None
+        self.attribution = (
+            bool(attribution) if attribution is not None
+            else _utils.getenv("MXNET_NUMERICS_ATTRIBUTION"))
+        if detector is None:
+            spike = (float(spike) if spike is not None
+                     else float(_utils.getenv("MXNET_NUMERICS_SPIKE")))
+            detector = _rules.AnomalyDetector(spike=spike)
+        self.detector = detector
+        self.logger = logger or logging.getLogger("mxnet_tpu.numerics")
+        self.history = deque(maxlen=max(1, hist))
+        self.anomalies = []
+        self.run_log = _runlog.RunEventLog(run_log) if run_log else None
+        self._module = None
+        self._last_batch = None
+        self._active = False
+
+    # ------------------------------------------------------- lifecycle
+    def attach(self, module):
+        """Enable the sentinel on the module's fused step and open the
+        run log. Inert (with a warning) when the module has no fused
+        train path — the sentinel lives inside that jit."""
+        ensure = getattr(module, "_ensure_sentinel", None)
+        spec = ensure() if ensure is not None else None
+        if spec is None:
+            self.logger.warning(
+                "numerics: module has no fused train step (eager "
+                "binding?) — sentinel disabled for this run")
+            self._active = False
+            return self
+        self._module = module
+        self._active = True
+        if self.run_log is not None:
+            self.run_log.open()
+        return self
+
+    @property
+    def active(self):
+        return self._active
+
+    # -------------------------------------------------------- hot path
+    def note_batch(self, batch):
+        """Keep THIS batch as the attribution replay input. Reference
+        only — no copy, no device touch (fit's per-step path)."""
+        self._last_batch = batch
+
+    def after_batch(self, module, epoch=0, nbatch=0):
+        """Interval check on the fit hot path: drains (one non-blocking
+        fetch) only when the fused step counter crosses the interval."""
+        if not self._active:
+            return
+        fs = getattr(module, "_fused_step", None)
+        if fs is None or fs._sentinel is None:
+            return
+        if self.interval > 0 and fs._t and fs._t % self.interval == 0:
+            # non-blocking: completed rows only, never a pipeline stall
+            self.drain(module, wait=False)
+
+    # ----------------------------------------------------------- drain
+    def drain(self, module=None, epoch=None, metrics=None, wait=True):
+        """Fetch pending sentinel rows (ONE device_get), run the rules,
+        log, and — on a nonfinite trip — attribute and flight-dump.
+        `wait=False` fetches only rows already complete on device (the
+        hot-path interval drain); the default blocks for everything."""
+        module = module or self._module
+        if not self._active or module is None:
+            return []
+        fs = getattr(module, "_fused_step", None)
+        if fs is None or fs._sentinel is None:
+            return []
+        spec = fs._sentinel
+        drained = fs.drain_sentinel(wait=wait)
+        new_anomalies = []
+        for t, lr, raw in drained:
+            row = spec.decode_row(raw)
+            self.history.append({"step": int(t), "lr": float(lr), **row})
+            _stats.note_row(t, row, lr=lr)
+            if self.run_log is not None:
+                self.run_log.step(t, row, lr=lr)
+            new_anomalies.extend(self.detector.observe(t, row))
+        for anom in new_anomalies:
+            self._handle_anomaly(module, anom)
+        if epoch is not None and self.run_log is not None:
+            self.run_log.epoch(epoch, metrics)
+        return new_anomalies
+
+    def _handle_anomaly(self, module, anom):
+        culprit = None
+        if anom.kind == "nonfinite" and self.attribution:
+            culprit = _attribution.attribute(module, self._last_batch)
+        self.anomalies.append(anom)
+        self.logger.warning(
+            "numerics anomaly: %s%s", anom.message,
+            f" — first bad op: {culprit}" if culprit else "")
+        _stats.note_anomaly(anom, first_bad_op=culprit)
+        if self.run_log is not None:
+            self.run_log.anomaly(anom, first_bad_op=culprit)
+        # the crash-flight payload: the anomaly, the culprit, and the
+        # last-K sentinel rows leading up to it — everything the 3am
+        # page needs, durable before anything else can fall over
+        _flight.maybe_dump(
+            f"numerics:{anom.kind}",
+            extra={"numerics": {
+                "anomaly": anom.to_dict(),
+                "first_bad_op": culprit,
+                "recent_rows": [
+                    {k: v for k, v in r.items() if k != "groups"}
+                    for r in list(self.history)],
+            }})
+
+    def close(self):
+        if self.run_log is not None:
+            self.run_log.close()
+
+
+def from_fit_arg(arg, logger=None):
+    """Resolve fit's `numerics=` argument: a NumericsMonitor passes
+    through, True builds one, None consults MXNET_NUMERICS, False
+    disables."""
+    if isinstance(arg, NumericsMonitor):
+        return arg
+    if arg is True:
+        return NumericsMonitor(logger=logger)
+    if arg is None and _utils.getenv("MXNET_NUMERICS"):
+        return NumericsMonitor(logger=logger)
+    return None

@@ -1,0 +1,77 @@
+"""First-bad-op attribution: name the op where the NaN was born.
+
+When a sentinel row trips the nonfinite rule, the poisoned values are
+already in the parameters/activations — the question "which op" is
+answerable by replaying one batch through the executor's EAGER
+monitored pass (`Executor._forward_monitored`, the reference's
+MXExecutorSetMonitorCallback surface): every node output flows through
+a callback in topological order, so the FIRST non-finite output names
+the op. The replay is a cold path (one batch, per-op host checks, runs
+only after an anomaly), so its per-op syncs are deliberate and cheap
+relative to the page it answers.
+"""
+from __future__ import annotations
+
+
+class _FoundBadOp(Exception):
+    """Early exit from the monitored pass once the culprit is known."""
+
+    def __init__(self, name):
+        super().__init__(name)
+        self.name = name
+
+
+def first_bad_op(executor, is_train=True):
+    """Replay the executor's CURRENT bound inputs through the eager
+    monitored pass; return the name of the first node output holding a
+    NaN/Inf (e.g. ``fc1_output``), or None when the replay is clean.
+
+    The caller must have loaded the offending batch into the
+    executor's arg arrays (and flushed fused params back) first."""
+    import jax
+    import jax.numpy as jnp
+
+    def check(name, nd_arr):
+        v = nd_arr._data
+        if not jnp.issubdtype(v.dtype, jnp.floating):
+            return
+        if bool(jax.device_get(jnp.any(~jnp.isfinite(v)))):
+            raise _FoundBadOp(name)
+
+    arg_vals, aux_vals = executor._gather_inputs()
+    prev = executor._monitor_callback
+    executor._monitor_callback = check
+    try:
+        executor._forward_monitored(
+            is_train, executor._rng, arg_vals, aux_vals)
+    except _FoundBadOp as hit:
+        return hit.name
+    finally:
+        executor._monitor_callback = prev
+    return None
+
+
+def attribute(module, batch=None):
+    """Module-level entry: flush fused params back to the executors,
+    load `batch` (the saved step inputs; optional when the executor
+    already holds them), and bisect. Returns the culprit op-output name
+    or None. Never raises — attribution is advisory."""
+    try:
+        flush = getattr(module, "_flush_fused", None)
+        if flush is not None:
+            module._fused_dirty = True  # force: params live in the step
+            flush()
+        exe = module._exec_group.execs[0]
+        if batch is not None:
+            names = [n for n, _s in module._exec_group.data_shapes]
+            for name, arr in zip(names, batch.data):
+                exe.arg_dict[name][:] = arr
+            if batch.label:
+                lnames = [n for n, _s in
+                          (module._exec_group.label_shapes or [])]
+                for name, arr in zip(lnames, batch.label):
+                    if name in exe.arg_dict:
+                        exe.arg_dict[name][:] = arr
+        return first_bad_op(exe, is_train=True)
+    except Exception:
+        return None

@@ -1,0 +1,131 @@
+"""Anomaly rules over drained sentinel rows.
+
+Pure host-side logic (numpy-free, device-free): a drained row dict
+(`SentinelSpec.decode_row`) goes in, zero or more structured
+`NumericsAnomaly` records come out. The detector is deliberately
+stateful-but-tiny — one EWMA float, one consecutive-zero counter per
+param group — so it serializes trivially alongside the run event log.
+
+Rules:
+
+  nonfinite      any NaN/Inf in outputs, gradients, or parameters.
+                 The page-at-3am rule: trips attribution + flight dump.
+  grad_spike     global grad norm > `spike` x its EWMA (after a short
+                 warmup so init noise doesn't trip it).
+  dead_group     a param group's grad norm is exactly 0.0 for
+                 `dead_after` consecutive drained rows — a detached
+                 subgraph or a saturated activation. Fires once per
+                 group until the group revives.
+  exploding_group a group's update/param ratio above `explode` — the
+                 update is rewriting the weights wholesale, the usual
+                 prelude to divergence.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+
+@dataclass
+class NumericsAnomaly:
+    kind: str            # nonfinite | grad_spike | dead_group | exploding_group
+    step: int            # optimizer step of the offending row
+    message: str
+    value: float = 0.0   # the measured quantity that tripped
+    threshold: float = 0.0
+    group: str = ""      # param group, for the group-scoped rules
+    detail: dict = field(default_factory=dict)
+
+    def to_dict(self):
+        return {
+            "kind": self.kind, "step": self.step,
+            "message": self.message, "value": self.value,
+            "threshold": self.threshold, "group": self.group,
+            "detail": dict(self.detail),
+        }
+
+
+class AnomalyDetector:
+    """Applies the rule set row-by-row; `observe` returns the anomalies
+    of one row."""
+
+    def __init__(self, spike=8.0, ewma_alpha=0.1, warmup=5,
+                 dead_after=3, explode=1.0):
+        self.spike = float(spike)
+        self.ewma_alpha = float(ewma_alpha)
+        self.warmup = int(warmup)
+        self.dead_after = int(dead_after)
+        self.explode = float(explode)
+        self._ewma = None
+        self._seen = 0
+        self._dead = {}       # group -> consecutive zero-grad rows
+        self._dead_fired = set()
+
+    def observe(self, step, row):
+        """row: SentinelSpec.decode_row output. Returns
+        [NumericsAnomaly]."""
+        anomalies = []
+        step = int(step)
+
+        nf = (row.get("out_nonfinite", 0.0)
+              + row.get("grad_nonfinite", 0.0)
+              + row.get("param_nonfinite", 0.0))
+        gn = row.get("grad_norm", 0.0)
+        loss_bad = not math.isfinite(row.get("loss", 0.0))
+        if nf > 0 or loss_bad or not math.isfinite(gn):
+            where = [k for k in ("out", "grad", "param")
+                     if row.get(f"{k}_nonfinite", 0.0) > 0]
+            if loss_bad:
+                where.append("loss")
+            anomalies.append(NumericsAnomaly(
+                kind="nonfinite", step=step, value=float(nf),
+                message=(f"non-finite values at step {step} "
+                         f"({'/'.join(where) or 'grad_norm'}): "
+                         f"{nf:.0f} elements"),
+                detail={"where": where}))
+
+        if math.isfinite(gn):
+            if (self._ewma is not None and self._seen >= self.warmup
+                    and gn > self.spike * self._ewma):
+                anomalies.append(NumericsAnomaly(
+                    kind="grad_spike", step=step, value=gn,
+                    threshold=self.spike * self._ewma,
+                    message=(f"grad norm {gn:.4g} at step {step} is "
+                             f"{gn / max(self._ewma, 1e-30):.1f}x the "
+                             f"EWMA {self._ewma:.4g}")))
+            else:
+                # a spike must not poison its own baseline
+                self._ewma = (gn if self._ewma is None else
+                              (1 - self.ewma_alpha) * self._ewma
+                              + self.ewma_alpha * gn)
+                self._seen += 1
+
+        for g, seg in row.get("groups", {}).items():
+            ggn = seg.get("grad_norm", 0.0)
+            if ggn == 0.0:
+                self._dead[g] = self._dead.get(g, 0) + 1
+                if (self._dead[g] >= self.dead_after
+                        and g not in self._dead_fired):
+                    self._dead_fired.add(g)
+                    anomalies.append(NumericsAnomaly(
+                        kind="dead_group", step=step, group=g,
+                        threshold=float(self.dead_after),
+                        message=(f"param group '{g}' has zero gradient "
+                                 f"for {self._dead[g]} consecutive "
+                                 f"sentinel rows")))
+            else:
+                self._dead[g] = 0
+                self._dead_fired.discard(g)
+            pn = seg.get("param_norm", 0.0)
+            un = seg.get("update_norm", 0.0)
+            if pn > 0 and math.isfinite(un) and math.isfinite(pn):
+                ratio = un / pn
+                if ratio > self.explode:
+                    anomalies.append(NumericsAnomaly(
+                        kind="exploding_group", step=step, group=g,
+                        value=ratio, threshold=self.explode,
+                        message=(f"param group '{g}' update/param "
+                                 f"ratio {ratio:.3g} at step {step} "
+                                 f"(> {self.explode:g}): the update is "
+                                 f"rewriting the weights")))
+        return anomalies

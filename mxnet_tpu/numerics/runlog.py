@@ -1,0 +1,131 @@
+"""Run event log: an append-only JSONL record of one training run.
+
+The durable half of run health: every drained sentinel row becomes one
+line ({"event": "step", step, loss, lr, grad_norm, ...}), anomalies
+and epoch boundaries get their own lines, and a restarted run appends
+a {"event": "resume"} marker instead of truncating — so the file reads
+as the full history across preemptions, the signal ROADMAP item 5's
+elastic control plane needs to tell divergence from preemption.
+
+Durability model (the mxnet_tpu.data tiny-state pattern): each line is
+one `write()` + `flush()`, and `open()` repairs a torn trailing line
+(a kill mid-write) by truncating to the last complete line before
+appending. Readers (`read_events`) tolerate a torn tail too, so the
+log is usable even while a crashed writer's file is being inspected.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def read_events(path):
+    """Parse every complete JSONL event; a torn trailing line (crash
+    mid-write) is skipped, never fatal."""
+    events = []
+    if not os.path.exists(path):
+        return events
+    with open(path, "rb") as f:
+        data = f.read()
+    for line in data.split(b"\n"):
+        if not line.strip():
+            continue
+        try:
+            events.append(json.loads(line))
+        except ValueError:
+            continue  # torn tail
+    return events
+
+
+class RunEventLog:
+    def __init__(self, path):
+        self.path = path
+        self._f = None
+
+    # ------------------------------------------------------- lifecycle
+    def open(self, context=None):
+        """Open for append, repairing a torn trailing line first. When
+        the file already holds events, a `resume` marker (with the last
+        recorded step) is appended — the run continues the same record.
+        `context` merges extra fields into the start/resume marker."""
+        if self._f is not None:
+            return self
+        resumed_from = None
+        if os.path.exists(self.path):
+            self._repair_tail()
+            prior = read_events(self.path)
+            if prior:
+                steps = [e.get("step") for e in prior
+                         if e.get("event") == "step"]
+                resumed_from = max(steps) if steps else 0
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._f = open(self.path, "a")
+        marker = {
+            "event": "resume" if resumed_from is not None else "start",
+            "pid": os.getpid(),
+            "time_unix": time.time(),
+        }
+        if resumed_from is not None:
+            marker["last_step"] = resumed_from
+        if context:
+            marker.update(context)
+        self.append(marker)
+        return self
+
+    def _repair_tail(self):
+        """Truncate a torn (kill-mid-write) trailing line so the append
+        stream stays line-aligned."""
+        with open(self.path, "rb") as f:
+            data = f.read()
+        if not data or data.endswith(b"\n"):
+            return
+        cut = data.rfind(b"\n") + 1
+        with open(self.path, "r+b") as f:
+            f.truncate(cut)
+
+    def close(self):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    # --------------------------------------------------------- writing
+    def append(self, event):
+        """One event -> one line -> one flush. A crash between lines
+        loses at most the in-flight event; `open()`/`read_events`
+        absorb a crash mid-line."""
+        if self._f is None:
+            self.open()
+        self._f.write(json.dumps(event, default=str) + "\n")
+        self._f.flush()
+
+    def step(self, step, row, lr=None):
+        """Record one drained sentinel row."""
+        ev = {
+            "event": "step", "step": int(step),
+            "loss": row.get("loss"), "grad_norm": row.get("grad_norm"),
+            "param_norm": row.get("param_norm"),
+            "update_ratio": row.get("update_ratio"),
+            "out_nonfinite": row.get("out_nonfinite"),
+            "grad_nonfinite": row.get("grad_nonfinite"),
+            "param_nonfinite": row.get("param_nonfinite"),
+        }
+        if lr is not None:
+            ev["lr"] = float(lr)
+        self.append(ev)
+
+    def anomaly(self, anom, first_bad_op=None):
+        ev = {"event": "anomaly", **anom.to_dict()}
+        if first_bad_op is not None:
+            ev["first_bad_op"] = first_bad_op
+        self.append(ev)
+
+    def epoch(self, epoch, metrics=None):
+        ev = {"event": "epoch", "epoch": int(epoch)}
+        if metrics:
+            pairs = metrics.items() if hasattr(metrics, "items") \
+                else metrics
+            ev["metrics"] = {k: float(v) for k, v in pairs}
+        self.append(ev)

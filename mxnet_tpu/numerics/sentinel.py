@@ -1,0 +1,168 @@
+"""Sentinel engine: per-step run-health statistics computed INSIDE the
+fused train step.
+
+The reference's only numeric introspection is the monitor's eager
+per-tensor callback (python/mxnet/monitor.py) — one host sync per
+tensor per batch, unusable in the pipelined fit. The sentinel inverts
+that: a fixed vector of scalars (loss, NaN/Inf counts, per-param-group
+gradient/parameter/update norms) is computed at trace time inside the
+step jit, so the stats ride the existing dispatch for free. Rows
+accumulate device-side (FusedTrainStep keeps the jax arrays, never
+reading them) and drain in ONE `jax.device_get` per log interval —
+the PR 3 device-metric discipline (metric.py _drain_pending) applied
+to run health.
+
+Sharding: every column is a full reduction (sum / max over a whole
+parameter or gradient), so under a `ShardingPlan` GSPMD lowers them to
+psum/pmax across the fsdp/tp axes inside the trace and the row comes
+out replicated — sharded and unsharded runs produce the same row
+(tests/test_numerics.py sharded-parity case).
+
+Column layout (all float32):
+
+  [0] loss           mean of the first head output (the framework's
+                     loss proxy — SoftmaxOutput/LinearRegressionOutput
+                     heads emit per-row losses through out 0's vjp)
+  [1] out_nonfinite  NaN/Inf count across every head output
+  then, per param group g (derived by stripping weight/bias/gamma/...
+  suffixes, so `fc1_weight` and `fc1_bias` share group `fc1`):
+  grad_norm_sq, grad_max_abs, grad_nonfinite,
+  param_norm_sq, param_nonfinite, update_norm_sq
+
+Global grad norm, update/param ratio etc. are derived HOST-side at
+drain time from the per-group sums (`decode_row`) — the device row
+stays minimal.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+# Suffixes that group a parameter under its layer (the reference's
+# naming convention: <layer>_<kind>); longest-match first.
+GROUP_SUFFIXES = (
+    "_moving_mean", "_moving_var", "_weight", "_bias", "_gamma",
+    "_beta",
+)
+
+HEAD_COLS = ("loss", "out_nonfinite")
+GROUP_COLS = (
+    "grad_norm_sq", "grad_max_abs", "grad_nonfinite",
+    "param_norm_sq", "param_nonfinite", "update_norm_sq",
+)
+
+
+def group_of(name):
+    """Param group of one parameter name (suffix stripped)."""
+    for suf in GROUP_SUFFIXES:
+        if name.endswith(suf) and len(name) > len(suf):
+            return name[: -len(suf)]
+    return name
+
+
+class SentinelSpec:
+    """Fixed column layout + the traceable row function for one model.
+
+    `trainable` fixes the group set and the iteration order (trace-time
+    python, so the order is baked into the jit); params outside
+    `trainable` carry no gradient and are excluded — frozen weights
+    cannot diverge.
+    """
+
+    def __init__(self, trainable):
+        self.trainable = tuple(trainable)
+        groups = {}
+        for n in self.trainable:
+            groups.setdefault(group_of(n), []).append(n)
+        self.groups = {g: tuple(ns) for g, ns in groups.items()}
+        self.columns = tuple(HEAD_COLS) + tuple(
+            f"{g}/{c}" for g in self.groups for c in GROUP_COLS)
+
+    @property
+    def width(self):
+        return len(self.columns)
+
+    # ------------------------------------------------------ trace time
+    def compute(self, outs, params, new_params, grads):
+        """The sentinel row, as trace-time jnp — called from inside the
+        fused step body with that step's forward outputs, pre-update
+        params, post-update params, and gradients. Pure reductions:
+        under GSPMD every sum/max lowers to in-trace collectives and
+        the row replicates."""
+        import jax.numpy as jnp
+
+        f32 = jnp.float32
+
+        def nonfinite(x):
+            if not jnp.issubdtype(x.dtype, jnp.floating):
+                return jnp.zeros((), f32)
+            return jnp.sum(~jnp.isfinite(x)).astype(f32)
+
+        cols = [
+            jnp.mean(outs[0]).astype(f32),
+            functools.reduce(jnp.add, [nonfinite(o) for o in outs]),
+        ]
+        for names in self.groups.values():
+            gs = [grads[n].astype(f32) for n in names]
+            ps = [params[n].astype(f32) for n in names]
+            us = [(new_params[n].astype(f32) - params[n].astype(f32))
+                  for n in names]
+            add = functools.reduce(jnp.add, [
+                jnp.sum(jnp.square(g)) for g in gs])
+            gmax = functools.reduce(jnp.maximum, [
+                jnp.max(jnp.abs(g)) for g in gs])
+            cols += [
+                add,
+                gmax.astype(f32),
+                functools.reduce(jnp.add, [nonfinite(g) for g in gs]),
+                functools.reduce(jnp.add, [
+                    jnp.sum(jnp.square(p)) for p in ps]),
+                functools.reduce(jnp.add, [nonfinite(p) for p in ps]),
+                functools.reduce(jnp.add, [
+                    jnp.sum(jnp.square(u)) for u in us]),
+            ]
+        return jnp.stack(cols).astype(f32)
+
+    # ------------------------------------------------------ drain time
+    def decode_row(self, row):
+        """Host row (1-D, width `self.width`) -> structured dict with
+        the derived globals the anomaly rules consume."""
+        vals = [float(v) for v in row]
+        d = {"loss": vals[0], "out_nonfinite": vals[1], "groups": {}}
+        gsq = psq = usq = 0.0
+        gnf = pnf = 0.0
+        for i, g in enumerate(self.groups):
+            base = len(HEAD_COLS) + i * len(GROUP_COLS)
+            seg = dict(zip(GROUP_COLS, vals[base:base + len(GROUP_COLS)]))
+            d["groups"][g] = {
+                "grad_norm": math.sqrt(max(seg["grad_norm_sq"], 0.0))
+                if math.isfinite(seg["grad_norm_sq"]) else
+                seg["grad_norm_sq"],
+                "grad_max_abs": seg["grad_max_abs"],
+                "grad_nonfinite": seg["grad_nonfinite"],
+                "param_norm": math.sqrt(max(seg["param_norm_sq"], 0.0))
+                if math.isfinite(seg["param_norm_sq"]) else
+                seg["param_norm_sq"],
+                "param_nonfinite": seg["param_nonfinite"],
+                "update_norm": math.sqrt(max(seg["update_norm_sq"], 0.0))
+                if math.isfinite(seg["update_norm_sq"]) else
+                seg["update_norm_sq"],
+            }
+            gsq += seg["grad_norm_sq"]
+            psq += seg["param_norm_sq"]
+            usq += seg["update_norm_sq"]
+            gnf += seg["grad_nonfinite"]
+            pnf += seg["param_nonfinite"]
+        d["grad_norm"] = (math.sqrt(max(gsq, 0.0))
+                          if math.isfinite(gsq) else gsq)
+        d["param_norm"] = (math.sqrt(max(psq, 0.0))
+                           if math.isfinite(psq) else psq)
+        d["update_norm"] = (math.sqrt(max(usq, 0.0))
+                            if math.isfinite(usq) else usq)
+        d["update_ratio"] = (
+            d["update_norm"] / d["param_norm"]
+            if d["param_norm"] and math.isfinite(d["param_norm"])
+            else 0.0)
+        d["grad_nonfinite"] = gnf
+        d["param_nonfinite"] = pnf
+        return d

@@ -1,0 +1,85 @@
+"""`numericsStats` telemetry view + native Prometheus instruments.
+
+Live run health for /metrics and /statusz (PR 7 registry machinery):
+the NumericsMonitor pushes each drained row's headline numbers here,
+so an exporter scrape answers "what do the norms look like right now"
+without touching the device — the snapshot is pure host state
+refreshed at drain intervals (the exporter-hot-path rule: a view
+function must never block on device values).
+
+Registered omit_empty: processes that never enable numerics keep their
+/statusz byte-identical (the serving/decoding snapshot-pinning
+convention).
+"""
+from __future__ import annotations
+
+import threading
+
+from ..telemetry import register_view as _register_view
+from ..telemetry import registry as _treg
+
+_lock = threading.Lock()
+_state: dict = {}
+
+_GRAD_NORM = _treg.gauge(
+    "mxnet_tpu_numerics_grad_norm",
+    "Global gradient norm of the most recently drained sentinel row")
+_LOSS = _treg.gauge(
+    "mxnet_tpu_numerics_loss",
+    "Head-output mean (loss proxy) of the most recent sentinel row")
+_UPDATE_RATIO = _treg.gauge(
+    "mxnet_tpu_numerics_update_ratio",
+    "Global update-norm / param-norm ratio of the most recent row")
+_ANOMALIES = _treg.counter(
+    "mxnet_tpu_numerics_anomalies_total",
+    "Numerics anomalies by kind (nonfinite, grad_spike, dead_group, "
+    "exploding_group)")
+
+
+def numerics_stats():
+    """Snapshot for the `numericsStats` view ({} while inactive)."""
+    with _lock:
+        return {k: (dict(v) if isinstance(v, dict) else v)
+                for k, v in _state.items()}
+
+
+def reset_numerics_stats():
+    with _lock:
+        _state.clear()
+
+
+_register_view("numericsStats", numerics_stats, prom_prefix="numerics",
+               omit_empty=True)
+
+
+def note_row(step, row, lr=None):
+    """Record one drained sentinel row's headline numbers."""
+    with _lock:
+        _state["last_step"] = int(step)
+        _state["loss"] = row.get("loss", 0.0)
+        _state["grad_norm"] = row.get("grad_norm", 0.0)
+        _state["param_norm"] = row.get("param_norm", 0.0)
+        _state["update_ratio"] = row.get("update_ratio", 0.0)
+        _state["out_nonfinite"] = row.get("out_nonfinite", 0.0)
+        _state["grad_nonfinite"] = row.get("grad_nonfinite", 0.0)
+        _state["param_nonfinite"] = row.get("param_nonfinite", 0.0)
+        if lr is not None:
+            _state["lr"] = float(lr)
+        _state["rows_drained"] = _state.get("rows_drained", 0) + 1
+        _state.setdefault("anomalies_total", 0)
+        _state.setdefault("anomalies", {})
+    _GRAD_NORM.set(row.get("grad_norm", 0.0))
+    _LOSS.set(row.get("loss", 0.0))
+    _UPDATE_RATIO.set(row.get("update_ratio", 0.0))
+
+
+def note_anomaly(anom, first_bad_op=None):
+    with _lock:
+        _state["anomalies_total"] = _state.get("anomalies_total", 0) + 1
+        kinds = _state.setdefault("anomalies", {})
+        kinds[anom.kind] = kinds.get(anom.kind, 0) + 1
+        last = anom.to_dict()
+        if first_bad_op is not None:
+            last["first_bad_op"] = first_bad_op
+        _state["last_anomaly"] = last
+    _ANOMALIES.inc(1, kind=anom.kind)

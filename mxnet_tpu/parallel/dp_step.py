@@ -204,6 +204,12 @@ class FusedTrainStep:
 
         self._multi_cache = {}     # (k, stacked) -> jitted k-step loop
         self._multi_compiled = {}  # (k, stacked) -> AOT executable
+        # numerics sentinel (mxnet_tpu.numerics): when a SentinelSpec
+        # is enabled, every step program additionally returns one stats
+        # row; rows pile up here DEVICE-side until drain_sentinel()
+        self._sentinel = None
+        self._sentinel_pending = []   # [(rows (k, C) array, [(t, lr)])]
+        self._sentinel_dropped = 0
         self._jitted = self._build()
         self._compiled = None  # AOT executable, built on first run
 
@@ -275,6 +281,8 @@ class FusedTrainStep:
         self._bucket_active = bucket is not None
         gsh = self._gather_sh
         mesh = self._mesh
+        sentinel = self._sentinel
+        nan_inj = self._nan_inject_plan()
 
         def gather_c(tree):
             """Pin fsdp-stored params to their compute layout inside
@@ -324,6 +332,19 @@ class FusedTrainStep:
 
             outs, vjp_fn, aux_upd = jax.vjp(fwd, train_p, has_aux=True)
             (grads,) = vjp_fn([jnp.ones_like(o) for o in outs])
+
+            if nan_inj is not None:
+                # fault-injection (MXNET_TPU_FAULT_INJECT=nan:step:N):
+                # poison one gradient tensor ON DEVICE at step N — a
+                # jnp.where on the step counter, baked into the trace,
+                # so the injected run compiles the same program shape
+                # as a healthy one (no retrace, no host branch)
+                iname, istep = nan_inj
+                g = grads[iname]
+                grads = dict(grads)
+                grads[iname] = jnp.where(
+                    jnp.equal(t, np.int32(istep)),
+                    jnp.full_like(g, jnp.nan), g)
 
             new_params = dict(params)
             new_states = dict(states)
@@ -402,6 +423,13 @@ class FusedTrainStep:
                     if k in auxs
                 },
             }
+            if sentinel is not None:
+                # numerics sentinel row: every reduction here happens
+                # inside the jit, so under a mesh GSPMD turns them into
+                # the cross-shard psums for free and the row comes out
+                # replicated — norms are GLOBAL regardless of the plan
+                row = sentinel.compute(outs, params, new_params, grads)
+                return outs, new_params, new_states, new_auxs, row
             return outs, new_params, new_states, new_auxs
 
         self._step_fn = step  # raw traceable body (multi-step loop)
@@ -423,10 +451,13 @@ class FusedTrainStep:
             # practice); pinning them could fail on rank-0 outputs.
             # Multi-process: replicate outputs (one small all-gather)
             # so every process can read them without a collective fetch
-            kwargs["out_shardings"] = (
+            out_sh = (
                 self._repl if self._nproc > 1 else None,
                 self._param_sh, state_sh, aux_sh,
             )
+            if sentinel is not None:
+                out_sh = out_sh + (self._repl,)
+            kwargs["out_shardings"] = out_sh
         from ..sharding.lower import jit_sharded
 
         return jit_sharded(
@@ -448,6 +479,108 @@ class FusedTrainStep:
             except Exception:
                 pass
         return digest
+
+    def _nan_inject_plan(self):
+        """(param_name, step) for the fault injector's 'nan:step:N'
+        spec, or None. Resolved at build time so the poison bakes into
+        the trace. Lazy import: fault.py imports the model layer."""
+        from ..fault import parse_nan_inject
+
+        spec = parse_nan_inject()
+        if spec is None:
+            return None
+        istep, pname = spec
+        if pname is None:
+            pname = self._trainable[0] if self._trainable else None
+        if pname not in self._trainable:
+            self._logger.warning(
+                "nan injection target %r is not a trainable parameter "
+                "— injection disabled", pname)
+            return None
+        return (pname, istep)
+
+    # -------------------------------------------------- numerics sentinel
+    # device-resident rows between drains are bounded; a run that never
+    # drains (numerics enabled, no monitor attached) drops the oldest
+    _SENTINEL_CAP = 4096
+
+    def enable_sentinel(self, spec):
+        """Bake a numerics SentinelSpec into the step programs: every
+        step then returns one extra replicated stats row. Rebuilds the
+        jits — cheap before first compile (AOT compilation is lazy),
+        a recompile after. Idempotent for the same spec."""
+        if self._sentinel is spec:
+            return
+        self._sentinel = spec
+        self._jitted = self._build()
+        self._compiled = None
+        self._multi_cache.clear()
+        self._multi_compiled.clear()
+
+    def _absorb(self, res, meta):
+        """Unpack one dispatch's result into the owned training state;
+        stash sentinel rows (still ON DEVICE — zero sync) when enabled.
+        `meta` is [(t, lr)], one entry per row the result carries."""
+        if self._sentinel is None:
+            outs, self.params, self.states, self.auxs = res
+            return outs
+        outs, self.params, self.states, self.auxs, rows = res
+        self._sentinel_pending.append((rows, list(meta)))
+        total = sum(len(m) for _r, m in self._sentinel_pending)
+        while total > self._SENTINEL_CAP and \
+                len(self._sentinel_pending) > 1:
+            _r, m = self._sentinel_pending.pop(0)
+            total -= len(m)
+            self._sentinel_dropped += len(m)
+        return outs
+
+    @staticmethod
+    def _rows_ready(rows):
+        try:
+            return rows.is_ready()
+        except AttributeError:
+            return True
+
+    def drain_sentinel(self, wait=True):
+        """Move pending sentinel rows to host in ONE fetch (counted in
+        hostSyncStats exactly like the device-metric drain, PR 3).
+        Returns [(t, lr, row)] with row a 1-D float32 vector in the
+        spec's column order; [] (no fetch) when nothing is pending.
+
+        `wait=False` is the steady-state mode (NumericsMonitor's
+        interval drains): only rows whose step has already COMPLETED
+        on device are fetched, so the drain never stalls the dispatch
+        pipeline behind an in-flight step — those rows ride the next
+        drain. `wait=True` (epoch ends, manual drains, device Monitor
+        toc) blocks for everything pending."""
+        pending = self._sentinel_pending
+        if not pending:
+            return []
+        if wait:
+            take = len(pending)
+        else:
+            # dispatch order == completion order: the first unready
+            # entry bounds everything after it
+            take = 0
+            for rows, _m in pending:
+                if not self._rows_ready(rows):
+                    break
+                take += 1
+            if take == 0:
+                return []
+        self._sentinel_pending = pending[take:]
+        pending = pending[:take]
+        host = jax.device_get([r for r, _m in pending])
+        _profiler.count_host_sync("blocking_fetches")
+        _profiler.count_host_sync("metric_fetches")
+        out = []
+        for mat, (_rows, metas) in zip(host, pending):
+            mat = np.asarray(mat)
+            if mat.ndim == 1:
+                mat = mat[None]
+            for i, (t, lr) in enumerate(metas):
+                out.append((int(t), float(lr), mat[i]))
+        return out
 
     # -------------------------------------------------------------- run
     def _place_data(self, data_vals):
@@ -501,13 +634,13 @@ class FusedTrainStep:
                 except Exception:  # fall back to dispatch-compiled jit
                     self._compiled = False
             fn = self._compiled if self._compiled else self._jitted
+            meta = ((self._t, float(lr)),)
             try:
-                outs, self.params, self.states, self.auxs = fn(*args)
+                outs = self._absorb(fn(*args), meta)
             except (TypeError, ValueError):
                 # shape/dtype drift (e.g. a differently-sized final
                 # batch): the AOT executable is exact-shape; re-dispatch
-                outs, self.params, self.states, self.auxs = \
-                    self._jitted(*args)
+                outs = self._absorb(self._jitted(*args), meta)
         return outs
 
     # ------------------------------------------------- multi-step loop
@@ -524,9 +657,11 @@ class FusedTrainStep:
         if fn is not None:
             return fn
         step_fn = self._step_fn
+        sentinel = self._sentinel
 
         def multi(params, states, auxs, data, lrs, ts):
             carry = (params, states, auxs)
+            rows = None
             if k > 1:
                 if stacked:
                     xs = ({n: v[:-1] for n, v in data.items()},
@@ -535,23 +670,31 @@ class FusedTrainStep:
                     def body(c, x):
                         data_i, lr_i, t_i = x
                         p, s, a = c
-                        _o, p2, s2, a2 = step_fn(
-                            p, s, a, data_i, lr_i, t_i)
-                        return (p2, s2, a2), None
+                        res = step_fn(p, s, a, data_i, lr_i, t_i)
+                        return (res[1], res[2], res[3]), \
+                            (res[4] if sentinel is not None else None)
                 else:
                     xs = (lrs[:-1], ts[:-1])
 
                     def body(c, x):
                         lr_i, t_i = x
                         p, s, a = c
-                        _o, p2, s2, a2 = step_fn(
-                            p, s, a, data, lr_i, t_i)
-                        return (p2, s2, a2), None
-                carry, _ = jax.lax.scan(body, carry, xs)
+                        res = step_fn(p, s, a, data, lr_i, t_i)
+                        return (res[1], res[2], res[3]), \
+                            (res[4] if sentinel is not None else None)
+                carry, rows = jax.lax.scan(body, carry, xs)
             params, states, auxs = carry
             last = {n: v[-1] for n, v in data.items()} if stacked \
                 else data
-            return step_fn(params, states, auxs, last, lrs[-1], ts[-1])
+            res = step_fn(params, states, auxs, last, lrs[-1], ts[-1])
+            if sentinel is None:
+                return res
+            outs, p2, s2, a2, last_row = res
+            # (k, C) row matrix: scan ys for the first k-1 steps plus
+            # the peeled final step — same drain shape as k step()s
+            all_rows = (jnp.concatenate([rows, last_row[None]], 0)
+                        if rows is not None else last_row[None])
+            return outs, p2, s2, a2, all_rows
 
         kwargs = {"donate_argnums": (0, 1, 2)}
         if self._mesh is not None:
@@ -571,10 +714,13 @@ class FusedTrainStep:
             kwargs["in_shardings"] = (
                 self._param_sh, state_sh, aux_sh, data_sh, None, None,
             )
-            kwargs["out_shardings"] = (
+            out_sh = (
                 self._repl if self._nproc > 1 else None,
                 self._param_sh, state_sh, aux_sh,
             )
+            if sentinel is not None:
+                out_sh = out_sh + (self._repl,)
+            kwargs["out_shardings"] = out_sh
         from ..sharding.lower import jit_sharded
 
         fn = jit_sharded(
@@ -621,8 +767,8 @@ class FusedTrainStep:
                 args = (self.params, self.states, self.auxs, placed,
                         np.float32(lrs[i]), np.int32(ts[i]))
                 with self._ambient():
-                    outs, self.params, self.states, self.auxs = \
-                        self._jitted(*args)
+                    outs = self._absorb(
+                        self._jitted(*args), ((ts[i], lrs[i]),))
             return outs
         lrs_v = np.asarray(lrs, np.float32)
         ts_v = np.asarray(ts, np.int32)
@@ -665,10 +811,11 @@ class FusedTrainStep:
                     ex = False
                 self._multi_compiled[key] = ex
             call = ex if ex else fn
+            meta = tuple(zip(ts, lrs))
             try:
-                outs, self.params, self.states, self.auxs = call(*args)
+                outs = self._absorb(call(*args), meta)
             except (TypeError, ValueError):
-                outs, self.params, self.states, self.auxs = fn(*args)
+                outs = self._absorb(fn(*args), meta)
         return outs
 
     def sync(self):

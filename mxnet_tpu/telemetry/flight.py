@@ -44,9 +44,11 @@ def enabled():
     return bool(flight_dir())
 
 
-def flight_record(reason, exc=None):
+def flight_record(reason, exc=None, extra=None):
     """The record itself (pure build, no I/O): reason, wall time,
-    exception traceback when given, last-N spans, full statusz."""
+    exception traceback when given, last-N spans, full statusz.
+    `extra` is a caller-supplied JSON-able dict attached verbatim under
+    "extra" (e.g. numerics anomaly context — mxnet_tpu.numerics)."""
     rec = {
         "reason": reason,
         "pid": os.getpid(),
@@ -55,6 +57,8 @@ def flight_record(reason, exc=None):
         "spans": [s.to_dict() for s in _trace.recent_spans()],
         "stats": _http.statusz(),
     }
+    if extra is not None:
+        rec["extra"] = extra
     if exc is not None:
         rec["exception"] = {
             "type": type(exc).__name__,
@@ -65,7 +69,7 @@ def flight_record(reason, exc=None):
     return rec
 
 
-def dump_flight_record(reason, exc=None, path=None):
+def dump_flight_record(reason, exc=None, path=None, extra=None):
     """Write the record atomically; returns the path. Explicit `path`
     overrides the env dir (programmatic dumps)."""
     if path is None:
@@ -82,7 +86,7 @@ def dump_flight_record(reason, exc=None, path=None):
         # crashing threads each get a coherent record); the slow part
         # — the disk write — happens OUTSIDE it, so one thread's dump
         # never stalls behind another's fsync-speed I/O
-        rec = flight_record(reason, exc=exc)
+        rec = flight_record(reason, exc=exc, extra=extra)
         payload = json.dumps(rec, default=str)
     tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
     with open(tmp, "w") as f:
@@ -91,13 +95,13 @@ def dump_flight_record(reason, exc=None, path=None):
     return path
 
 
-def maybe_dump(reason, exc=None):
+def maybe_dump(reason, exc=None, extra=None):
     """Best-effort dump iff enabled; never raises (called from
     excepthooks and the fault injector's raise path)."""
     if not enabled():
         return None
     try:
-        return dump_flight_record(reason, exc=exc)
+        return dump_flight_record(reason, exc=exc, extra=extra)
     except Exception:
         return None
 

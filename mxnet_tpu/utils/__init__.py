@@ -423,6 +423,56 @@ register_env(
     "(docs/observability.md).",
 )
 register_env(
+    "MXNET_NUMERICS", bool, False,
+    "numerics: enable the device-resident run-health layer "
+    "(mxnet_tpu.numerics) in fit — a per-step sentinel row (loss, "
+    "NaN/Inf counts, per-param-group gradient/parameter/update "
+    "norms) computed inside the fused train step, drained in one "
+    "device fetch per MXNET_NUMERICS_INTERVAL steps, with anomaly "
+    "rules, first-bad-op attribution, and the numericsStats view "
+    "(docs/observability.md 'Run health').",
+)
+register_env(
+    "MXNET_NUMERICS_INTERVAL", int, 10,
+    "numerics: steps between sentinel drains (each drain is ONE "
+    "blocking device fetch). <= 0 drains only at epoch boundaries "
+    "— the setting CI uses to prove fit's host-sync budget is "
+    "unchanged with numerics on (ci/check_numerics.py).",
+)
+register_env(
+    "MXNET_NUMERICS_HISTORY", int, 64,
+    "numerics: sentinel rows kept in the in-memory history ring — "
+    "the 'what did the norms look like before it' context attached "
+    "to crash flight records on an anomaly.",
+)
+register_env(
+    "MXNET_NUMERICS_RUNLOG", str, "",
+    "numerics: path of the append-only JSONL run event log (step "
+    "rows, anomalies, epoch marks; resume-friendly — a restarted "
+    "run appends a 'resume' marker). '' disables; fit_auto_resume "
+    "defaults it to <prefix>-runlog.jsonl when numerics is on.",
+)
+register_env(
+    "MXNET_NUMERICS_SPIKE", str, "8.0",
+    "numerics: grad-norm spike threshold — a drained global grad "
+    "norm above SPIKE x its EWMA raises a grad_spike anomaly "
+    "(float; EWMA warms up for a few rows first).",
+)
+register_env(
+    "MXNET_NUMERICS_ATTRIBUTION", bool, True,
+    "numerics: on a nonfinite anomaly, replay the saved step inputs "
+    "through the executor's eager monitored pass to name the FIRST "
+    "op whose output is non-finite (cold path; per-op host checks "
+    "run only after a trip). 0 skips the replay.",
+)
+register_env(
+    "MXNET_NUMERICS_DECODE_GUARD", bool, False,
+    "numerics: decode-tier logits guard — each decode step also "
+    "emits a device-side count of non-finite logits on active rows, "
+    "drained every MXNET_NUMERICS_INTERVAL steps into "
+    "decodingStats (nonfinite_logit_steps / nonfinite_logits).",
+)
+register_env(
     "MXNET_LOCK_WITNESS", str, "",
     "analysis: runtime lock witness "
     "(mxnet_tpu.analysis.lockwitness). '' / 'off' = disabled (the "

@@ -431,6 +431,7 @@ def test_decoding_stats_view_shape_pinned():
             "submitted", "completed", "failed", "rejected", "expired",
             "preemptions", "readmissions", "prefills",
             "prefill_tokens", "decode_tokens", "steps",
+            "nonfinite_logit_steps", "nonfinite_logits",
             "prefill_tokens_per_s", "decode_tokens_per_s",
             "p50_token_ms", "p95_token_ms", "p99_token_ms",
             "traces_since_warmup", "waiting", "active", "pages_total",

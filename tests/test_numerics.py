@@ -1,0 +1,488 @@
+"""mxnet_tpu.numerics: sentinel math vs a numpy oracle (incl. the
+2x2x2 sharded-parity invariant), anomaly-rule unit tests, injected-NaN
+end-to-end first-bad-op attribution, run-event-log resume continuity,
+and the host-sync accounting of every drain path (sentinel, legacy
+monitor, decode guard) — the PR's acceptance checklist in test form."""
+import glob
+import json
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import fault, profiler
+from mxnet_tpu.numerics import (AnomalyDetector, NumericsMonitor,
+                                SentinelSpec, read_events)
+from mxnet_tpu.numerics import stats as nstats
+from mxnet_tpu.numerics.sentinel import group_of
+
+needs8 = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices")
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    """Numerics reads its knobs from env at construction time; these
+    tests each set exactly what they need."""
+    for var in ("MXNET_NUMERICS", "MXNET_NUMERICS_INTERVAL",
+                "MXNET_NUMERICS_HISTORY", "MXNET_NUMERICS_RUNLOG",
+                "MXNET_NUMERICS_SPIKE", "MXNET_NUMERICS_ATTRIBUTION",
+                "MXNET_NUMERICS_DECODE_GUARD", "MXNET_TPU_FAULT_INJECT",
+                "MXNET_TELEMETRY_FLIGHT_DIR",
+                "MXNET_TPU_OPT_STATE_DTYPE"):
+        monkeypatch.delenv(var, raising=False)
+    nstats.reset_numerics_stats()
+    yield
+
+
+def _mlp():
+    d = mx.sym.Variable("data")
+    f1 = mx.sym.FullyConnected(d, name="fc1", num_hidden=16)
+    a1 = mx.sym.Activation(f1, name="relu1", act_type="relu")
+    f2 = mx.sym.FullyConnected(a1, name="fc2", num_hidden=4)
+    return mx.sym.SoftmaxOutput(f2, name="softmax")
+
+
+def _iter(n=64, feat=8, classes=4, batch=32, seed=0):
+    rs = np.random.RandomState(seed)
+    X = rs.uniform(-1, 1, (n, feat)).astype(np.float32)
+    Y = rs.randint(0, classes, (n,)).astype(np.float32)
+    return mx.io.NDArrayIter(X, Y, batch_size=batch)
+
+
+def _batch(batch=32, feat=8, classes=4, seed=1):
+    rs = np.random.RandomState(seed)
+    X = rs.uniform(-1, 1, (batch, feat)).astype(np.float32)
+    Y = rs.randint(0, classes, (batch,)).astype(np.float32)
+    return mx.io.DataBatch(data=[mx.nd.array(X)], label=[mx.nd.array(Y)])
+
+
+def _fused_module():
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (32, 8))],
+             label_shapes=[("softmax_label", (32,))])
+    mx.random.seed(7)
+    mod.init_params(mx.initializer.Uniform(0.07))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    assert mod._fused_step is not None
+    return mod
+
+
+# ------------------------------------------------------ sentinel engine
+def test_group_of():
+    assert group_of("fc1_weight") == "fc1"
+    assert group_of("fc1_bias") == "fc1"
+    assert group_of("bn_moving_mean") == "bn"
+    assert group_of("bn_gamma") == "bn"
+    assert group_of("plain") == "plain"
+    assert group_of("_weight") == "_weight"  # suffix-only: no group
+
+
+def _oracle_fixture(seed=5):
+    names = ("fc1_weight", "fc1_bias", "fc2_weight")
+    rs = np.random.RandomState(seed)
+    params = {n: rs.randn(4, 3).astype(np.float32) for n in names}
+    grads = {n: rs.randn(4, 3).astype(np.float32) for n in names}
+    outs = [rs.randn(6, 2).astype(np.float32),
+            rs.randn(3).astype(np.float32)]
+    return names, params, grads, outs
+
+
+def _compute(spec, outs, params, new_params, grads):
+    row = spec.compute(
+        [jnp.asarray(o) for o in outs],
+        {k: jnp.asarray(v) for k, v in params.items()},
+        {k: jnp.asarray(v) for k, v in new_params.items()},
+        {k: jnp.asarray(v) for k, v in grads.items()})
+    return spec.decode_row(np.asarray(row))
+
+
+def test_sentinel_compute_matches_numpy_oracle():
+    names, params, grads, outs = _oracle_fixture()
+    spec = SentinelSpec(names)
+    assert spec.columns[:2] == ("loss", "out_nonfinite")
+    assert set(spec.groups) == {"fc1", "fc2"}
+    assert spec.groups["fc1"] == ("fc1_weight", "fc1_bias")
+    new_params = {n: params[n] - 0.1 * grads[n] for n in names}
+    d = _compute(spec, outs, params, new_params, grads)
+
+    approx = lambda v: pytest.approx(v, rel=1e-5, abs=1e-6)
+    assert d["loss"] == approx(float(outs[0].mean()))
+    assert d["out_nonfinite"] == 0.0
+    for g, members in spec.groups.items():
+        seg = d["groups"][g]
+        gsq = sum(float((grads[n] ** 2).sum()) for n in members)
+        psq = sum(float((params[n] ** 2).sum()) for n in members)
+        usq = sum(float(((new_params[n] - params[n]) ** 2).sum())
+                  for n in members)
+        assert seg["grad_norm"] == approx(math.sqrt(gsq))
+        assert seg["param_norm"] == approx(math.sqrt(psq))
+        assert seg["update_norm"] == approx(math.sqrt(usq))
+        assert seg["grad_max_abs"] == approx(
+            max(float(np.abs(grads[n]).max()) for n in members))
+    # derived globals reduce over the groups
+    gsq = sum(float((grads[n] ** 2).sum()) for n in names)
+    psq = sum(float((params[n] ** 2).sum()) for n in names)
+    assert d["grad_norm"] == approx(math.sqrt(gsq))
+    assert d["param_norm"] == approx(math.sqrt(psq))
+    assert d["update_ratio"] == approx(d["update_norm"] / d["param_norm"])
+
+
+def test_sentinel_counts_nonfinite():
+    names, params, grads, outs = _oracle_fixture()
+    spec = SentinelSpec(names)
+    grads["fc1_weight"][0, 0] = np.nan
+    grads["fc1_weight"][0, 1] = np.inf
+    params["fc2_weight"][1, 1] = np.inf
+    outs[0][0, 0] = np.nan
+    new_params = {n: params[n] - 0.1 * grads[n] for n in names}
+    d = _compute(spec, outs, params, new_params, grads)
+    assert d["out_nonfinite"] == 1.0
+    assert d["groups"]["fc1"]["grad_nonfinite"] == 2.0
+    assert d["groups"]["fc2"]["param_nonfinite"] == 1.0
+    assert d["grad_nonfinite"] == 2.0
+    assert d["param_nonfinite"] == 1.0
+    assert not math.isfinite(d["loss"])  # NaN head output poisons mean
+
+
+# --------------------------------------------------------- fit wiring
+def test_fit_populates_history_runlog_and_stats(tmp_path):
+    log = str(tmp_path / "run.jsonl")
+    mon = NumericsMonitor(interval=2, run_log=log)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(_iter(n=128), num_epoch=2, numerics=mon, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+    assert mon.active
+    steps = [r["step"] for r in mon.history]
+    assert steps == list(range(1, 9))  # 4 batches/epoch x 2 epochs
+    for r in mon.history:
+        assert math.isfinite(r["loss"])
+        assert r["grad_norm"] > 0 and r["param_norm"] > 0
+        assert r["update_ratio"] > 0
+        assert r["grad_nonfinite"] == 0 and r["out_nonfinite"] == 0
+    assert not any(a.kind == "nonfinite" for a in mon.anomalies)
+
+    ev = read_events(log)
+    kinds = [e["event"] for e in ev]
+    assert kinds[0] == "start"
+    assert kinds.count("step") == 8
+    assert kinds.count("epoch") == 2
+    step_ev = [e for e in ev if e["event"] == "step"]
+    assert step_ev[0]["lr"] == pytest.approx(0.1)
+    assert "grad_norm" in step_ev[0]
+
+    snap = nstats.numerics_stats()
+    assert snap["last_step"] == 8
+    assert snap["rows_drained"] == 8
+    assert snap["grad_norm"] > 0
+
+
+def test_drain_is_one_device_get():
+    mod = _fused_module()
+    mon = NumericsMonitor(interval=0)  # manual drain only
+    mon.attach(mod)
+    b = _batch()
+    for _ in range(3):
+        mod.forward_backward(b)
+        mod.update()
+    profiler.reset_host_sync_stats()
+    mon.drain(mod)
+    st = profiler.host_sync_stats()
+    assert st["blocking_fetches"] == 1  # N pending rows, ONE fetch
+    assert st["metric_fetches"] == 1
+    assert [r["step"] for r in mon.history] == [1, 2, 3]
+
+
+# -------------------------------------------------------- anomaly rules
+def _row(loss=0.1, gn=1.0, groups=(), out_nf=0.0, grad_nf=0.0,
+         param_nf=0.0):
+    d = {"loss": loss, "out_nonfinite": out_nf,
+         "grad_nonfinite": grad_nf, "param_nonfinite": param_nf,
+         "grad_norm": gn, "param_norm": 1.0, "update_norm": 0.01,
+         "update_ratio": 0.01, "groups": {}}
+    for name, ggn, pn, un in groups:
+        d["groups"][name] = {
+            "grad_norm": ggn, "grad_max_abs": ggn, "grad_nonfinite": 0.0,
+            "param_norm": pn, "param_nonfinite": 0.0, "update_norm": un}
+    return d
+
+
+def test_detector_nonfinite_rule():
+    det = AnomalyDetector()
+    assert det.observe(1, _row()) == []
+    out = det.observe(2, _row(grad_nf=2.0))
+    assert [a.kind for a in out] == ["nonfinite"]
+    assert out[0].step == 2 and out[0].value == 2.0
+    assert out[0].detail["where"] == ["grad"]
+    out = det.observe(3, _row(loss=float("nan")))
+    assert out[0].kind == "nonfinite" and "loss" in out[0].detail["where"]
+    # a non-finite grad_norm trips even with zero element counts
+    out = det.observe(4, _row(gn=float("inf")))
+    assert out[0].kind == "nonfinite"
+
+
+def test_detector_grad_spike_and_ewma_hygiene():
+    det = AnomalyDetector(spike=4.0, warmup=3)
+    # inside warmup a huge value is absorbed, never flagged
+    assert det.observe(1, _row(gn=1.0)) == []
+    assert det.observe(2, _row(gn=100.0)) == []
+    det2 = AnomalyDetector(spike=4.0, warmup=3)
+    for s in range(1, 6):
+        assert det2.observe(s, _row(gn=1.0)) == []
+    out = det2.observe(6, _row(gn=10.0))
+    assert [a.kind for a in out] == ["grad_spike"]
+    assert out[0].value == 10.0
+    assert out[0].threshold == pytest.approx(4.0)
+    # the spike did not poison its own baseline: normal row is quiet,
+    # an identical second spike still trips against the old EWMA
+    assert det2.observe(7, _row(gn=1.0)) == []
+    assert [a.kind for a in det2.observe(8, _row(gn=10.0))] \
+        == ["grad_spike"]
+
+
+def test_detector_dead_group_fires_once_then_revives():
+    det = AnomalyDetector(dead_after=2)
+    dead = _row(groups=[("fc1", 0.0, 1.0, 0.0)])
+    live = _row(groups=[("fc1", 0.5, 1.0, 0.0)])
+    assert det.observe(1, dead) == []
+    out = det.observe(2, dead)
+    assert [a.kind for a in out] == ["dead_group"]
+    assert out[0].group == "fc1"
+    assert det.observe(3, dead) == []   # latched: no repeat fire
+    assert det.observe(4, live) == []   # revival resets the latch
+    assert det.observe(5, dead) == []
+    assert [a.kind for a in det.observe(6, dead)] == ["dead_group"]
+
+
+def test_detector_exploding_group():
+    det = AnomalyDetector(explode=1.0)
+    out = det.observe(1, _row(groups=[("fc2", 1.0, 1.0, 2.5)]))
+    assert [a.kind for a in out] == ["exploding_group"]
+    assert out[0].group == "fc2" and out[0].value == pytest.approx(2.5)
+    # zero param norm never divides
+    assert det.observe(2, _row(groups=[("fc2", 1.0, 0.0, 2.5)])) == []
+
+
+# -------------------------------------- injected NaN -> attribution
+def test_nan_injection_attribution_end_to_end(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_FAULT_INJECT", "nan:step:2:fc1_weight")
+    monkeypatch.setenv("MXNET_TELEMETRY_FLIGHT_DIR",
+                       str(tmp_path / "flight"))
+    log = str(tmp_path / "run.jsonl")
+    mon = NumericsMonitor(interval=1, run_log=log)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(_iter(), num_epoch=1, numerics=mon, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+
+    bad = [a for a in mon.anomalies if a.kind == "nonfinite"]
+    assert bad and bad[0].step == 2  # detected in the injected step
+
+    # the run log names the FIRST op whose output went bad: the NaN
+    # landed in fc1_weight, so the eager replay flags fc1's output
+    anoms = [e for e in read_events(log)
+             if e["event"] == "anomaly" and e["kind"] == "nonfinite"]
+    assert anoms and anoms[0]["first_bad_op"] == "fc1_output"
+
+    # flight record durable with the full numerics payload
+    recs = sorted(glob.glob(str(tmp_path / "flight" / "*.json")))
+    assert recs
+    with open(recs[0]) as f:
+        rec = json.load(f)
+    assert rec["reason"] == "numerics:nonfinite"
+    nm = rec["extra"]["numerics"]
+    assert nm["first_bad_op"] == "fc1_output"
+    assert nm["anomaly"]["kind"] == "nonfinite"
+    assert nm["recent_rows"] and nm["recent_rows"][-1]["step"] == 2
+
+    snap = nstats.numerics_stats()
+    assert snap["anomalies"]["nonfinite"] >= 1
+    assert snap["last_anomaly"]["first_bad_op"] == "fc1_output"
+
+
+# ------------------------------------------------- run log continuity
+def test_runlog_resume_continuity_after_kill(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_NUMERICS", "1")
+    monkeypatch.setenv("MXNET_NUMERICS_INTERVAL", "1")
+    prefix = str(tmp_path / "job")
+    log = prefix + "-runlog.jsonl"
+    it = _iter(n=128)  # 4 batches/epoch
+
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    with pytest.raises(RuntimeError, match="fault-injection"):
+        fault.fit_auto_resume(
+            mod, it, prefix, num_epoch=3,
+            fault_injector=fault.FaultInjector("step:6"),
+            optimizer="sgd", optimizer_params={"learning_rate": 0.1})
+    ev1 = read_events(log)
+    assert ev1[0]["event"] == "start"
+    steps1 = [e["step"] for e in ev1 if e["event"] == "step"]
+    assert steps1 == list(range(1, 7))  # killed mid-epoch after step 6
+
+    # simulate the kill landing mid-write: a torn trailing line must be
+    # repaired by the resuming writer, not corrupt the stream
+    with open(log, "a") as f:
+        f.write('{"event": "step", "step": 9')
+
+    it.reset()
+    mod2 = mx.mod.Module(_mlp(), context=mx.cpu())
+    end = fault.fit_auto_resume(
+        mod2, it, prefix, num_epoch=3,
+        fault_injector=fault.FaultInjector(""),
+        optimizer="sgd", optimizer_params={"learning_rate": 0.1})
+    assert end == 3
+
+    ev2 = read_events(log)
+    # append-only: the first run's record is intact underneath
+    assert ev2[:len(ev1)] == ev1
+    resumes = [e for e in ev2 if e["event"] == "resume"]
+    assert len(resumes) == 1
+    assert resumes[0]["last_step"] == 6
+    steps2 = [e["step"] for e in ev2 if e["event"] == "step"]
+    # resumed run restarts from the epoch-1 checkpoint: 2 epochs x 4
+    assert len(steps2) == 6 + 8
+    epochs = [e["epoch"] for e in ev2 if e["event"] == "epoch"]
+    assert epochs == [0, 1, 2]
+
+
+# --------------------------------------------------- legacy monitor
+def test_monitor_toc_is_one_batched_fetch():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = mx.sym.Activation(fc, act_type="relu", name="relu")
+    ex = net.simple_bind(ctx=mx.cpu(), data=(2, 3))
+    mon = mx.Monitor(interval=1, pattern=".*")
+    mon.install(ex)
+    ex.arg_dict["fc_weight"][:] = np.ones((4, 3), np.float32)
+    mon.tic()
+    ex.forward(data=np.ones((2, 3), np.float32))
+    profiler.reset_host_sync_stats()
+    res = mon.toc()
+    st = profiler.host_sync_stats()
+    assert st["blocking_fetches"] == 1  # all stat scalars, ONE fetch
+    assert st["metric_fetches"] == 1
+    names = [k for _, k, _ in res]
+    assert any("fc_output" in n for n in names)
+    assert "fc_weight" in names
+
+
+def test_monitor_device_mode_keeps_fused_and_reports_sentinel():
+    mod = _fused_module()
+    mon = mx.Monitor(interval=1, device=True)
+    mod.install_monitor(mon)
+    # the whole point of device mode: no eager fallback
+    assert mod._fused_step is not None
+    mon.tic()
+    mod.forward_backward(_batch())
+    mod.update()
+    assert mod._fused_step._sentinel is not None
+    res = mon.toc()
+    names = {k for _, k, _ in res}
+    assert {"loss", "grad_norm", "param_norm", "update_ratio"} <= names
+    assert "fc1_grad_norm" in names and "fc2_grad_norm" in names
+    assert all(t == 1 for t, _k, _v in res)  # rows labeled by step
+
+
+# ------------------------------------------------- sharded parity
+def _toy_sym():
+    data = mx.symbol.Variable("data")
+    fc = mx.symbol.FullyConnected(data, name="out_head", num_hidden=8,
+                                  no_bias=True)
+    return mx.symbol.LinearRegressionOutput(fc, name="lro")
+
+
+def _toy_rows(plan=None, n_steps=3):
+    """The test_sharding dyadic-rational recipe with the sentinel on:
+    exact f32 arithmetic makes the drained rows an equality invariant
+    across shardings, not a tolerance."""
+    rng = np.random.RandomState(0)
+    X = rng.randint(-1, 2, size=(8, 4)).astype(np.float32) / 2.0
+    Y = rng.randint(-1, 2, size=(8, 8)).astype(np.float32) / 2.0
+    it = mx.io.NDArrayIter(X, Y, batch_size=8, label_name="lro_label")
+    mod = mx.mod.Module(_toy_sym(), data_names=("data",),
+                        label_names=("lro_label",), sharding=plan)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    w0 = np.random.RandomState(7).randint(
+        -1, 2, size=(8, 4)).astype(np.float32) / 2.0
+    mod.init_params(arg_params={"out_head_weight": mx.nd.array(w0)},
+                    aux_params={}, force_init=True)
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    mon = NumericsMonitor(interval=0)
+    mon.attach(mod)
+    assert mon.active
+    for _ in range(n_steps):
+        it.reset()
+        for batch in it:
+            mod.forward_backward(batch)
+            mod.update()
+    mon.drain(mod)
+    return list(mon.history)
+
+
+@needs8
+def test_sharded_sentinel_parity():
+    from mxnet_tpu.sharding import ShardingPlan
+
+    base = _toy_rows()
+    shard = _toy_rows(plan=ShardingPlan({"data": 2, "fsdp": 2, "tp": 2}))
+    assert len(base) == len(shard) == 3
+    for a, b in zip(base, shard):
+        # element-wise values are exact (params are bitwise-identical
+        # across shardings — test_sharding proves it), so the order-
+        # independent columns must match exactly...
+        assert a["step"] == b["step"]
+        assert a["out_nonfinite"] == b["out_nonfinite"] == 0.0
+        ga = a["groups"]["out_head"]
+        gb = b["groups"]["out_head"]
+        assert ga["grad_max_abs"] == gb["grad_max_abs"]
+        # ...while the squared-sum reductions split across the mesh
+        # axes, so GSPMD's partial-sum order may differ by ~1 ulp
+        for k in ("loss", "grad_norm", "param_norm", "update_norm",
+                  "update_ratio"):
+            assert a[k] == pytest.approx(b[k], rel=1e-6), k
+
+
+# ----------------------------------------------------- decode guard
+def test_decode_guard_counts_nonfinite_logits(monkeypatch):
+    from mxnet_tpu import decoding as dec
+
+    monkeypatch.setenv("MXNET_NUMERICS_DECODE_GUARD", "1")
+    cfg = dec.DecoderConfig(vocab=32, d_model=16, n_layers=2, n_heads=2,
+                            d_ff=32, max_len=64)
+    params = dict(dec.init_decoder_params(cfg, seed=0))
+    # poison the final layernorm: every logit row comes out NaN
+    params["ln_f"] = np.asarray(params["ln_f"]) * np.nan
+    m = dec.DecodedModel("lm_guard", 1, params, cfg, max_batch=2,
+                         page_size=4, num_pages=32,
+                         page_buckets=(1, 2, 4), max_tokens=8)
+    try:
+        assert m.engine._guard
+        m.generate([5, 6, 7], max_new_tokens=4, timeout=60)
+        total = sum(m.engine.drain_guard()) \
+            + m.stats.snapshot()["nonfinite_logits"]
+        assert total > 0
+    finally:
+        m.close()
+
+
+def test_decode_guard_off_by_default():
+    from mxnet_tpu import decoding as dec
+
+    cfg = dec.DecoderConfig(vocab=32, d_model=16, n_layers=2, n_heads=2,
+                            d_ff=32, max_len=64)
+    m = dec.DecodedModel("lm_noguard", 1,
+                         dec.init_decoder_params(cfg, seed=0), cfg,
+                         max_batch=2, page_size=4, num_pages=32,
+                         page_buckets=(1, 2, 4), max_tokens=8)
+    try:
+        assert not m.engine._guard
+        m.generate([5, 6, 7], max_new_tokens=2, timeout=60)
+        assert m.engine.drain_guard() == []
+        assert m.stats.snapshot()["nonfinite_logits"] == 0
+    finally:
+        m.close()

@@ -29,6 +29,8 @@ MODULES = [
     ("mxnet_tpu.lr_scheduler", "learning-rate schedules"),
     ("mxnet_tpu.callback", "fit callbacks"),
     ("mxnet_tpu.monitor", "per-tensor training monitor"),
+    ("mxnet_tpu.numerics",
+     "run-health sentinels, anomaly rules, first-bad-op attribution"),
     ("mxnet_tpu.profiler", "host+device tracing"),
     ("mxnet_tpu.telemetry",
      "metrics registry + span tracing + live endpoints"),

@@ -12,7 +12,8 @@ XLA8    := XLA_FLAGS=--xla_force_host_platform_device_count=8
 .PHONY: all test nightly examples lint lint-check libs predict perl \
 	docs dryrun cache-check serving-check sync-check data-check \
 	passes-check telemetry-check decode-check race-check \
-	shard-check profiling-check numerics-check bench-diff clean
+	shard-check profiling-check numerics-check coldstart-check \
+	bench-diff clean
 
 all: libs test
 
@@ -137,6 +138,13 @@ profiling-check:
 # unchanged with numerics on) + paired A/B overhead bench gate
 numerics-check:
 	$(CPUENV) bash ci/check_numerics.sh
+
+# coldstart tier: disk exec-cache + bundle test suite, then the
+# three-subprocess runtime gate (warm snapshot -> fresh-interpreter
+# restore with zero traces, zero compiles, bit-identical outputs;
+# tampered bundle rejected)
+coldstart-check:
+	$(CPUENV) bash ci/check_coldstart.sh
 
 # regression diff of two bench captures (nonzero exit on >10% drops):
 #   make bench-diff OLD=BENCH_r04.json NEW=BENCH_r05.json

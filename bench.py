@@ -895,6 +895,156 @@ def _numerics_bench(platform):
     })
 
 
+def _coldstart_net():
+    """The coldstart model: ragged embedding head + deep-enough MLP
+    that each (batch, length) bucket cell is a real XLA compile.
+    Deterministic (seed 0) so warm and restore processes agree
+    bit-for-bit on params AND outputs."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    vocab, d_h, depth, classes = 500, 512, 5, 16
+    data = mx.sym.Variable("data")
+    net = mx.sym.Embedding(data, input_dim=vocab, output_dim=64,
+                           name="embed")
+    net = mx.sym.mean(net, axis=1)
+    for i in range(depth):
+        net = mx.sym.FullyConnected(net, num_hidden=d_h,
+                                    name=f"fc{i}")
+        net = mx.sym.Activation(net, act_type="relu",
+                                name=f"relu{i}")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="head")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    shapes, _, _ = net.infer_shape(data=(1, 32))
+    rs = np.random.RandomState(0)
+    params = {n: rs.normal(0, 0.1, s).astype("float32")
+              for n, s in zip(net.list_arguments(), shapes)
+              if n not in ("data", "softmax_label")}
+    return net, params
+
+
+_COLDSTART_BUCKETS = {"batch_buckets": (1, 2, 4, 8),
+                      "length_buckets": (8, 16, 32)}
+
+
+def _coldstart_child(role):
+    """One process of the coldstart A/B. `warm` pays the full
+    trace+compile grid then snapshots the bundle; `restore` mounts it.
+    Emits one JSON line the parent parses."""
+    import numpy as np
+
+    import mxnet_tpu as mx  # noqa: F401 — registers ops
+    from mxnet_tpu import exec_cache, serving
+    from mxnet_tpu.profiling import device_stats
+
+    bundle_dir = os.environ["BENCH_COLDSTART_BUNDLE"]
+    reg = serving.ModelRegistry()
+    t0 = time.perf_counter()
+    if role == "warm":
+        net, params = _coldstart_net()
+        model = reg.load("coldstart", net.tojson(), params,
+                         {"data": ("L",)},
+                         input_dtypes={"data": "int32"},
+                         **_COLDSTART_BUCKETS)
+        ready_s = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        serving.save_bundle(model, bundle_dir)
+        bundle_s = time.perf_counter() - t1
+    else:
+        model = reg.load_bundle(bundle_dir)
+        ready_s = time.perf_counter() - t0
+        bundle_s = 0.0
+    # parity probe: one fixed batch through one mid-grid bucket —
+    # the restore serves the warm process's EXACT executables, so
+    # outputs must agree bit-for-bit
+    rs = np.random.RandomState(7)
+    x = np.zeros((4, 16), np.int32)
+    x[:, :9] = rs.randint(0, 500, (4, 9))
+    out = model.infer({"data": x}, 4, 16)[0]
+    cs = exec_cache.cache_stats()
+    totals = device_stats().get("totals", {})
+    _emit({
+        "role": role,
+        "ready_s": round(ready_s, 4),
+        "bundle_s": round(bundle_s, 4),
+        "traces": cs["traces"],
+        "compiles": totals.get("compiles", 0),
+        "disk_loads": totals.get("disk_loads", 0),
+        "out_sum": float(np.asarray(out, np.float64).sum()),
+        "out_head": [float(v) for v in np.ravel(out)[:8]],
+    })
+
+
+def _coldstart_bench(platform):
+    """BENCH_MODE=coldstart: process-restart latency A/B.
+
+    Two subprocesses over one bundle directory: the first warms the
+    full bucket grid cold and snapshots it (`serving.save_bundle`),
+    the second restores from the bundle (`load_bundle`). Reported
+    walls are each child's load-to-ready seconds (interpreter + jax
+    import overhead excluded — it is identical in both and not what
+    bundles address); proc_s keys carry the full subprocess walls.
+    Design target: restore_wall_s < 50% of warm_wall_s with
+    restore_traces == restore_compiles == 0 and bit-identical outputs
+    (ci/check_coldstart.sh gates the same contract)."""
+    import subprocess
+    import tempfile
+
+    work = tempfile.mkdtemp(prefix="bench_coldstart_")
+    env = dict(os.environ)
+    env.update({
+        "BENCH_MODE": "coldstart",
+        "BENCH_COLDSTART_BUNDLE": os.path.join(work, "model.bundle"),
+        # isolate from ambient caches: the warm child must pay a REAL
+        # cold start (its own jax cache dir), and the restore child
+        # must get its zero-compile restart from the bundle alone
+        "MXNET_EXEC_CACHE_DIR": "",
+        "JAX_COMPILATION_CACHE_DIR": os.path.join(work, "jax_cache"),
+    })
+
+    def run(role):
+        env["BENCH_COLDSTART_CHILD"] = role
+        t0 = time.perf_counter()
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            capture_output=True, text=True, timeout=900)
+        proc_s = time.perf_counter() - t0
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"coldstart {role} child failed (rc={out.returncode}):"
+                f" {out.stderr[-800:]}")
+        rec = json.loads(out.stdout.strip().splitlines()[-1])
+        rec["proc_s"] = round(proc_s, 3)
+        return rec
+
+    warm = run("warm")
+    restore = run("restore")
+    parity = warm["out_head"] == restore["out_head"] and \
+        warm["out_sum"] == restore["out_sum"]
+    speedup = (warm["ready_s"] / restore["ready_s"]
+               if restore["ready_s"] else 0.0)
+    _emit({
+        "metric": f"coldstart_restore_{platform}",
+        "value": round(speedup, 2),
+        "unit": "x",
+        "warm_wall_s": warm["ready_s"],
+        "restore_wall_s": restore["ready_s"],
+        "restore_frac": round(restore["ready_s"] / warm["ready_s"], 4)
+        if warm["ready_s"] else 0.0,
+        "warm_proc_s": warm["proc_s"],
+        "restore_proc_s": restore["proc_s"],
+        "bundle_s": warm["bundle_s"],
+        "warm_traces": warm["traces"],
+        "warm_compiles": warm["compiles"],
+        "restore_traces": restore["traces"],
+        "restore_compiles": restore["compiles"],
+        "restore_disk_loads": restore["disk_loads"],
+        "parity": parity,
+        "platform": platform,
+    })
+
+
 def main():
     # BENCH_XLA_FLAGS: extra XLA flags for A/B capture runs (e.g.
     # "--xla_tpu_enable_latency_hiding_scheduler=true"); appended
@@ -957,6 +1107,11 @@ def main():
         return _profiling_bench(jax.devices()[0].platform)
     if os.environ.get("BENCH_MODE", "train") == "numerics":
         return _numerics_bench(jax.devices()[0].platform)
+    if os.environ.get("BENCH_MODE", "train") == "coldstart":
+        role = os.environ.get("BENCH_COLDSTART_CHILD")
+        if role:
+            return _coldstart_child(role)
+        return _coldstart_bench(jax.devices()[0].platform)
 
     import jax.numpy as jnp
     import numpy as np

@@ -51,11 +51,14 @@ def main():
     X = rs.uniform(-2.0, 2.0, (n, 1)).astype(np.float32)
     y = (0.5 * X[:, 0] ** 2
          + rs.normal(0, 0.05, n)).astype(np.float32)
-    it = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True,
-                           label_name="lro_label")
-
+    # seed BEFORE the iterator: unseeded shuffle=True draws its one
+    # construction-time shuffle from the ambient mx.random stream, so
+    # seeding afterwards left the batch order (and the whole run)
+    # nondeterministic
     np.random.seed(3)
     mx.random.seed(3)
+    it = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True,
+                           label_name="lro_label")
     mod = mx.mod.Module(net(), label_names=("lro_label",),
                         context=mx.cpu())
     it.reset()

@@ -27,6 +27,7 @@ from __future__ import annotations
 import json
 import os
 
+from ..utils.persist import atomic_write_json
 from .loader import STATE_FORMAT, DataPipelineError
 
 
@@ -40,15 +41,11 @@ def is_resumable(data_iter):
 def save_state(data_iter, path):
     """Atomically write `data_iter.state_dict()` as JSON to `path`.
 
-    tmp + fsync + os.replace: a crash at any instant leaves either the
-    previous state file or the new one, never a torn write."""
+    tmp + fsync + os.replace (utils.persist.atomic_write_json): a
+    crash at any instant leaves either the previous state file or the
+    new one, never a torn write."""
     state = data_iter.state_dict()
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(state, f, indent=0, sort_keys=True)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    atomic_write_json(path, state, indent=0)
     return state
 
 

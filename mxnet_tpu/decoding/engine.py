@@ -34,6 +34,10 @@ from ..serving.batcher import pick_bucket
 from . import config as _cfg
 from . import attention as _attn
 from . import model as _model
+
+# warn-once latch for calibration-harvest failures (the serving
+# registry's convention: one WARN per process, not one per bucket)
+_calibration_warned = False
 from .blocks import SCRATCH_PAGE, BlockAllocator, PageError, \
     pages_needed
 
@@ -294,8 +298,19 @@ class DecodeEngine:
                 if bucket == self.page_buckets[-1]:
                     store.record(self._digest, platform, "decode_step",
                                  seconds)
-        except Exception:
-            pass  # calibration is advisory; warmup must never fail
+        except Exception as e:
+            # calibration is advisory; warmup must never fail — but
+            # don't lose the evidence either (serving.registry's
+            # warn-once convention)
+            import logging
+
+            global _calibration_warned
+            if not _calibration_warned:
+                _calibration_warned = True
+                logging.getLogger(__name__).warning(
+                    "decode calibration harvest failed for engine %s: "
+                    "%s — continuing without measured-cost records",
+                    self._digest, e)
 
     # -------------------------------------------------------- hot path
     def prefill(self, token_ids, table):

@@ -20,13 +20,23 @@ eval-only bind never pays the train trace (and vice versa).
 Knobs:
   MXNET_EXEC_CACHE=0        disable (every bind builds a private program)
   MXNET_EXEC_CACHE_SIZE=N   LRU bound on retained entries (default 64)
+  MXNET_EXEC_CACHE_DIR=path disk tier (exec_cache_disk): persist
+                            per-entry records + AOT-serialized
+                            executables across processes, and point
+                            jax's own persistent compilation cache at
+                            `<path>/xla`. A fresh process rebinding a
+                            seen graph restores with zero traces and
+                            zero compiles; stale/corrupt entries fall
+                            back to a normal re-trace.
 
 Stats are surfaced via `cache_stats()` (re-exported as
-`mxnet_tpu.executor.cache_stats`) and merged into the profiler dump.
+`mxnet_tpu.executor.cache_stats`, disk tier counters merged in) and
+merged into the profiler dump.
 """
 from __future__ import annotations
 
 import os
+import sys
 import threading
 import warnings
 from collections import OrderedDict
@@ -76,19 +86,28 @@ def capacity():
 
 
 def cache_stats():
-    """Snapshot of cache counters plus current size/capacity."""
+    """Snapshot of cache counters plus current size/capacity, with the
+    disk tier's counters (disk_hits / disk_misses / disk_stale / ...)
+    merged in when exec_cache_disk has been touched."""
     with _lock:
         out = dict(_stats)
         out["size"] = len(_table)
         out["capacity"] = capacity()
         out["enabled"] = _enabled()
-        return out
+    disk = sys.modules.get(__package__ + ".exec_cache_disk")
+    if disk is not None:
+        out.update(disk.counters())
+        out["disk_enabled"] = disk.tier_active()
+    return out
 
 
 def reset_stats():
     with _lock:
         for k in _stats:
             _stats[k] = 0
+    disk = sys.modules.get(__package__ + ".exec_cache_disk")
+    if disk is not None:
+        disk.reset_counters()
 
 
 # live view in the central telemetry registry: /statusz and /metrics
@@ -127,7 +146,20 @@ def count_shared_hit():
         _stats["shared_hits"] += 1
 
 
-def lookup_or_build(key, builder, raw_sig=None, canonical_fn=None):
+def _mark_hit(key, raw_sig):
+    """Bookkeeping for an in-memory hit — caller holds _lock."""
+    _stats["hits"] += 1
+    _table.move_to_end(key)
+    if raw_sig is not None:
+        seen = _raw_sigs.setdefault(key, set())
+        if raw_sig not in seen:
+            seen.add(raw_sig)
+            if len(seen) > 1:
+                _stats["canonical_collisions"] += 1
+
+
+def lookup_or_build(key, builder, raw_sig=None, canonical_fn=None,
+                    disk_meta_fn=None):
     """Return the cached CompiledGraph for `key`, building (and
     LRU-inserting) it with `builder()` on a miss. Building happens under
     the lock: it is pure Python closure construction — the actual jax
@@ -140,30 +172,55 @@ def lookup_or_build(key, builder, raw_sig=None, canonical_fn=None):
 
     `canonical_fn` (miss only) supplies the graph's canonical digest:
     it lands on the entry so profiling's `deviceStats` records and the
-    `CalibrationStore` key by the same id the autotuner uses."""
+    `CalibrationStore` key by the same id the autotuner uses.
+
+    Disk tier (exec_cache_disk, active when MXNET_EXEC_CACHE_DIR or a
+    bundle overlay is mounted): an in-memory miss probes disk for a
+    record under the same digest. A compatible record means the
+    entry's executables are restorable AOT — the per-mode jits will
+    deserialize instead of tracing, so `traces` is NOT billed (that is
+    the restart win the counter exposes). On a disk miss the record
+    (with `disk_meta_fn()`'s graph/signature metadata) is written for
+    the next process. All disk I/O happens OUTSIDE _lock."""
     with _lock:
         if _enabled():
             entry = _table.get(key)
             if entry is not None:
-                _stats["hits"] += 1
-                _table.move_to_end(key)
-                if raw_sig is not None:
-                    seen = _raw_sigs.setdefault(key, set())
-                    if raw_sig not in seen:
-                        seen.add(raw_sig)
-                        if len(seen) > 1:
-                            _stats["canonical_collisions"] += 1
+                _mark_hit(key, raw_sig)
+                return entry
+    # in-memory miss: probe the disk tier before re-taking the lock
+    # (MX006 — no file I/O under _lock). Inert unless a dir/overlay
+    # is mounted: lookup_record returns None immediately.
+    import hashlib as _hashlib
+
+    digest = _hashlib.sha1(repr(key).encode()).hexdigest()[:12]
+    disk_rec = None
+    disk = None
+    try:
+        from . import exec_cache_disk as _disk
+
+        if _disk.tier_active():
+            disk = _disk
+            disk.configure_jax_cache()
+            disk_rec = disk.lookup_record(digest)
+    except Exception:
+        disk = None
+    with _lock:
+        if _enabled():
+            entry = _table.get(key)
+            if entry is not None:  # raced a concurrent builder
+                _mark_hit(key, raw_sig)
                 return entry
         _stats["misses"] += 1
-        _stats["traces"] += 1
+        if disk_rec is None:
+            # a disk-restorable entry pays no trace: the jits
+            # deserialize pre-compiled executables (profiling layer)
+            _stats["traces"] += 1
         entry = builder()
         # per-entry identity for the profiling layer: `digest` is this
         # ENTRY (graph + shapes + grad config), `canonical` the graph
         # family shared with the tuner/calibration key space
-        import hashlib as _hashlib
-
-        entry.digest = _hashlib.sha1(
-            repr(key).encode()).hexdigest()[:12]
+        entry.digest = digest
         if canonical_fn is not None:
             try:
                 entry.canonical = canonical_fn()
@@ -178,7 +235,10 @@ def lookup_or_build(key, builder, raw_sig=None, canonical_fn=None):
                 old_key, _ = _table.popitem(last=False)
                 _raw_sigs.pop(old_key, None)
                 _stats["evictions"] += 1
-        return entry
+    if disk is not None and disk_rec is None:
+        disk.write_record(digest, canonical=entry.canonical,
+                          meta_fn=disk_meta_fn)
+    return entry
 
 
 _donation_effective = None

@@ -183,7 +183,31 @@ class Executor:
             self._cache_key, self._trace_graph,
             raw_sig=hash(raw_key),
             canonical_fn=lambda: _passes.canonical_digest(
-                self._opt_symbol))
+                self._opt_symbol),
+            disk_meta_fn=self._disk_record_meta)
+
+    def _disk_record_meta(self):
+        """What the disk tier (exec_cache_disk) persists alongside the
+        entry digest: the OPTIMIZED canonical graph JSON plus the full
+        bind signature — enough to inspect/rebuild the program offline
+        (tools/mx_bundle.py inspect) without re-running the passes."""
+        return {
+            # _opt_symbol already went through the bind-time pipeline
+            # (or the user turned it off) — plain serialization, so
+            # the record write never re-runs passes or bills
+            # pipeline_runs for key/metadata work
+            "graph_json": self._opt_symbol.tojson(),
+            "inputs": [[n, list(self.arg_dict[n].shape),
+                        str(self.arg_dict[n].dtype)]
+                       for n in self._arg_names],
+            "auxs": [[n, list(self.aux_dict[n].shape),
+                      str(self.aux_dict[n].dtype)]
+                     for n in self._aux_names],
+            "grad_req": {n: self._grad_req.get(n, "null")
+                         for n in self._arg_names},
+            "sharding": (self._sharding_plan.digest()
+                         if self._sharding_plan is not None else None),
+        }
 
     def _trace_graph(self):
         """Build the pure run_graph program + node plan for this bind's

@@ -61,12 +61,10 @@ class Autotuner:
             return {}
 
     def _save(self, table):
-        tmp = f"{self.cache_path}.tmp.{os.getpid()}"
-        os.makedirs(os.path.dirname(self.cache_path) or ".",
-                    exist_ok=True)
-        with open(tmp, "w") as f:
-            json.dump(table, f, indent=2, sort_keys=True)
-        os.replace(tmp, self.cache_path)  # atomic vs concurrent tuners
+        from ..utils.persist import atomic_write_json
+
+        # atomic vs concurrent tuners (tmp + fsync + os.replace)
+        atomic_write_json(self.cache_path, table)
 
     # ----------------------------------------------------------- choice
     def choose(self, symbol, input_shapes, platform=None, measure=False):

@@ -55,12 +55,9 @@ class CalibrationStore:
             return {}
 
     def _save(self, table):
-        tmp = f"{self.cache_path}.tmp.{os.getpid()}"
-        os.makedirs(os.path.dirname(self.cache_path) or ".",
-                    exist_ok=True)
-        with open(tmp, "w") as f:
-            json.dump(table, f, indent=2, sort_keys=True)
-        os.replace(tmp, self.cache_path)
+        from ..utils.persist import atomic_write_json
+
+        atomic_write_json(self.cache_path, table)
 
     @staticmethod
     def _key(digest, platform, kind):

@@ -42,7 +42,22 @@ _DEFAULT_MAX_SIGS = 64
 _lock = threading.Lock()
 # (digest, kind) -> record dict (see _new_record)
 _records: "dict[tuple, dict]" = {}
-_totals = {"fallbacks": 0, "compile_errors": 0}
+_totals = {"fallbacks": 0, "compile_errors": 0,
+           "compiles": 0,      # real XLA compiles this process paid
+           "disk_loads": 0}    # executables restored AOT from the
+                               # exec_cache_disk tier (compile_s≈0)
+
+
+def _disk_tier():
+    """The exec_cache_disk module when a cache dir / bundle overlay is
+    mounted, else None — the single gate every disk hook goes
+    through, so an unset MXNET_EXEC_CACHE_DIR costs one attr check."""
+    try:
+        from .. import exec_cache_disk as _disk
+
+        return _disk if _disk.tier_active() else None
+    except Exception:
+        return None
 
 # native Prometheus companions of the deviceStats snapshot
 _EXECUTABLES = _treg.gauge(
@@ -85,10 +100,13 @@ def _new_record(digest, kind, canonical, label):
 
 
 def record_executable(digest, kind, compiled, trace_s, compile_s,
-                      canonical=None, label=None):
+                      canonical=None, label=None, from_disk=False):
     """Merge one captured executable into the record table. Analyses
     that a backend does not implement degrade to zeros — the record
-    (and its compile-time fields) exists regardless."""
+    (and its compile-time fields) exists regardless. `from_disk=True`
+    marks an executable restored AOT by the exec_cache_disk tier: it
+    bills `totals.disk_loads` instead of `totals.compiles` and carries
+    compile_s≈0 (the restart win the deviceStats view exposes)."""
     mem = None
     try:
         mem = compiled.memory_analysis()
@@ -137,6 +155,11 @@ def record_executable(digest, kind, compiled, trace_s, compile_s,
         if canonical and not rec["canonical"]:
             rec["canonical"] = canonical
         rec["platform"] = platform
+        if from_disk:
+            _totals["disk_loads"] += 1
+            rec["disk_loads"] = rec.get("disk_loads", 0) + 1
+        else:
+            _totals["compiles"] += 1
         n_records = len(_records)
         peak = max(r["hbm_bytes"] for r in _records.values())
     _COMPILE_SECONDS.inc(compile_s, kind=str(kind))
@@ -302,11 +325,34 @@ class InstrumentedJit:
         """lower+compile+record for one signature. Compilation runs
         OUTSIDE the instance lock (a concurrent duplicate costs one
         wasted compile; a lock held across XLA would serialize every
-        signature of this family behind the compiler)."""
+        signature of this family behind the compiler).
+
+        Disk tier first: when exec_cache_disk is mounted, a compatible
+        AOT-serialized executable for this exact (digest, kind,
+        signature) deserializes in place of the lower+compile — zero
+        trace, zero compile, recorded with from_disk=True. A fresh
+        compile is serialized back so the NEXT process restores."""
         if len(self._compiled) >= _max_sigs():
             with self._lock:
                 self._compiled.setdefault(key, _FAILED)
             return self._compiled[key]
+        disk = _disk_tier()
+        sighash = None
+        if disk is not None:
+            try:
+                sighash = disk.sig_hash(key)
+                restored = disk.load_executable(self.digest, self.kind,
+                                                sighash)
+            except Exception:
+                restored = None
+            if restored is not None:
+                record_executable(self.digest, self.kind, restored,
+                                  trace_s=0.0, compile_s=0.0,
+                                  canonical=self.canonical,
+                                  label=self.label, from_disk=True)
+                with self._lock:
+                    self._compiled.setdefault(key, restored)
+                return self._compiled[key]
         try:
             t0 = time.perf_counter()
             lowered = self.fn.lower(*args, **kwargs)
@@ -321,6 +367,12 @@ class InstrumentedJit:
         record_executable(self.digest, self.kind, compiled,
                           trace_s=t1 - t0, compile_s=t2 - t1,
                           canonical=self.canonical, label=self.label)
+        if disk is not None and sighash is not None:
+            try:
+                disk.store_executable(self.digest, self.kind, sighash,
+                                      compiled)
+            except Exception:
+                pass  # serialization support is best-effort
         with self._lock:
             self._compiled.setdefault(key, compiled)
         return self._compiled[key]

@@ -16,23 +16,28 @@ backpressure, per-request deadlines, and multi-model/version routing.
     fut = server.submit("clf", {"data": token_ids})    # async Future
 
 Modules: batcher (queue + bucketing + flush policy), server
-(ModelServer front door), registry (multi-model + warmup), stats
+(ModelServer front door), registry (multi-model + warmup), bundle
+(AOT serving bundles: `save_bundle` a warm model, `load_bundle` it in
+a fresh process with zero traces and zero compiles), stats
 (qps/latency/fill/padding counters -> mx.profiler dumps), config
 (MXNET_SERVING_* env knobs). Guide: docs/serving.md.
 """
-from . import batcher, config, registry, server, stats
+from . import batcher, bundle, config, registry, server, stats
 from .batcher import (BucketSpec, DynamicBatcher, DeadlineExceededError,
                       ServerBusyError, ServerClosedError, ServingError,
                       default_batch_buckets, pick_bucket)
+from .bundle import BundleError, load_bundle, read_manifest, save_bundle
 from .registry import ModelRegistry, ServedModel
 from .server import ModelServer
 from .stats import ServingStats, reset_serving_stats, serving_stats
 
 __all__ = [
-    "BucketSpec", "DynamicBatcher", "DeadlineExceededError",
-    "ModelRegistry", "ModelServer", "ServedModel", "ServerBusyError",
-    "ServerClosedError", "ServingError", "ServingStats",
-    "batcher", "config", "default_batch_buckets", "pick_bucket",
-    "registry", "reset_serving_stats", "server", "serving_stats",
+    "BucketSpec", "BundleError", "DynamicBatcher",
+    "DeadlineExceededError", "ModelRegistry", "ModelServer",
+    "ServedModel", "ServerBusyError", "ServerClosedError",
+    "ServingError", "ServingStats",
+    "batcher", "bundle", "config", "default_batch_buckets",
+    "load_bundle", "pick_bucket", "read_manifest", "registry",
+    "reset_serving_stats", "save_bundle", "server", "serving_stats",
     "stats",
 ]

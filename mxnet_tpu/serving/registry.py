@@ -15,6 +15,7 @@ zero new traces (stats.traces_since_warmup proves it).
 """
 from __future__ import annotations
 
+import logging
 import threading
 
 import numpy as np
@@ -22,6 +23,14 @@ import numpy as np
 from ..predictor import Predictor
 from .batcher import BucketSpec, ServingError, default_batch_buckets
 from .stats import ServingStats, _register, _unregister
+
+log = logging.getLogger(__name__)
+
+# warn-once latch for calibration-harvest failures: the failure mode
+# is usually environmental (read-only cache dir, profiling disabled
+# mid-run) and identical for every bucket — one WARN line, not one
+# per grid cell. Tests reset it to re-arm.
+_calibration_warned = False
 
 
 class ServedModel:
@@ -106,8 +115,20 @@ class ServedModel:
                          f"forward[{batch}x{length}]", seconds)
             if (batch, length) == tuple(self.spec.all_buckets()[-1]):
                 store.record(canonical, platform, "forward", seconds)
-        except Exception:
-            pass  # calibration is advisory; warmup must never fail
+        except Exception as e:
+            # calibration is advisory; warmup must never fail — but a
+            # harvest that silently never lands leaves the autotuner
+            # blind with no trace of why. Count it, warn ONCE.
+            self.stats.note_calibration_skipped()
+            global _calibration_warned
+            if not _calibration_warned:
+                _calibration_warned = True
+                log.warning(
+                    "calibration harvest failed for %s bucket "
+                    "(%d, %d): %s — continuing without measured-cost "
+                    "records (counted as stats.calibration_skipped; "
+                    "further failures are silent)",
+                    self.key, batch, length, e)
 
     def infer(self, feed, batch, length):
         """Run one assembled batch; returns the raw padded outputs."""
@@ -202,6 +223,17 @@ class ModelRegistry:
             model.warmup()
         _dec_stats._register(model.key, model.stats)
         return model
+
+    def load_bundle(self, path, name=None, version=None, warmup=True):
+        """Restore an AOT serving bundle (serving.bundle.save_bundle
+        artifact): mounts its exec_cache subtree as a read-only
+        overlay and replays the ordinary load — zero traces, zero
+        compiles on an env-compatible bundle (execCacheStats /
+        deviceStats verify). See docs/serving.md \"Bundles\"."""
+        from .bundle import load_bundle as _load_bundle
+
+        return _load_bundle(path, self, name=name, version=version,
+                            warmup=warmup)
 
     def get(self, name, version=None):
         with self._lock:

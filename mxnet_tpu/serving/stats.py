@@ -98,6 +98,7 @@ class ServingStats:
             self.batch_live = 0    # sum of live requests per batch
             self.padded_elems = 0  # total elements dispatched
             self.real_elems = 0    # elements carrying request data
+            self.calibration_skipped = 0  # warmup harvests that failed
             self.traces_at_warmup = None
             self._latencies = deque(maxlen=_LATENCY_KEEP)
             self._done_times = deque(maxlen=8192)
@@ -126,6 +127,14 @@ class ServingStats:
             self.batch_slots += slots
             self.real_elems += real_elems
             self.padded_elems += padded_elems
+
+    def note_calibration_skipped(self, n=1):
+        """A warmup calibration harvest failed (advisory — warmup
+        itself succeeded). Surfaced in the snapshot so a model whose
+        measured-cost evidence silently never materializes is
+        visible, not mysterious."""
+        with self._lock:
+            self.calibration_skipped += n
 
     def note_completed(self, latency_s, n=1, now=None):
         now = time.monotonic() if now is None else now
@@ -170,6 +179,7 @@ class ServingStats:
                 "p50_ms": round(_percentile(lat, 0.50) * 1e3, 3),
                 "p95_ms": round(_percentile(lat, 0.95) * 1e3, 3),
                 "p99_ms": round(_percentile(lat, 0.99) * 1e3, 3),
+                "calibration_skipped": self.calibration_skipped,
                 "traces_since_warmup": (
                     traces_now - self.traces_at_warmup
                     if self.traces_at_warmup is not None else None),

@@ -473,6 +473,43 @@ register_env(
     "decodingStats (nonfinite_logit_steps / nonfinite_logits).",
 )
 register_env(
+    "MXNET_EXEC_CACHE_DIR", str, "",
+    "disk tier of the exec cache (mxnet_tpu.exec_cache_disk): a "
+    "directory holding per-entry records (optimized canonical graph "
+    "JSON, input signatures, sharding digest) plus the AOT-serialized "
+    "executables of every captured program, with jax's persistent "
+    "compilation cache configured underneath at <dir>/xla. A process "
+    "restart then rebinds with ZERO jax traces and ZERO XLA compiles "
+    "(cache_stats()['disk_hits'] counts the wins). Empty = in-memory "
+    "cache only, the pre-disk behavior (docs/perf.md 'Cold starts').",
+)
+register_env(
+    "MXNET_EXEC_CACHE_DISK_BYTES", int, 1 << 30,
+    "size cap in bytes on the MXNET_EXEC_CACHE_DIR entry store: after "
+    "every write the least-recently-used entries (record + serialized "
+    "executables; hit time = file mtime) are evicted until the store "
+    "fits. The jax compilation cache under <dir>/xla is not counted — "
+    "jax bounds it itself. 0 disables eviction.",
+)
+register_env(
+    "MXNET_BUNDLE_STRICT", bool, False,
+    "serving bundles: escalate restore degradations to errors. By "
+    "default a bundle whose executables were serialized by a "
+    "different jaxlib/platform loads with a warning and falls back to "
+    "re-tracing (correct, just not zero-compile); strict mode raises "
+    "BundleError instead — deploys that REQUIRE the zero-compile "
+    "contract fail loudly rather than silently paying warmup "
+    "(docs/serving.md 'Bundles').",
+)
+register_env(
+    "MXNET_BUNDLE_VERIFY", bool, True,
+    "serving bundles: verify the manifest's parameter content hash "
+    "(over array names, dtypes, shapes, bytes) on load_bundle; a "
+    "mismatch raises BundleError (tamper/corruption rejection). 0 "
+    "skips hashing — only for bundles on trusted read-only media "
+    "where load latency matters more.",
+)
+register_env(
     "MXNET_LOCK_WITNESS", str, "",
     "analysis: runtime lock witness "
     "(mxnet_tpu.analysis.lockwitness). '' / 'off' = disabled (the "

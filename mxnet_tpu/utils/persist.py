@@ -1,0 +1,58 @@
+"""Atomic JSON persistence — the one implementation of the
+tmp + fsync + `os.replace` pattern.
+
+Three subsystems grew the same durable-write idiom independently (the
+autotuner's tuning table, the profiling CalibrationStore, and the data
+tier's resume state), and the serving bundle manifest is a fourth
+customer. The contract they all need is identical:
+
+  * a crash at ANY instant leaves either the previous file or the new
+    one on disk, never a torn write (write to a sibling tmp file,
+    fsync it, then `os.replace` — atomic on POSIX);
+  * concurrent writers may each lose a race, but the file is always a
+    complete JSON document some process wrote;
+  * callers hold NO locks across the write (MX006): serialize your
+    state to a plain dict under your lock, release it, then call
+    `atomic_write_json` on the copy — the snapshot pattern.
+
+`read_json` is the matching load half: a plain read of an
+atomically-replaced file needs no locking, and a missing or corrupt
+file degrades to the caller's default instead of raising.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+
+def atomic_write_json(path, obj, *, indent=2, sort_keys=True,
+                      fsync=True, make_dirs=True):
+    """Durably write `obj` as JSON to `path` via tmp + os.replace.
+
+    The tmp name carries the pid so concurrent writers in different
+    processes never collide on the staging file. `fsync=False` skips
+    the flush-to-platter (for per-batch writers like the data-state
+    saver the caller decides the durability/latency tradeoff; the
+    replace is atomic either way)."""
+    if make_dirs:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=indent, sort_keys=sort_keys)
+        if fsync:
+            f.flush()
+            os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def read_json(path, default=None):
+    """Load a JSON file written by `atomic_write_json`; `default` when
+    the file is absent or unreadable (a torn tmp file can never be at
+    `path`, so corruption here means external damage — the caller
+    decides whether that is fatal)."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return default

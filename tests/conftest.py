@@ -34,6 +34,15 @@ os.environ.setdefault(
     "MXNET_CALIBRATION_CACHE",
     os.path.join(_tempfile.mkdtemp(prefix="mx_test_calib_"),
                  "calibration.json"))
+# The exec-cache disk tier (MXNET_EXEC_CACHE_DIR) must be per-run
+# under test — UNCONDITIONAL assignment, not setdefault: a developer's
+# ambient cache dir would let one run's serialized executables leak
+# into the next and skew the exact trace/compile counts many tests
+# pin. Within one run the same dir is shared (subprocess round-trip
+# tests rely on inheriting it), and the in-process self-written skip
+# keeps same-process counts identical to the no-disk world.
+os.environ["MXNET_EXEC_CACHE_DIR"] = _tempfile.mkdtemp(
+    prefix="mx_test_exec_cache_")
 
 # The axon sitecustomize (TPU tunnel) force-selects jax_platforms
 # "axon,cpu" at interpreter start, overriding JAX_PLATFORMS; pin the

@@ -31,6 +31,10 @@ LOWER_IS_BETTER = (
     "p50_ms", "p99_ms", "p50_token_ms", "p99_token_ms",
     "compile_s", "hbm_peak_bytes", "dispatch_overhead_us",
     "padding_waste", "stall_fraction",
+    # BENCH_MODE=coldstart (process-restart A/B): restart latency and
+    # its compile bill must only ever shrink
+    "warm_wall_s", "restore_wall_s", "restore_frac",
+    "restore_traces", "restore_compiles",
 )
 
 

@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""mx_bundle: build, inspect, and smoke-load AOT serving bundles.
+
+    # snapshot a warmed checkpoint into one atomic bundle directory
+    python tools/mx_bundle.py bundle --checkpoint model --epoch 3 \
+        --input-spec data=L --length-buckets 16,32 --out clf.bundle
+
+    # what is inside (manifest summary; no jax work)
+    python tools/mx_bundle.py inspect clf.bundle
+
+    # prove the zero-compile restart: load in THIS fresh process and
+    # print execCacheStats/deviceStats evidence (exit 1 when the
+    # restore traced or compiled anything)
+    python tools/mx_bundle.py load-bundle clf.bundle
+
+`bundle` is the warm half of the cold-start story (docs/perf.md):
+it loads + warms the model exactly like a serving process would —
+paying the full trace/compile grid once — then snapshots params,
+bucket grid, tuner + calibration records, and the AOT-serialized
+executables via `serving.save_bundle`. `load-bundle` is the restart
+half: a fresh interpreter that mounts the bundle and serves without
+tracing or compiling anything (ci/check_coldstart.py gates on it).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _parse_spec(items):
+    """data=L or image=3,32,32 -> {"data": ("L",)} / {...}."""
+    specs = {}
+    for item in items:
+        name, _, raw = item.partition("=")
+        if not raw:
+            raise SystemExit(f"--input-spec needs name=dims: {item!r}")
+        dims = tuple("L" if d.strip() == "L" else int(d)
+                     for d in raw.split(","))
+        specs[name] = dims
+    return specs
+
+
+def _parse_ints(raw):
+    return tuple(int(v) for v in raw.split(",") if v.strip()) \
+        if raw else None
+
+
+def cmd_bundle(args):
+    from mxnet_tpu import serving
+
+    reg = serving.ModelRegistry()
+    model = reg.load_checkpoint(
+        args.name, args.checkpoint, args.epoch,
+        _parse_spec(args.input_spec),
+        version=args.version,
+        input_dtypes=dict(kv.split("=") for kv in args.input_dtype),
+        batch_buckets=_parse_ints(args.batch_buckets),
+        length_buckets=_parse_ints(args.length_buckets),
+        warmup=True)
+    out = serving.save_bundle(model, args.out)
+    manifest = serving.read_manifest(out)
+    print(json.dumps({
+        "bundle": out,
+        "programs": len(manifest["programs"]),
+        "digests": manifest["digests"],
+        "param_hash": manifest["params"]["content_hash"][:12],
+    }))
+    return 0
+
+
+def cmd_inspect(args):
+    from mxnet_tpu import serving
+
+    manifest = serving.read_manifest(args.bundle)
+    out = {k: manifest.get(k) for k in (
+        "format", "kind", "name", "version", "env", "digests",
+        "batch_buckets", "length_buckets", "input_specs", "decoder",
+        "decode_kinds")}
+    out["programs"] = len(manifest.get("programs", []))
+    out["params"] = manifest.get("params")
+    out["tuner_records"] = len(manifest.get("tuner") or {})
+    out["calibration_records"] = len(manifest.get("calibration") or {})
+    print(json.dumps({k: v for k, v in out.items() if v is not None},
+                     indent=2, sort_keys=True))
+    return 0
+
+
+def cmd_load_bundle(args):
+    from mxnet_tpu import exec_cache, serving
+    from mxnet_tpu.profiling import device_stats
+
+    reg = serving.ModelRegistry()
+    model = reg.load_bundle(args.bundle, warmup=not args.no_warmup)
+    cs = exec_cache.cache_stats()
+    totals = device_stats().get("totals", {})
+    report = {
+        "loaded": f"{model.name}:{model.version}",
+        "traces": cs["traces"],
+        "compiles": totals.get("compiles", 0),
+        "disk_hits": cs.get("disk_hits", 0),
+        "disk_loads": totals.get("disk_loads", 0),
+        "disk_stale": cs.get("disk_stale", 0),
+    }
+    cold = report["traces"] or report["compiles"]
+    report["zero_compile_restore"] = not cold
+    print(json.dumps(report))
+    if hasattr(model, "close"):
+        model.close(drain=False)
+    return 1 if (cold and args.strict) else 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="mx_bundle",
+                                description=__doc__.splitlines()[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    b = sub.add_parser("bundle",
+                       help="warm a checkpoint, snapshot to a bundle")
+    b.add_argument("--checkpoint", required=True,
+                   help="save_checkpoint prefix (prefix-symbol.json + "
+                        "prefix-%%04d.params)")
+    b.add_argument("--epoch", type=int, required=True)
+    b.add_argument("--out", required=True,
+                   help="bundle directory to create (must not exist)")
+    b.add_argument("--name", default="model")
+    b.add_argument("--version", type=int, default=1)
+    b.add_argument("--input-spec", action="append", default=[],
+                   metavar="NAME=DIMS",
+                   help="per-request shape, ragged axis as L "
+                        "(repeatable): data=L, image=3,32,32")
+    b.add_argument("--input-dtype", action="append", default=[],
+                   metavar="NAME=DTYPE")
+    b.add_argument("--batch-buckets", default=None)
+    b.add_argument("--length-buckets", default=None)
+    b.set_defaults(fn=cmd_bundle)
+
+    i = sub.add_parser("inspect", help="print a bundle's manifest")
+    i.add_argument("bundle")
+    i.set_defaults(fn=cmd_inspect)
+
+    l = sub.add_parser("load-bundle",
+                       help="restore a bundle here; report trace/"
+                            "compile evidence")
+    l.add_argument("bundle")
+    l.add_argument("--no-warmup", action="store_true")
+    l.add_argument("--strict", action="store_true",
+                   help="exit 1 unless the restore was zero-trace, "
+                        "zero-compile")
+    l.set_defaults(fn=cmd_load_bundle)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

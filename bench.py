@@ -541,12 +541,15 @@ def _passes_bench(platform):
 def _decode_bench(platform):
     """BENCH_MODE=decode: continuous-batching autoregressive serving.
 
-    Ragged prompt traffic through decoding.DecodedModel (paged KV
-    cache, per-step admission/eviction) measured as prefill and decode
-    tokens/s, KV-page occupancy, and KV-memory padding waste versus
-    the rectangular (batch, max_context) cache a one-shot batcher
-    would pin per request. Gate (ci/check_decode.sh): zero retraces
-    in steady state and paged waste strictly below rectangular."""
+    Shared-prefix ragged prompt traffic through decoding.DecodedModel
+    (paged KV cache, per-step admission/eviction, prefix cache)
+    measured as prefill and decode tokens/s, prefix-cache page reuse,
+    KV-page occupancy, and KV-memory padding waste versus the
+    rectangular (batch, max_context) cache a one-shot batcher would
+    pin per request — plus a speculative arm (K=4 self-draft)
+    reporting emitted tokens per target step. Gate
+    (ci/check_decode.sh): zero retraces in steady state and paged
+    waste strictly below rectangular."""
     import numpy as np
 
     import mxnet_tpu as mx
@@ -564,10 +567,18 @@ def _decode_bench(platform):
         queue_cap=max(256, n_requests), max_tokens=max_new)
     floor = model.engine.traces()
 
+    # chat-shaped traffic: half the requests share a system-preamble
+    # prefix (2 pages), the rest are unrelated — the prefix cache
+    # should serve the shared half from pages already prefilled
     rs = np.random.RandomState(0)
-    prompts = [rs.randint(2, cfg.vocab,
-                          size=int(rs.randint(4, 25))).tolist()
-               for _ in range(n_requests)]
+    shared = rs.randint(2, cfg.vocab, size=2 * page_size).tolist()
+    prompts = []
+    for i in range(n_requests):
+        tail = rs.randint(2, cfg.vocab,
+                          size=int(rs.randint(4, 9))).tolist()
+        prompts.append(shared + tail if i % 2 else
+                       rs.randint(2, cfg.vocab,
+                                  size=int(rs.randint(4, 25))).tolist())
     t0 = time.perf_counter()
     futs = [model.submit(p, max_new_tokens=max_new) for p in prompts]
     outs = [f.result(600) for f in futs]
@@ -589,6 +600,22 @@ def _decode_bench(platform):
         / max(1, snap["pages_total"])
     model.close()
 
+    # speculative arm: same traffic shape, K=4 self-draft; the
+    # interesting number is how many tokens each TARGET step emits
+    spec_model = dec.DecodedModel(
+        "bench-spec", 1, params, cfg, max_batch=8,
+        page_size=page_size, num_pages=128, page_buckets=(1, 2, 4, 8),
+        queue_cap=max(256, n_requests), max_tokens=max_new,
+        draft="self", spec_k=4, prefix_cache=False)
+    spec_floor = spec_model.engine.traces()
+    sfuts = [spec_model.submit(p, max_new_tokens=max_new)
+             for p in prompts[:n_requests // 2]]
+    for f in sfuts:
+        f.result(600)
+    spec_traces = spec_model.engine.traces() - spec_floor
+    spec_snap = spec_model.stats.snapshot()
+    spec_model.close()
+
     _emit({
         "metric": f"decode_throughput_{platform}"
                   f"_b8_p{page_size}_n{n_requests}",
@@ -608,7 +635,12 @@ def _decode_bench(platform):
         if paged_slots else 0.0,
         "padding_waste_oneshot": round(1 - toks / rect_slots, 4)
         if rect_slots else 0.0,
-        "traces_added": traces_added,
+        "prefix_hit_rate": snap["prefix_hit_rate"],
+        "prefix_pages_reused": snap["prefix_pages_reused"],
+        "spec_tokens_per_target_step":
+            spec_snap["tokens_per_target_step"],
+        "spec_acceptance_rate": spec_snap["spec_acceptance_rate"],
+        "traces_added": traces_added + spec_traces,
         "traces_since_warmup": snap["traces_since_warmup"],
         "requests": n_requests,
         "telemetry": _telemetry_snapshot(),

@@ -94,21 +94,31 @@ HOT_PATH_MANIFEST = {
     # the only sanctioned syncs are the engine's np.asarray token
     # fetches (one per prefill, one per step — EOS/stream need them)
     "mxnet_tpu/decoding/blocks.py": "*",
+    # the radix lookup runs inside every admission, the sampler and
+    # the speculative propose/verify forwards run inside the jitted
+    # step programs — none may fetch or retrace
+    "mxnet_tpu/decoding/prefix.py": "*",
+    "mxnet_tpu/decoding/sampling.py": "*",
+    "mxnet_tpu/decoding/speculative.py": "*",
     "mxnet_tpu/decoding/engine.py": (
         "DecodeEngine.prefill", "DecodeEngine.step",
-        "DecodeEngine.copy_page", "DecodeEngine.pool_stats",
+        "DecodeEngine.spec_step", "DecodeEngine.copy_page",
+        "DecodeEngine.pool_stats",
     ),
     "mxnet_tpu/decoding/scheduler.py": (
         "ContinuousScheduler._admit", "ContinuousScheduler._grow",
         "ContinuousScheduler._step", "ContinuousScheduler._preempt",
         "ContinuousScheduler._reclaim_one",
+        "ContinuousScheduler._free_one_page",
         "ContinuousScheduler._check_deadlines",
+        "ContinuousScheduler._check_cancelled",
         "ContinuousScheduler._handle_token",
         "ContinuousScheduler._resolve",
     ),
     "mxnet_tpu/decoding/stats.py": (
         "DecodeStats.note_step", "DecodeStats.note_prefill",
         "DecodeStats.note_preempted", "DecodeStats.note_pool",
+        "DecodeStats.note_spec", "DecodeStats.note_prefix_reuse",
     ),
     # sharding plan resolution + jit lowering (PR 11): resolve/digest
     # run inside every bind (ahead of the exec-cache lookup) and the

@@ -17,6 +17,16 @@ package applies the Ragged Paged Attention recipe (PAPERS.md) instead:
              fixed-shape program grid (zero steady-state retraces)
   scheduler  ContinuousScheduler + DecodedModel — per-step admission,
              eviction, priority preemption, streaming DecodeFuture
+             (whose TokenStream owns/cancels the request)
+  prefix     PrefixCache — radix index over cached prompt KV pages;
+             admission maps shared prefixes via the fork path and
+             prefills only the tail
+  sampling   SamplingParams + the (seed, position, salt) counter
+             streams: temperature/top-k/top-p inside the jitted step,
+             bit-reproducible across preemption
+  speculative draft-proposes-K / target-verifies-K+1 forwards over
+             the same page tables (distribution-identical output,
+             exact under greedy)
   stats      DecodeStats -> `decodingStats` view (profiler dumps,
              /metrics, /statusz)
 
@@ -30,23 +40,28 @@ package applies the Ragged Paged Attention recipe (PAPERS.md) instead:
 Knobs: MXNET_DECODE_* (docs/env_vars.md). Guide: docs/serving.md
 ("Continuous decoding").
 """
-from . import attention, blocks, config, engine, model, scheduler, \
-    stats
+from . import attention, blocks, config, engine, model, prefix, \
+    sampling, scheduler, speculative, stats
 from .blocks import (SCRATCH_PAGE, BlockAllocator, PageError,
                      PagePoolExhausted, pages_needed)
-from .attention import (get_kernel, paged_attention_lax,
-                        paged_attention_pallas)
+from .attention import (get_kernel, get_multi_kernel,
+                        paged_attention_lax, paged_attention_pallas)
 from .engine import DecodeEngine
 from .model import DecoderConfig, init_decoder_params, reference_logits
-from .scheduler import ContinuousScheduler, DecodeFuture, DecodedModel
+from .prefix import PrefixCache
+from .sampling import SamplingParams
+from .scheduler import (ContinuousScheduler, DecodeFuture,
+                        DecodedModel, TokenStream)
 from .stats import DecodeStats, decoding_stats, reset_decoding_stats
 
 __all__ = [
     "BlockAllocator", "ContinuousScheduler", "DecodeEngine",
     "DecodeFuture", "DecodeStats", "DecodedModel", "DecoderConfig",
-    "PageError", "PagePoolExhausted", "SCRATCH_PAGE", "attention",
-    "blocks", "config", "decoding_stats", "engine", "get_kernel",
+    "PageError", "PagePoolExhausted", "PrefixCache", "SCRATCH_PAGE",
+    "SamplingParams", "TokenStream", "attention", "blocks", "config",
+    "decoding_stats", "engine", "get_kernel", "get_multi_kernel",
     "init_decoder_params", "model", "paged_attention_lax",
-    "paged_attention_pallas", "pages_needed", "reference_logits",
-    "reset_decoding_stats", "scheduler", "stats",
+    "paged_attention_pallas", "pages_needed", "prefix",
+    "reference_logits", "reset_decoding_stats", "sampling",
+    "scheduler", "speculative", "stats",
 ]

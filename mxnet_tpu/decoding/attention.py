@@ -75,6 +75,47 @@ def paged_attention_lax(q, k_pages, v_pages, page_table, lengths,
     return out.astype(q.dtype)
 
 
+def paged_attention_lax_multi(q, k_pages, v_pages, page_table,
+                              q_positions, scale=None):
+    """Multi-query variant: S queries per row over the same paged
+    context, each masked by its OWN absolute position.
+
+      q            (B, S, H, D)   queries (tail-prefill / verify)
+      q_positions  (B, S) int32   absolute position of each query;
+                                  query j attends context positions
+                                  <= q_positions[b, j]
+
+    The per-query causal mask is what lets ONE fixed-shape program
+    serve both the prefix-cache tail prefill (queries = the uncached
+    prompt tail, context = shared pages + the tail itself) and the
+    speculative verify step (queries = last_token + K drafts). Shapes
+    are a function of (B, S, pages bucket) only.
+    """
+    b, s, h, d = q.shape
+    n, p, hh, dd = k_pages.shape
+    if (hh, dd) != (h, d):
+        raise ValueError(
+            f"pool heads/dim {(hh, dd)} != query {(h, d)}")
+    if page_table.shape[0] != b or q_positions.shape != (b, s):
+        raise ValueError("page_table/q_positions batch mismatch")
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    t = page_table.shape[1] * p
+    k_ctx = k_pages[page_table].reshape(b, t, h, d)
+    v_ctx = v_pages[page_table].reshape(b, t, h, d)
+    sc = jnp.einsum("bshd,bthd->bhst", q, k_ctx,
+                    preferred_element_type=jnp.float32) * scale
+    mask = (jnp.arange(t)[None, None, :]
+            <= q_positions[:, :, None])          # (B, S, T)
+    sc = jnp.where(mask[:, None], sc, NEG_INF)
+    m = sc.max(axis=-1, keepdims=True)
+    e = jnp.exp(sc - m)
+    w = e / e.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bhst,bthd->bshd", w, v_ctx,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
 # ---------------------------------------------------------------- pallas
 def _paged_attn_kernel(page_size):
     """Kernel body on a (B, Bp) grid: one (page, row) tile per step,
@@ -164,6 +205,23 @@ _KERNELS = {
     "lax": paged_attention_lax,
     "pallas": paged_attention_pallas,
 }
+
+# the multi-query paths (tail prefill, speculative verify) have one
+# implementation today; the pallas flash variant is a silicon item
+_MULTI_KERNELS = {
+    "lax": paged_attention_lax_multi,
+    "pallas": paged_attention_lax_multi,
+}
+
+
+def get_multi_kernel(name):
+    """Resolve MXNET_DECODE_KERNEL to a multi-query implementation."""
+    try:
+        return _MULTI_KERNELS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown MXNET_DECODE_KERNEL {name!r} "
+            f"(choices: {sorted(_MULTI_KERNELS)})") from None
 
 
 def get_kernel(name):

@@ -74,6 +74,11 @@ class BlockAllocator:
         self._refs = [0] * self.num_pages
         self._refs[SCRATCH_PAGE] = 1  # pinned forever
         self._low_watermark = len(self._free)
+        # cumulative pages ever allocated (alloc + COW copies): the
+        # work-avoided evidence of prefix sharing — a cache hit refs
+        # instead of allocating, so this counter, not occupancy, is
+        # what the decode-gate's shared-prefix arm compares
+        self._allocated_total = 0
 
     # ------------------------------------------------------------ state
     def free_pages(self):
@@ -116,6 +121,7 @@ class BlockAllocator:
             out = [self._free.pop() for _ in range(n)]
             for p in out:
                 self._refs[p] = 1
+            self._allocated_total += n
             if len(self._free) < self._low_watermark:
                 self._low_watermark = len(self._free)
             return out
@@ -174,6 +180,7 @@ class BlockAllocator:
             fresh = self._free.pop()
             self._refs[fresh] = 1
             self._refs[page] -= 1
+            self._allocated_total += 1
             if len(self._free) < self._low_watermark:
                 self._low_watermark = len(self._free)
         table[idx] = fresh
@@ -203,4 +210,5 @@ class BlockAllocator:
             "pages_in_use": (self.num_pages - 1) - free,
             "free_low_watermark": self._low_watermark,
             "page_size": self.page_size,
+            "pages_allocated": self._allocated_total,
         }

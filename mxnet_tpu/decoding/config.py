@@ -43,6 +43,34 @@ def queue_cap():
     return utils.getenv("MXNET_DECODE_QUEUE_CAP")
 
 
+def prefix_cache():
+    return bool(utils.getenv("MXNET_DECODE_PREFIX_CACHE"))
+
+
+def spec_k():
+    return utils.getenv("MXNET_DECODE_SPEC_K")
+
+
+def spec_draft():
+    return utils.getenv("MXNET_DECODE_SPEC_DRAFT")
+
+
+def sampling_temperature():
+    return utils.getenv("MXNET_DECODE_SAMPLING_TEMPERATURE")
+
+
+def sampling_top_k():
+    return utils.getenv("MXNET_DECODE_SAMPLING_TOP_K")
+
+
+def sampling_top_p():
+    return utils.getenv("MXNET_DECODE_SAMPLING_TOP_P")
+
+
+def sampling_seed():
+    return utils.getenv("MXNET_DECODE_SAMPLING_SEED")
+
+
 def default_page_buckets(max_pages_per_seq):
     """Powers of two up to max_pages_per_seq (inclusive): each bucket
     is one compiled decode program, so the grid stays logarithmic."""

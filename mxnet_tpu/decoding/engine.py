@@ -7,11 +7,21 @@ a FIXED grid of jitted programs:
   prefill  one program per prompt length bucket (batch 1, dense causal
            attention — optionally ring attention for long buckets —
            that scatters K/V into the sequence's pages)
+  tail     one tail-prefill program per length bucket (prefix-cache
+           hits: compute only the uncached prompt tail, attending over
+           the shared pages — page table padded to the largest bucket
+           for one static shape per tail bucket)
   decode   one program per pages-per-sequence bucket; the step shape
            is a function ONLY of (max_batch, bucket) — never of real
            lengths or batch composition — so `warmup()` pre-traces the
            full grid and steady-state decode adds zero traces
-  copy     one page-copy program (copy-on-write fork support)
+  draft/   with a draft model configured, one K-token draft proposer
+  verify   and one K+1-position target verifier per pages bucket —
+           the speculative pair joins the same pinned trace grid, and
+           the draft keeps parallel K/V pools indexed by the SAME
+           page ids (see speculative.py)
+  copy     one page-copy program (copy-on-write fork support; traced
+           once more for the draft pool shape when it differs)
 
 Trace accounting: every impl body bumps a python-side counter as its
 first statement. Python runs at TRACE time only, so the counter counts
@@ -34,6 +44,7 @@ from ..serving.batcher import pick_bucket
 from . import config as _cfg
 from . import attention as _attn
 from . import model as _model
+from . import speculative as _spec
 
 # warn-once latch for calibration-harvest failures (the serving
 # registry's convention: one WARN per process, not one per bucket)
@@ -45,7 +56,8 @@ from .blocks import SCRATCH_PAGE, BlockAllocator, PageError, \
 class DecodeEngine:
     def __init__(self, params, cfg, *, max_batch=None, page_size=None,
                  num_pages=None, page_buckets=None, kernel=None,
-                 ring_prefill=None):
+                 ring_prefill=None, draft_params=None, draft_cfg=None,
+                 spec_k=None, prefix_cache=None):
         self.cfg = cfg
         self.max_batch = max_batch if max_batch is not None \
             else _cfg.max_batch()
@@ -79,16 +91,46 @@ class DecodeEngine:
 
         self.allocator = BlockAllocator(self.num_pages, self.page_size)
         self._attn = _attn.get_kernel(self.kernel_name)
+        self._attn_multi = _attn.get_multi_kernel(self.kernel_name)
         self._params = jax.tree_util.tree_map(jnp.asarray, dict(params))
         shape = (cfg.n_layers, self.num_pages, self.page_size,
                  cfg.n_heads, cfg.head_dim)
         self._k = jnp.zeros(shape, jnp.float32)
         self._v = jnp.zeros(shape, jnp.float32)
+        self.prefix_cache_enabled = prefix_cache if prefix_cache \
+            is not None else _cfg.prefix_cache()
+        self.spec_k = int(spec_k) if spec_k is not None \
+            else _cfg.spec_k()
+        self.draft_cfg = None
+        self._draft_params = None
+        if draft_params is not None and self.spec_k > 0:
+            dcfg = draft_cfg if draft_cfg is not None else cfg
+            if dcfg.vocab != cfg.vocab:
+                raise PageError(
+                    f"draft vocab {dcfg.vocab} != target {cfg.vocab}: "
+                    "speculative decoding needs one token space")
+            if dcfg.max_len < cfg.max_len:
+                raise PageError(
+                    f"draft max_len {dcfg.max_len} < target "
+                    f"{cfg.max_len}: the draft must cover every "
+                    "position the target can reach")
+            self.draft_cfg = dcfg
+            self._draft_params = jax.tree_util.tree_map(
+                jnp.asarray, dict(draft_params))
+            dshape = (dcfg.n_layers, self.num_pages, self.page_size,
+                      dcfg.n_heads, dcfg.head_dim)
+            self._dk = jnp.zeros(dshape, jnp.float32)
+            self._dv = jnp.zeros(dshape, jnp.float32)
         # donation lets XLA update the pool in place; CPU falls back
         # with a warning, so only donate where it pays
         self._donate = jax.default_backend() != "cpu"
         self._decode_fns = {}
         self._prefill_fns = {}
+        self._tail_fns = {}
+        self._draft_prefill_fns = {}
+        self._draft_tail_fns = {}
+        self._propose_fns = {}
+        self._verify_fns = {}
         self._copy_fn = None
         self._trace_counts = {}
         self._warm = False
@@ -104,7 +146,9 @@ class DecodeEngine:
 
         self._digest = _hashlib.sha1(repr(
             (cfg, self.max_batch, self.page_size, self.num_pages,
-             self.kernel_name)).encode()).hexdigest()[:12]
+             self.kernel_name, self.draft_cfg,
+             self.spec_k if self.spec_enabled else 0)
+        ).encode()).hexdigest()[:12]
 
     def _instrument(self, fn, kind):
         """Route one grid program through profiling's executable
@@ -120,6 +164,12 @@ class DecodeEngine:
             return fn
 
     # ------------------------------------------------------ properties
+    @property
+    def spec_enabled(self):
+        """True when a draft model is loaded and K > 0: the scheduler
+        routes steps through spec_step instead of step."""
+        return self._draft_params is not None and self.spec_k > 0
+
     @property
     def max_context(self):
         """Tokens the largest bucket covers — the hard length cap."""
@@ -147,6 +197,7 @@ class DecodeEngine:
             "kv_occupancy": round(
                 st["pages_in_use"] / max(1, st["pages_total"]), 4),
             "free_low_watermark": st["free_low_watermark"],
+            "pages_allocated": st["pages_allocated"],
         }
 
     def _note_trace(self, name):
@@ -189,18 +240,20 @@ class DecodeEngine:
         guard = self._guard
 
         def impl(params, tokens, k_pages, v_pages, page_table,
-                 lengths, active):
+                 lengths, active, seeds, temps, top_ks, top_ps):
             self._note_trace(f"decode@{bucket}")
             return _model.decode_forward(
                 params, tokens, k_pages, v_pages, page_table,
-                lengths, active, cfg=cfg, attn=attn, with_stats=guard)
+                lengths, active, seeds, temps, top_ks, top_ps,
+                cfg=cfg, attn=attn, with_stats=guard)
 
         donate = (2, 3) if self._donate else ()
         return self._instrument(jax.jit(impl, donate_argnums=donate),
                                 f"decode@{bucket}")
 
-    def _build_prefill_fn(self, length_bucket):
-        cfg = self.cfg
+    def _build_prefill_fn(self, length_bucket, name="prefill",
+                          cfg=None):
+        cfg = cfg if cfg is not None else self.cfg
         attn_fn = None
         if self.ring_prefill and length_bucket >= self.ring_prefill:
             # NOTE: mxnet_tpu.parallel re-exports the ring_attention
@@ -214,15 +267,64 @@ class DecodeEngine:
             def attn_fn(q, k, v):
                 return ring_attention(q, k, v, mesh=mesh, causal=True)
 
-        def impl(params, tokens, length, k_pages, v_pages, page_ids):
-            self._note_trace(f"prefill@{length_bucket}")
+        def impl(params, tokens, length, k_pages, v_pages, page_ids,
+                 seed, temp, top_k, top_p):
+            self._note_trace(f"{name}@{length_bucket}")
             return _model.prefill_forward(
                 params, tokens, length, k_pages, v_pages, page_ids,
-                cfg=cfg, attn_fn=attn_fn)
+                seed, temp, top_k, top_p, cfg=cfg, attn_fn=attn_fn)
 
         donate = (3, 4) if self._donate else ()
         return self._instrument(jax.jit(impl, donate_argnums=donate),
-                                f"prefill@{length_bucket}")
+                                f"{name}@{length_bucket}")
+
+    def _build_tail_fn(self, length_bucket, name="prefill_tail",
+                       cfg=None):
+        cfg = cfg if cfg is not None else self.cfg
+        attn_multi = self._attn_multi
+
+        def impl(params, tokens, start, length, k_pages, v_pages,
+                 page_ids, seed, temp, top_k, top_p):
+            self._note_trace(f"{name}@{length_bucket}")
+            return _model.tail_prefill_forward(
+                params, tokens, start, length, k_pages, v_pages,
+                page_ids, seed, temp, top_k, top_p, cfg=cfg,
+                attn_multi=attn_multi)
+
+        donate = (4, 5) if self._donate else ()
+        return self._instrument(jax.jit(impl, donate_argnums=donate),
+                                f"{name}@{length_bucket}")
+
+    def _build_propose_fn(self, bucket):
+        cfg, attn, k = self.draft_cfg, self._attn, self.spec_k
+
+        def impl(params, tokens, k_pages, v_pages, page_table,
+                 lengths, active, seeds, temps, top_ks, top_ps):
+            self._note_trace(f"draft@{bucket}")
+            return _spec.draft_propose_forward(
+                params, tokens, k_pages, v_pages, page_table, lengths,
+                active, seeds, temps, top_ks, top_ps, cfg=cfg,
+                attn=attn, k=k)
+
+        donate = (2, 3) if self._donate else ()
+        return self._instrument(jax.jit(impl, donate_argnums=donate),
+                                f"draft@{bucket}")
+
+    def _build_verify_fn(self, bucket):
+        cfg, attn_multi, k = self.cfg, self._attn_multi, self.spec_k
+
+        def impl(params, tokens, drafts, q_dists, k_pages, v_pages,
+                 page_table, lengths, active, use_draft, seeds, temps,
+                 top_ks, top_ps):
+            self._note_trace(f"verify@{bucket}")
+            return _spec.verify_forward(
+                params, tokens, drafts, q_dists, k_pages, v_pages,
+                page_table, lengths, active, use_draft, seeds, temps,
+                top_ks, top_ps, cfg=cfg, attn_multi=attn_multi, k=k)
+
+        donate = (4, 5) if self._donate else ()
+        return self._instrument(jax.jit(impl, donate_argnums=donate),
+                                f"verify@{bucket}")
 
     def _build_copy_fn(self):
         def impl(pool, src, dst):
@@ -233,36 +335,95 @@ class DecodeEngine:
         return self._instrument(jax.jit(impl, donate_argnums=donate),
                                 "copy_page")
 
+    # --------------------------------------------- fixed-dtype packing
+    @staticmethod
+    def _samp_scalars(seed=0, temperature=0.0, top_k=0, top_p=1.0):
+        """Sampling params as fixed-dtype scalars: one traced
+        signature regardless of host value types."""
+        return (np.uint32(int(seed) & 0xFFFFFFFF),
+                np.float32(temperature), np.int32(top_k),
+                np.float32(top_p))
+
+    def _samp_arrays(self, seeds, temps, top_ks, top_ps):
+        """(B,) sampling arrays, defaulting to greedy, fixed dtypes."""
+        b = self.max_batch
+        if seeds is None:
+            seeds = np.zeros((b,), np.uint32)
+        if temps is None:
+            temps = np.zeros((b,), np.float32)
+        if top_ks is None:
+            top_ks = np.zeros((b,), np.int32)
+        if top_ps is None:
+            top_ps = np.ones((b,), np.float32)
+        return (np.asarray(seeds, np.uint32),
+                np.asarray(temps, np.float32),
+                np.asarray(top_ks, np.int32),
+                np.asarray(top_ps, np.float32))
+
     # ---------------------------------------------------------- warmup
     def warmup(self):
         """Pre-trace the full program grid: every prefill length
-        bucket, every decode pages bucket, and the page copy. All
-        writes of the dry runs land in the scratch page (lengths 0,
-        tables all-scratch), so the pool state is untouched except for
+        bucket (full + tail when the prefix cache is on, for the
+        draft too when speculation is on), every decode pages bucket
+        (plus the draft/verify pair), and the page copy. All writes of
+        the dry runs land in the scratch page (lengths 0, tables
+        all-scratch), so the pool state is untouched except for
         scratch garbage — which is never read unmasked. Idempotent."""
         if self._warm:
             return self
         self._copy_fn = self._build_copy_fn()
         self.copy_page(SCRATCH_PAGE, SCRATCH_PAGE)
+        sargs = self._samp_scalars()
+        max_pages = pages_needed(self.max_context, self.page_size)
         for lb in self.prefill_buckets:
-            self._prefill_fns[lb] = self._build_prefill_fn(lb)
             tokens = np.zeros((1, lb), np.int32)
             page_ids = np.zeros((pages_needed(lb, self.page_size),),
                                 np.int32)
+            full_ids = np.zeros((max_pages,), np.int32)
+            self._prefill_fns[lb] = self._build_prefill_fn(lb)
             tok, self._k, self._v = self._prefill_fns[lb](
                 self._params, tokens, jnp.int32(0), self._k, self._v,
-                page_ids)
+                page_ids, *sargs)
             tok.block_until_ready()
+            if self.prefix_cache_enabled:
+                self._tail_fns[lb] = self._build_tail_fn(lb)
+                tok, self._k, self._v = self._tail_fns[lb](
+                    self._params, tokens, jnp.int32(0), jnp.int32(0),
+                    self._k, self._v, full_ids, *sargs)
+                tok.block_until_ready()
+            if self.spec_enabled:
+                self._draft_prefill_fns[lb] = self._build_prefill_fn(
+                    lb, name="draft_prefill", cfg=self.draft_cfg)
+                tok, self._dk, self._dv = self._draft_prefill_fns[lb](
+                    self._draft_params, tokens, jnp.int32(0),
+                    self._dk, self._dv, page_ids, *sargs)
+                tok.block_until_ready()
+                if self.prefix_cache_enabled:
+                    self._draft_tail_fns[lb] = self._build_tail_fn(
+                        lb, name="draft_tail", cfg=self.draft_cfg)
+                    tok, self._dk, self._dv = self._draft_tail_fns[lb](
+                        self._draft_params, tokens, jnp.int32(0),
+                        jnp.int32(0), self._dk, self._dv, full_ids,
+                        *sargs)
+                    tok.block_until_ready()
+        b = self.max_batch
+        dry = (np.zeros((b,), np.int32), np.zeros((b,), np.int32),
+               np.zeros((b,), bool))
+        sarr = self._samp_arrays(None, None, None, None)
         for bucket in self.page_buckets:
+            table = np.zeros((b, bucket), np.int32)
             self._decode_fns[bucket] = self._build_decode_fn(bucket)
-            b = self.max_batch
             out = self._run_decode(
-                self._decode_fns[bucket], self._params,
-                np.zeros((b,), np.int32), self._k, self._v,
-                np.zeros((b, bucket), np.int32),
-                np.zeros((b,), np.int32),
-                np.zeros((b,), bool))
+                self._decode_fns[bucket], self._params, dry[0],
+                self._k, self._v, table, dry[1], dry[2], *sarr)
             out.block_until_ready()
+            if self.spec_enabled:
+                self._propose_fns[bucket] = self._build_propose_fn(
+                    bucket)
+                self._verify_fns[bucket] = self._build_verify_fn(
+                    bucket)
+                self.spec_step(dry[0], table, dry[1], dry[2],
+                               np.zeros((b,), bool), *sarr)
         self._harvest_calibration()
         self._guard_pending = []  # warmup rows are all-masked noise
         self._warm = True
@@ -283,6 +444,7 @@ class DecodeEngine:
             store = _profiling.calibration_store()
             platform = jax.default_backend()
             b = self.max_batch
+            sarr = self._samp_arrays(None, None, None, None)
             for bucket in self.page_buckets:
                 t0 = _time.perf_counter()
                 out = self._run_decode(
@@ -290,7 +452,7 @@ class DecodeEngine:
                     np.zeros((b,), np.int32), self._k, self._v,
                     np.zeros((b, bucket), np.int32),
                     np.zeros((b,), np.int32),
-                    np.zeros((b,), bool))
+                    np.zeros((b,), bool), *sarr)
                 out.block_until_ready()
                 seconds = _time.perf_counter() - t0
                 store.record(self._digest, platform,
@@ -313,42 +475,100 @@ class DecodeEngine:
                     self._digest, e)
 
     # -------------------------------------------------------- hot path
-    def prefill(self, token_ids, table):
+    def prefill(self, token_ids, table, *, start=0, seed=0,
+                temperature=0.0, top_k=0, top_p=1.0):
         """Fill `table`'s pages with the prompt's K/V; returns the
         first generated token (host int). `table` must already cover
-        pages_needed(len(token_ids))."""
+        pages_needed(len(token_ids)).
+
+        `start > 0` is the prefix-cache hit path: positions < start
+        already live in (shared) pages, so only the tail runs —
+        through the tail program family, whose page table is padded to
+        the largest bucket for a static shape. With a draft model
+        loaded, the same prompt also prefills the draft pools (same
+        pages, draft-shaped K/V)."""
         n = len(token_ids)
-        lb = pick_bucket(n, self.prefill_buckets)
-        tokens = np.zeros((1, lb), np.int32)
-        tokens[0, :n] = token_ids
-        page_ids = np.full((pages_needed(lb, self.page_size),),
-                           SCRATCH_PAGE, np.int32)
-        page_ids[:len(table)] = table
-        tok, self._k, self._v = self._prefill_fns[lb](
-            self._params, tokens, jnp.int32(n), self._k, self._v,
-            page_ids)
+        sargs = self._samp_scalars(seed, temperature, top_k, top_p)
+        zargs = self._samp_scalars()  # draft prefill output is unused
+        if start:
+            tail = token_ids[start:]
+            lb = pick_bucket(len(tail), self.prefill_buckets)
+            tokens = np.zeros((1, lb), np.int32)
+            tokens[0, :len(tail)] = tail
+            max_pages = pages_needed(self.max_context, self.page_size)
+            page_ids = np.full((max_pages,), SCRATCH_PAGE, np.int32)
+            page_ids[:len(table)] = table
+            tok, self._k, self._v = self._tail_fns[lb](
+                self._params, tokens, jnp.int32(start), jnp.int32(n),
+                self._k, self._v, page_ids, *sargs)
+            if self.spec_enabled:
+                _, self._dk, self._dv = self._draft_tail_fns[lb](
+                    self._draft_params, tokens, jnp.int32(start),
+                    jnp.int32(n), self._dk, self._dv, page_ids, *zargs)
+        else:
+            lb = pick_bucket(n, self.prefill_buckets)
+            tokens = np.zeros((1, lb), np.int32)
+            tokens[0, :n] = token_ids
+            page_ids = np.full((pages_needed(lb, self.page_size),),
+                               SCRATCH_PAGE, np.int32)
+            page_ids[:len(table)] = table
+            tok, self._k, self._v = self._prefill_fns[lb](
+                self._params, tokens, jnp.int32(n), self._k, self._v,
+                page_ids, *sargs)
+            if self.spec_enabled:
+                _, self._dk, self._dv = self._draft_prefill_fns[lb](
+                    self._draft_params, tokens, jnp.int32(n),
+                    self._dk, self._dv, page_ids, *zargs)
         # the sampled token must reach the host to stream/EOS-check —
         # the one deliberate sync of the prefill path
         return int(np.asarray(tok))
 
-    def step(self, tokens, page_table, lengths, active):
+    def step(self, tokens, page_table, lengths, active, seeds=None,
+             temps=None, top_ks=None, top_ps=None):
         """One continuous-decode step. All arrays are the full
         (max_batch, ...) fixed shapes; `page_table.shape[1]` must be a
-        configured bucket. Returns next tokens as a host (B,) array
-        (the stream/EOS sync — one fetch per step, by design)."""
+        configured bucket. Per-row sampling params default to greedy.
+        Returns next tokens as a host (B,) array (the stream/EOS sync
+        — one fetch per step, by design)."""
         bucket = page_table.shape[1]
+        sarr = self._samp_arrays(seeds, temps, top_ks, top_ps)
         out = self._run_decode(
             self._decode_fns[bucket], self._params, tokens,
-            self._k, self._v, page_table, lengths, active)
+            self._k, self._v, page_table, lengths, active, *sarr)
         return np.asarray(out)
 
+    def spec_step(self, tokens, page_table, lengths, active,
+                  use_draft, seeds=None, temps=None, top_ks=None,
+                  top_ps=None):
+        """One speculative step: draft proposes K tokens (one
+        dispatch), target verifies K+1 positions (one dispatch); the
+        drafts and their distributions stay on device between the two.
+        Returns (tokens_out (B, K+1), n_emit (B,)) as host arrays in
+        ONE fetch — row b emits tokens_out[b, :n_emit[b]]."""
+        bucket = page_table.shape[1]
+        sarr = self._samp_arrays(seeds, temps, top_ks, top_ps)
+        use_draft = np.asarray(use_draft, bool)
+        drafts, q_dists, self._dk, self._dv = self._propose_fns[
+            bucket](self._draft_params, tokens, self._dk, self._dv,
+                    page_table, lengths, active, *sarr)
+        tokens_out, n_emit, self._k, self._v = self._verify_fns[
+            bucket](self._params, tokens, drafts, q_dists, self._k,
+                    self._v, page_table, lengths, active, use_draft,
+                    *sarr)
+        host_toks, host_n = jax.device_get((tokens_out, n_emit))
+        return np.asarray(host_toks), np.asarray(host_n)
+
     def copy_page(self, src, dst):
-        """Device copy of one page (both pools): the COW half of
+        """Device copy of one page (all pools — the draft pools track
+        the target's COW decisions): the COW half of
         `BlockAllocator.make_writable`."""
         src = jnp.int32(src)
         dst = jnp.int32(dst)
         self._k = self._copy_fn(self._k, src, dst)
         self._v = self._copy_fn(self._v, src, dst)
+        if self._draft_params is not None:
+            self._dk = self._copy_fn(self._dk, src, dst)
+            self._dv = self._copy_fn(self._dv, src, dst)
 
     # ----------------------------------------------------- test hooks
     def read_page(self, layer, page):

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import sampling as _sampling
 from .blocks import SCRATCH_PAGE
 
 NEG_INF = -1e30
@@ -136,16 +137,30 @@ def reference_logits(params, tokens, cfg, attn_fn=None):
     return x @ params["embed"].T
 
 
+# -------------------------------------------------------------- sampling
+def _pick_token(logits, seed, position, temperature, top_k, top_p):
+    """Single-position token choice: greedy argmax when no sampling
+    params were threaded through (seed None — the PR 8 call shape),
+    else the counter-keyed sampler (sampling.sample_token)."""
+    if seed is None:
+        return jnp.argmax(logits).astype(jnp.int32)
+    return _sampling.sample_token(logits, seed, position, temperature,
+                                  top_k, top_p)
+
+
 # --------------------------------------------------------------- prefill
 def prefill_forward(params, tokens, length, k_pages, v_pages,
-                    page_ids, *, cfg, attn_fn=None):
+                    page_ids, seed=None, temperature=None, top_k=None,
+                    top_p=None, *, cfg, attn_fn=None):
     """Prompt pass: tokens (1, Tb) padded to a length bucket, length
     () int32 the true prompt length, page_ids (ceil(Tb/P),) int32 the
     sequence's allocated pages (padded with scratch 0).
 
     Scatters every layer's K/V for positions < length into the pool
     (positions >= length land in the scratch page) and returns
-    (first_token (), k_pages, v_pages).
+    (first_token (), k_pages, v_pages). The first token is sampled on
+    the (seed, position=length) stream when sampling params are
+    given, greedy argmax otherwise.
     """
     scale = 1.0 / math.sqrt(cfg.head_dim)
     page_size = k_pages.shape[2]
@@ -172,31 +187,77 @@ def prefill_forward(params, tokens, length, k_pages, v_pages,
     x = _rms(x, params["ln_f"])
     last = x[0, length - 1]
     logits = last @ params["embed"].T
-    return jnp.argmax(logits).astype(jnp.int32), k_pages, v_pages
+    tok = _pick_token(logits, seed, length, temperature, top_k, top_p)
+    return tok, k_pages, v_pages
+
+
+# ---------------------------------------------------- prefix-cache tail
+def tail_prefill_forward(params, tokens, start, length, k_pages,
+                         v_pages, page_ids, seed=None, temperature=None,
+                         top_k=None, top_p=None, *, cfg, attn_multi):
+    """Tail-only prompt pass for a prefix-cache hit: positions
+    [0, start) already live in shared pages (K/V is a pure function of
+    the token prefix from position 0, so pages cached for one sequence
+    are exact for any sequence with the same prefix); only the tail
+    [start, length) is computed here.
+
+    tokens (1, Tb) holds the TAIL tokens padded to a length bucket;
+    start/length are () int32 (absolute); page_ids covers the FULL
+    table padded to the engine's largest bucket (one static shape per
+    tail bucket). Each layer scatters the tail K/V into its pages and
+    attends the tail queries over the context gathered from pages
+    (shared prefix + just-written tail) with per-query causal masks —
+    FLOPs scale with tail x context instead of prompt^2.
+    """
+    page_size = k_pages.shape[2]
+    _, t = tokens.shape
+    cap = page_ids.shape[0] * page_size
+    pos = start + jnp.arange(t)                      # absolute
+    valid = (pos < length) & (pos < cap)
+    tgt_pages = jnp.where(
+        valid, page_ids[jnp.clip(pos // page_size, 0,
+                                 page_ids.shape[0] - 1)], SCRATCH_PAGE)
+    slots = pos % page_size
+    pos_safe = jnp.clip(pos, 0, cfg.max_len - 1)
+
+    x = params["embed"][tokens] + params["pos"][pos_safe][None]
+    for i in range(cfg.n_layers):
+        h1 = _rms(x, params[f"l{i}.ln1"])
+        q, k, v = _qkv(params, i, h1, cfg)
+        k_pages = k_pages.at[i, tgt_pages, slots].set(k[0])
+        v_pages = v_pages.at[i, tgt_pages, slots].set(v[0])
+        o = attn_multi(q, k_pages[i], v_pages[i], page_ids[None],
+                       pos_safe[None])
+        x = x + o.reshape(1, t, cfg.d_model) @ params[f"l{i}.wo"]
+        x = x + _mlp(params, i, _rms(x, params[f"l{i}.ln2"]))
+    x = _rms(x, params["ln_f"])
+    last = x[0, length - 1 - start]
+    logits = last @ params["embed"].T
+    tok = _pick_token(logits, seed, length, temperature, top_k, top_p)
+    return tok, k_pages, v_pages
 
 
 # ---------------------------------------------------------------- decode
-def decode_forward(params, tokens, k_pages, v_pages, page_table,
-                   lengths, active, *, cfg, attn, with_stats=False):
-    """One decode step over the full fixed-shape batch.
-
-    tokens (B,) int32 last emitted token per row; lengths (B,) tokens
-    already in cache; active (B,) bool. Inactive rows write to / read
-    from the scratch page and their outputs are ignored by the host.
-    Returns (next_tokens (B,), k_pages, v_pages); with_stats=True
-    (the MXNET_NUMERICS_DECODE_GUARD path) appends a scalar count of
-    ACTIVE rows whose logits hold any NaN/Inf — computed inside the
-    jit, so the guard adds zero host syncs to the step.
-    """
+def decode_logits(params, tokens, k_pages, v_pages, page_table,
+                  lengths, active, *, cfg, attn):
+    """The shared decode-step body: embed each row's last token, append
+    its K/V at index `lengths` through the page table, attend over the
+    pages, return (logits (B, V), k_pages, v_pages). decode_forward and
+    the speculative draft proposer both build on this."""
     page_size = k_pages.shape[2]
     b = tokens.shape[0]
+    bp = page_table.shape[1]
     rows = jnp.arange(b)
+    in_cap = lengths < bp * page_size
     w_pages = jnp.where(
-        active, page_table[rows, lengths // page_size], SCRATCH_PAGE)
+        active & in_cap,
+        page_table[rows, jnp.clip(lengths // page_size, 0, bp - 1)],
+        SCRATCH_PAGE)
     slots = lengths % page_size
     ctx_len = jnp.where(active, lengths + 1, 1)
 
-    x = params["embed"][tokens] + params["pos"][lengths]
+    x = params["embed"][tokens] + params["pos"][
+        jnp.clip(lengths, 0, cfg.max_len - 1)]
     for i in range(cfg.n_layers):
         h1 = _rms(x, params[f"l{i}.ln1"])
         q, k, v = _qkv(params, i, h1, cfg)
@@ -206,8 +267,36 @@ def decode_forward(params, tokens, k_pages, v_pages, page_table,
         x = x + o.reshape(b, cfg.d_model) @ params[f"l{i}.wo"]
         x = x + _mlp(params, i, _rms(x, params[f"l{i}.ln2"]))
     x = _rms(x, params["ln_f"])
-    logits = x @ params["embed"].T
-    next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return x @ params["embed"].T, k_pages, v_pages
+
+
+def decode_forward(params, tokens, k_pages, v_pages, page_table,
+                   lengths, active, seeds=None, temps=None,
+                   top_ks=None, top_ps=None, *, cfg, attn,
+                   with_stats=False):
+    """One decode step over the full fixed-shape batch.
+
+    tokens (B,) int32 last emitted token per row; lengths (B,) tokens
+    already in cache; active (B,) bool. Inactive rows write to / read
+    from the scratch page and their outputs are ignored by the host.
+    With seeds/temps/top_ks/top_ps (B,) arrays the next token is drawn
+    per row on its (seed, position=lengths+1) stream (temperature 0 =
+    exact greedy); without them it is the argmax (PR 8 behavior).
+    Returns (next_tokens (B,), k_pages, v_pages); with_stats=True
+    (the MXNET_NUMERICS_DECODE_GUARD path) appends a scalar count of
+    ACTIVE rows whose logits hold any NaN/Inf — computed inside the
+    jit, so the guard adds zero host syncs to the step.
+    """
+    logits, k_pages, v_pages = decode_logits(
+        params, tokens, k_pages, v_pages, page_table, lengths, active,
+        cfg=cfg, attn=attn)
+    if seeds is None:
+        next_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    else:
+        next_tokens = jax.vmap(
+            lambda lg, sd, p, tm, tk, tp: _sampling.sample_token(
+                lg, sd, p, tm, tk, tp))(
+            logits, seeds, lengths + 1, temps, top_ks, top_ps)
     if with_stats:
         bad_rows = jnp.any(~jnp.isfinite(logits), axis=-1)
         nonfinite = jnp.sum(

@@ -1,0 +1,208 @@
+"""Prompt-prefix radix cache: share full KV pages across requests.
+
+Chat/agent traffic repeats prompt prefixes (system preambles, few-shot
+headers, conversation history) — and a KV page's contents are a pure
+function of the token prefix from position 0 (position embeddings
+included), so a page prefilled for one sequence is EXACT for any other
+sequence whose prompt starts with the same tokens. The COW fork
+machinery in `blocks.py` already supports sharing (refcounts,
+make_writable); this module is the missing index (ROADMAP item 1): a
+radix tree over page-aligned token-id runs mapping prompt prefixes to
+live page ids.
+
+Granularity is the PAGE: only full pages are cached (a partial page
+would be written by the owner's decode steps), keyed by their P-token
+tuple, with radix edges holding runs of >= 1 pages. The cache holds
+its OWN allocator reference on every cached page, so pages outlive
+the sequences that prefilled them ("recently finished" sharing) —
+admission hits `ref()` the matched pages for the new sequence exactly
+like a `fork`.
+
+Eviction is LRU over leaf runs, clocked by a monotonic counter (never
+wall time — MX005), and only under real pool pressure: the scheduler
+evicts cached-but-unreferenced pages BEFORE preempting live
+sequences, so the cache can never cause a preemption that would not
+have happened without it.
+
+Thread-safety: one lock around the tree. Matching/insertion happen on
+the scheduler thread; `stats()` may be called from any thread (the
+decodingStats snapshot path).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+
+
+class _Node:
+    """One radix edge: a run of >= 1 full pages. `tokens` is the run's
+    token tuple (len == len(pages) * page_size); children are keyed by
+    the first page-tuple of the child's run."""
+
+    __slots__ = ("tokens", "pages", "children", "stamp")
+
+    def __init__(self, tokens, pages, stamp):
+        self.tokens = tuple(tokens)
+        self.pages = list(pages)
+        self.children = {}
+        self.stamp = stamp
+
+
+class PrefixCache:
+    """Radix index over cached prompt pages (see module docstring)."""
+
+    def __init__(self, allocator):
+        self.allocator = allocator
+        self.page_size = allocator.page_size
+        self._lock = threading.Lock()
+        self._root = _Node((), (), 0)
+        self._clock = itertools.count(1)   # LRU clock: counter, not time
+        self.hits = 0
+        self.misses = 0
+        self.pages_reused = 0
+        self.evictions = 0
+        self._cached_pages = 0
+
+    # ------------------------------------------------------------ match
+    def match(self, tokens, max_pages):
+        """Longest cached page-aligned prefix of `tokens`, capped at
+        `max_pages` pages. Returns (pages, n_tokens); the matched
+        pages are already `ref()`ed for the caller (its own share, to
+        be freed with the rest of its table). Callers cap max_pages
+        below the full prompt so at least one tail token is always
+        prefilled — which also keeps every cached page out of any
+        sequence's write range."""
+        p = self.page_size
+        t = tuple(int(x) for x in tokens)
+        out = []
+        with self._lock:
+            node = self._root
+            i = 0
+            while len(out) < max_pages and i + p <= len(t):
+                child = node.children.get(t[i:i + p])
+                if child is None:
+                    break
+                child.stamp = next(self._clock)
+                run_pages = len(child.pages)
+                took = 0
+                for j in range(run_pages):
+                    if (len(out) >= max_pages or i + p > len(t)
+                            or child.tokens[j * p:(j + 1) * p]
+                            != t[i:i + p]):
+                        break
+                    out.append(child.pages[j])
+                    i += p
+                    took += 1
+                if took < run_pages:
+                    break
+                node = child
+            if out:
+                self.allocator.ref(out)
+                self.hits += 1
+                self.pages_reused += len(out)
+            else:
+                self.misses += 1
+        return out, i
+
+    # ----------------------------------------------------------- insert
+    def insert(self, tokens, pages):
+        """Cache `pages` (full pages only) as the prefix `tokens`
+        (len(tokens) == len(pages) * page_size). Newly-cached pages
+        get one allocator ref owned by the cache; runs that already
+        exist keep their existing pages (maximizing sharing) and just
+        refresh their LRU stamp."""
+        p = self.page_size
+        t = tuple(int(x) for x in tokens)
+        n = len(pages)
+        if n == 0:
+            return
+        if len(t) != n * p:
+            raise ValueError(
+                f"insert needs page-aligned tokens: {len(t)} tokens "
+                f"for {n} pages of {p}")
+        with self._lock:
+            node = self._root
+            i = 0
+            while i < n * p:
+                key = t[i:i + p]
+                child = node.children.get(key)
+                if child is None:
+                    new_pages = pages[i // p:]
+                    self.allocator.ref(new_pages)
+                    self._cached_pages += len(new_pages)
+                    node.children[key] = _Node(
+                        t[i:], new_pages, next(self._clock))
+                    return
+                child.stamp = next(self._clock)
+                run_pages = len(child.pages)
+                m = 0
+                while (m < run_pages and i + (m + 1) * p <= n * p
+                       and child.tokens[m * p:(m + 1) * p]
+                       == t[i + m * p:i + (m + 1) * p]):
+                    m += 1
+                if m == run_pages:
+                    node = child
+                    i += m * p
+                    continue
+                # diverged (or ran out of input) inside the run: split
+                # the child at m pages (m >= 1: the key matched)
+                top = _Node(child.tokens[:m * p], child.pages[:m],
+                            child.stamp)
+                child.tokens = child.tokens[m * p:]
+                child.pages = child.pages[m:]
+                top.children[child.tokens[:p]] = child
+                node.children[key] = top
+                node = top
+                i += m * p
+        # loop exits when the whole prefix already exists — done
+
+    # --------------------------------------------------------- eviction
+    def evict_lru(self):
+        """Drop the least-recently-used LEAF run, releasing the
+        cache's refs on its pages (pages still shared by live
+        sequences stay allocated until those sequences finish).
+        Returns the number of pages released, 0 when the cache is
+        empty."""
+        with self._lock:
+            parent, key, leaf = None, None, None
+            stack = [self._root]
+            while stack:
+                node = stack.pop()
+                for ckey, child in node.children.items():
+                    if child.children:
+                        stack.append(child)
+                    elif leaf is None or child.stamp < leaf.stamp:
+                        parent, key, leaf = node, ckey, child
+            if leaf is None:
+                return 0
+            del parent.children[key]
+            pages = leaf.pages
+            self._cached_pages -= len(pages)
+            self.evictions += len(pages)
+            self.allocator.free(pages)
+            return len(pages)
+
+    def release_all(self):
+        """Drop every cached run (model close/flush)."""
+        while self.evict_lru():
+            pass
+        with self._lock:
+            self.evictions = 0  # shutdown flush is not pool pressure
+
+    # ------------------------------------------------------------ stats
+    @property
+    def cached_pages(self):
+        with self._lock:
+            return self._cached_pages
+
+    def stats(self):
+        with self._lock:
+            return {
+                "prefix_hits": self.hits,
+                "prefix_misses": self.misses,
+                "prefix_hit_rate": round(
+                    self.hits / max(1, self.hits + self.misses), 4),
+                "prefix_pages_reused": self.pages_reused,
+                "prefix_evictions": self.evictions,
+                "prefix_cached_pages": self._cached_pages,
+            }

@@ -19,12 +19,27 @@ step the scheduler
 Preemption drops a sequence's pages but keeps its token history; on
 readmission the scheduler re-prefills prompt + generated-so-far and
 the continuation is bit-identical to the uninterrupted run (the
-XLA-level prefix stability tests/test_decoding.py pins).
+XLA-level prefix stability tests/test_decoding.py pins) — including
+sampled runs, whose randomness is a pure function of (request seed,
+position) and so replays exactly.
+
+Two work-avoidance layers ride the same loop (ROADMAP item 1):
+
+  * a `PrefixCache` (prefix.py) lets admission map full prompt pages
+    already prefilled by live or recently-finished sequences instead
+    of recomputing them — only the tail past the cached prefix is
+    prefilled. Cached-but-unreferenced pages are evicted LRU under
+    pool pressure BEFORE any live sequence is preempted.
+  * with a draft model loaded, `_step` runs the engine's speculative
+    propose+verify pair and can emit up to spec_k+1 tokens per target
+    step (speculative.py proves output equivalence).
 
 Tokens reach callers through `DecodeFuture`: `result()` is the full
-generated list (the serving Future contract), `stream()` yields tokens
-as steps complete — cancellation-free backpressure is the consumer
-just not reading; the queue is per-request and bounded by max_tokens.
+generated list (the serving Future contract), `stream()` returns a
+`TokenStream` iterating tokens as steps complete. The stream OWNS the
+request: closing it (context-manager exit, `close()`, or GC) cancels
+an unfinished request so its pages return to the pool instead of
+decoding on to max_tokens for a reader that left.
 """
 from __future__ import annotations
 
@@ -42,6 +57,8 @@ from ..telemetry import trace as _trace
 from . import config as _cfg
 from .blocks import SCRATCH_PAGE, PagePoolExhausted, pages_needed
 from .engine import DecodeEngine
+from .prefix import PrefixCache
+from .sampling import SamplingParams
 from .stats import DecodeStats
 
 _DONE = object()
@@ -52,10 +69,15 @@ class DecodeFuture:
 
     `result(timeout)` blocks for the COMPLETE generated token list
     (EOS excluded) or raises the request's failure. `stream(timeout)`
-    iterates tokens as the scheduler emits them — the first token
-    arrives right after prefill, the rest one per decode step — and
-    raises the failure mid-iteration if one lands. `finish_reason` is
-    "eos" | "max_tokens" | "length" after completion.
+    returns a TokenStream iterating tokens as the scheduler emits
+    them — the first token arrives right after prefill — and raises
+    the failure mid-iteration if one lands. `finish_reason` is
+    "eos" | "max_tokens" | "length" | "cancelled" after completion.
+
+    `cancel()` asks the scheduler to stop the request at its next
+    sweep: the future resolves with reason "cancelled" holding the
+    tokens generated so far, and the sequence's pages go back to the
+    pool. No-op once done.
     """
 
     def __init__(self, trace_id=None):
@@ -63,6 +85,7 @@ class DecodeFuture:
         self.finish_reason = None
         self._q = queue.Queue()
         self._done = threading.Event()
+        self._cancel = threading.Event()
         self._tokens = None
         self._exc = None
 
@@ -97,26 +120,75 @@ class DecodeFuture:
             raise TimeoutError("decode request still running")
         return self._exc
 
+    def cancel(self):
+        """Request cancellation; returns True if the request was still
+        running (the scheduler will resolve it with reason
+        "cancelled"), False if it had already finished."""
+        if self._done.is_set():
+            return False
+        self._cancel.set()
+        return True
+
     def stream(self, timeout=None):
-        """Yield generated tokens as they are produced."""
-        while True:
-            item = self._q.get(timeout=timeout)
-            if item is _DONE:
-                return
-            if isinstance(item, BaseException):
-                raise item
-            yield item
+        """A TokenStream over generated tokens (see class docstring:
+        the stream owns the request — close it to cancel)."""
+        return TokenStream(self, timeout=timeout)
+
+
+class TokenStream:
+    """Iterator over one request's tokens that OWNS the request.
+
+    Abandoning a stream used to leak the whole tail of the request:
+    the scheduler kept decoding to max_new_tokens, holding pages and a
+    batch row for a reader that left. TokenStream closes that hole —
+    `close()`, `with`-exit, and garbage collection all cancel the
+    underlying request if it has not finished. Iterating to the end
+    makes close a no-op.
+    """
+
+    def __init__(self, future, timeout=None):
+        self.future = future
+        self._timeout = timeout
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self.future._q.get(timeout=self._timeout)
+        if item is _DONE:
+            raise StopIteration
+        if isinstance(item, BaseException):
+            raise item
+        return item
+
+    def close(self):
+        """Cancel the request unless it already finished."""
+        self.future.cancel()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class _Sequence:
     """Scheduler-internal state of one in-flight request."""
 
     __slots__ = ("prompt", "max_new", "priority", "deadline", "future",
-                 "trace_id", "order", "generated", "table", "length",
-                 "last_token", "preempted", "t_submit_pc")
+                 "trace_id", "order", "sampling", "use_draft",
+                 "generated", "table", "length", "last_token",
+                 "preempted", "t_submit_pc")
 
     def __init__(self, prompt, max_new, priority, deadline, future,
-                 trace_id, order):
+                 trace_id, order, sampling, use_draft):
         self.prompt = list(prompt)
         self.max_new = max_new
         self.priority = priority
@@ -124,6 +196,8 @@ class _Sequence:
         self.future = future
         self.trace_id = trace_id
         self.order = order             # admission tiebreak (FIFO)
+        self.sampling = sampling       # SamplingParams (resolved)
+        self.use_draft = use_draft     # speculative opt-in for this row
         self.generated = []
         self.table = None              # page ids while active
         self.length = 0                # tokens materialized in cache
@@ -153,6 +227,9 @@ class ContinuousScheduler:
             else _cfg.max_tokens()
         self.eos_id = eos_id if eos_id is not None \
             else engine.cfg.eos_id
+        # prompt-prefix page cache: admission-side work avoidance
+        self.cache = PrefixCache(engine.allocator) \
+            if engine.prefix_cache_enabled else None
         self._cond = threading.Condition()
         self._waiting = []
         self._rows = [None] * engine.max_batch
@@ -175,7 +252,7 @@ class ContinuousScheduler:
                     sum(1 for s in self._rows if s is not None))
 
     def submit(self, prompt, max_new_tokens=None, priority=0,
-               deadline_ms=None):
+               deadline_ms=None, sampling=None, seed=None, draft=None):
         """Enqueue one autoregressive request; returns a DecodeFuture.
 
         `priority`: higher values survive page-pool pressure longer
@@ -183,8 +260,23 @@ class ContinuousScheduler:
         `deadline_ms` is end-to-end and checked EVERY step, not only
         at admission — a mid-generation miss resolves the future with
         DeadlineExceededError and frees the sequence's pages.
+        `sampling`/`seed`: a SamplingParams (or None for the env
+        defaults; `seed` overrides just the stream seed). Greedy
+        (temperature<=0) needs no seed. `draft`: per-request
+        speculative opt-in/out; defaults to "on when a draft model is
+        loaded".
         """
         prompt = [int(t) for t in prompt]
+        sp = SamplingParams.resolve(sampling, seed)
+        sp.validate(self.engine.cfg.vocab)
+        if draft is None:
+            use_draft = self.engine.spec_enabled
+        else:
+            use_draft = bool(draft)
+            if use_draft and not self.engine.spec_enabled:
+                raise ServingError(
+                    "speculative decoding requested but no draft "
+                    "model is loaded")
         if not prompt:
             raise ServingError("empty prompt")
         if any(t < 0 or t >= self.engine.cfg.vocab for t in prompt):
@@ -212,7 +304,8 @@ class ContinuousScheduler:
                         f"decode queue full ({self.queue_cap}); "
                         "retry with backoff")
                 seq = _Sequence(prompt, max_new, int(priority),
-                                deadline, fut, tid, next(self._order))
+                                deadline, fut, tid, next(self._order),
+                                sp, use_draft)
                 self._waiting.append(seq)
                 self._cond.notify()
         self.stats.note_submitted()
@@ -227,6 +320,10 @@ class ContinuousScheduler:
             self._cond.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=timeout)
+        if self.cache is not None:
+            # the loop is down: flush the cache's page refs so the
+            # pool drains to empty (pages_in_use == 0 after close)
+            self.cache.release_all()
 
     # ---------------------------------------------------- loop helpers
     def _active(self):
@@ -305,6 +402,31 @@ class ContinuousScheduler:
             self._resolve(s, exc=DeadlineExceededError(
                 f"deadline passed after {len(s.generated)} tokens"))
 
+    def _check_cancelled(self):
+        """Resolve requests whose future (or owning TokenStream) was
+        cancelled: queued ones never admit, active ones free their
+        pages now instead of decoding to max_tokens."""
+        with self._cond:
+            doomed = [s for s in self._waiting
+                      if s.future._cancel.is_set()]
+            for s in doomed:
+                self._waiting.remove(s)
+        for s in self._active():
+            if s.future._cancel.is_set():
+                doomed.append(s)
+        for s in doomed:
+            self.stats.note_cancelled()
+            self._resolve(s, reason="cancelled")
+
+    def _free_one_page(self, requester):
+        """Make at least one page reclaimable, cheapest source first:
+        evict a cached-but-idle prefix run before preempting any live
+        sequence (the cache must never cause a preemption). Returns
+        False when neither source can yield."""
+        if self.cache is not None and self.cache.evict_lru():
+            return True
+        return self._reclaim_one(requester) is not None
+
     def _handle_token(self, seq, tok):
         """Post-step bookkeeping for one live row's emitted token."""
         if tok == self.eos_id:
@@ -323,8 +445,20 @@ class ContinuousScheduler:
     def _admit(self):
         """Fill free batch rows from the waiting queue in (priority,
         FIFO) order. Admission prefers free pages but will preempt
-        strictly-lower-priority active sequences to make room."""
+        strictly-lower-priority active sequences to make room.
+
+        With the prefix cache on, admission first maps every full
+        prompt page already cached for this token prefix (allocator
+        `ref`, the fork path — zero compute) and prefills ONLY the
+        tail. The match is capped one page short of the prompt so at
+        least one tail token always runs (the prefill program needs a
+        position to emit from) — which also keeps cached pages out of
+        every write range. After prefill the sequence's own full
+        prompt pages are inserted, making them reusable by the next
+        request while this one is still decoding.
+        """
         alloc = self.engine.allocator
+        P = self.engine.page_size
         while None in self._rows:
             with self._cond:
                 if not self._waiting:
@@ -333,31 +467,57 @@ class ContinuousScheduler:
                           key=lambda s: (-s.priority, s.order))
                 self._waiting.remove(seq)
             tokens = seq.context_tokens()
-            need = pages_needed(len(tokens), self.engine.page_size)
+            need_total = pages_needed(len(tokens), P)
+            matched, start = [], 0
+            if self.cache is not None:
+                matched, start = self.cache.match(
+                    tokens, (len(tokens) - 1) // P)
+                self.stats.note_prefix_reuse(len(matched))
+            need = need_total - len(matched)
+            ok = True
             while alloc.free_pages() < need:
-                if self._reclaim_one(seq) is None:
-                    # nothing below this priority to evict: requeue
+                if not self._free_one_page(seq):
+                    # nothing reclaimable below this priority: requeue
                     # and stop admitting (pages may free up later)
-                    with self._cond:
-                        self._waiting.append(seq)
-                    return
-            seq.table = alloc.alloc(need)
+                    ok = False
+                    break
+            if not ok:
+                if matched:
+                    alloc.free(matched)
+                with self._cond:
+                    self._waiting.append(seq)
+                return
+            seq.table = matched + alloc.alloc(need)
             with self._cond:
                 row = self._rows.index(None)
                 self._rows[row] = seq
             t0 = _trace.now()
-            first = self.engine.prefill(tokens, seq.table)
+            first = self.engine.prefill(
+                tokens, seq.table, start=start,
+                seed=seq.sampling.seed,
+                temperature=seq.sampling.temperature,
+                top_k=seq.sampling.top_k, top_p=seq.sampling.top_p)
             dt = _trace.now() - t0
-            self.stats.note_prefill(len(tokens), dt,
+            self.stats.note_prefill(len(tokens) - start, dt,
                                     readmission=seq.preempted)
             _trace.record_span(
                 "decoding.prefill", seq.trace_id, t0, t0 + dt,
                 {"model": self.key, "tokens": len(tokens),
-                 "pages": need, "readmission": seq.preempted})
+                 "cached_tokens": start, "pages": need_total,
+                 "pages_reused": len(matched),
+                 "readmission": seq.preempted})
             seq.length = len(tokens)
+            if self.cache is not None:
+                # publish this prompt's full pages (existing runs keep
+                # their pages; only the new suffix takes cache refs)
+                n_full = len(seq.prompt) // P
+                if n_full:
+                    self.cache.insert(seq.prompt[:n_full * P],
+                                      seq.table[:n_full])
             if seq.preempted:
-                # the re-prefill's argmax reproduces the token already
-                # emitted (prefix stability); restore, don't re-emit
+                # the re-prefill reproduces the token already emitted
+                # (prefix stability — sampled streams are (seed,
+                # position)-pure); restore, don't re-emit
                 seq.preempted = False
                 seq.last_token = seq.generated[-1]
             else:
@@ -365,33 +525,54 @@ class ContinuousScheduler:
 
     # ------------------------------------------------------------ growth
     def _grow(self):
-        """Before each step, make every live row's write position
-        backed by an exclusively-owned page: allocate across page
-        boundaries (preempting under pressure) and break COW aliases
-        on the tail page."""
+        """Before each step, make every live row's WHOLE write range
+        backed by exclusively-owned pages: positions length..length+K
+        (K = spec_k in speculative mode, else 0). Allocates across
+        page boundaries (evicting cached pages, then preempting,
+        under pressure) and breaks COW aliases on every page the step
+        may write — rejected speculative entries land in owned pages,
+        so rollback-by-truncation never corrupts a shared page."""
         alloc = self.engine.allocator
+        P = self.engine.page_size
+        k = self.engine.spec_k if self.engine.spec_enabled else 0
         for seq in self._active():
             if seq.table is None:
                 continue
-            idx = seq.length // self.engine.page_size
-            if idx >= len(seq.table):
-                while True:
+            # pages covering the step's write positions (clamped to
+            # capacity: the host stops at max_context before any
+            # clamped write could be read back)
+            cover = min(seq.length + k + 1, self.engine.max_context)
+            need = pages_needed(cover, P)
+            while seq.table is not None and len(seq.table) < need:
+                try:
+                    seq.table.extend(alloc.alloc(1))
+                except PagePoolExhausted:
+                    if self.cache is not None and self.cache.evict_lru():
+                        continue
+                    victim = self._reclaim_one(None)
+                    if victim is None:
+                        break
+            if seq.table is None or len(seq.table) < need:
+                continue    # preempted itself; back in the queue
+            first = seq.length // P
+            last = min((cover - 1) // P, len(seq.table) - 1)
+            for idx in range(first, last + 1):
+                page, copy_from = None, None
+                while seq.table is not None:
                     try:
-                        seq.table.extend(alloc.alloc(1))
+                        page, copy_from = alloc.make_writable(
+                            seq.table, idx)
                         break
                     except PagePoolExhausted:
-                        victim = self._reclaim_one(None)
-                        if victim is None or victim is seq:
-                            break
-                if seq.table is None or idx >= len(seq.table):
-                    continue    # preempted itself; back in the queue
-            try:
-                page, copy_from = alloc.make_writable(seq.table, idx)
-            except PagePoolExhausted:
-                self._preempt(seq)
-                continue
-            if copy_from is not None:
-                self.engine.copy_page(copy_from, page)
+                        # COW needs one free page: cheapest first
+                        if (self.cache is not None
+                                and self.cache.evict_lru()):
+                            continue
+                        self._preempt(seq)
+                if seq.table is None or page is None:
+                    break
+                if copy_from is not None:
+                    self.engine.copy_page(copy_from, page)
 
     # -------------------------------------------------------------- step
     def _step(self):
@@ -401,29 +582,63 @@ class ContinuousScheduler:
         if not live:
             return
         b = engine.max_batch
-        span = max(pages_needed(s.length + 1, engine.page_size)
-                   for _, s in live)
+        spec = engine.spec_enabled
+        k = engine.spec_k if spec else 0
+        # _grow already sized every table for the full write range;
+        # span over table lengths keeps the bucket consistent with it
+        span = max(len(s.table) for _, s in live)
         bucket = pick_bucket(span, engine.page_buckets)
         tokens = np.zeros((b,), np.int32)
         table = np.full((b, bucket), SCRATCH_PAGE, np.int32)
         lengths = np.zeros((b,), np.int32)
         active = np.zeros((b,), bool)
+        use_draft = np.zeros((b,), bool)
+        seeds = np.zeros((b,), np.uint32)
+        temps = np.zeros((b,), np.float32)
+        top_ks = np.zeros((b,), np.int32)
+        top_ps = np.ones((b,), np.float32)
         for row, s in live:
             tokens[row] = s.last_token
             table[row, :len(s.table)] = s.table
             lengths[row] = s.length
             active[row] = True
+            use_draft[row] = s.use_draft
+            seeds[row] = s.sampling.seed & 0xFFFFFFFF
+            temps[row] = s.sampling.temperature
+            top_ks[row] = s.sampling.top_k
+            top_ps[row] = s.sampling.top_p
         t0 = _trace.now()
-        out = engine.step(tokens, table, lengths, active)
+        if spec:
+            out, n_emit = engine.spec_step(
+                tokens, table, lengths, active, use_draft,
+                seeds, temps, top_ks, top_ps)
+        else:
+            out = engine.step(tokens, table, lengths, active,
+                              seeds, temps, top_ks, top_ps)
         dt = _trace.now() - t0
-        self.stats.note_step(len(live), dt)
+        emitted = 0
+        if spec:
+            for row, s in live:
+                n = int(n_emit[row])
+                if s.use_draft:
+                    self.stats.note_spec(k, n - 1)
+                for j in range(n):
+                    if s.table is None or s.future.done():
+                        break   # resolved mid-run (eos/max_tokens)
+                    s.length += 1
+                    emitted += 1
+                    self._handle_token(s, int(out[row, j]))
+        else:
+            for row, s in live:
+                s.length += 1
+                emitted += 1
+                self._handle_token(s, int(out[row]))
+        self.stats.note_step(emitted, dt)
         _trace.record_span(
             "decoding.step", None, t0, t0 + dt,
             {"trace_ids": tuple(s.trace_id for _, s in live),
-             "model": self.key, "live": len(live), "bucket": bucket})
-        for row, s in live:
-            s.length += 1
-            self._handle_token(s, int(out[row]))
+             "model": self.key, "live": len(live), "bucket": bucket,
+             "tokens": emitted})
         self.stats.note_pool()
         if engine._guard and self.stats.steps % 16 == 0:
             # interval drain of the logits guard (one fetch per 16
@@ -456,6 +671,7 @@ class ContinuousScheduler:
                 return
             try:
                 self._check_deadlines(time.monotonic())
+                self._check_cancelled()
                 self._admit()
                 self._grow()
                 self._step()
@@ -473,14 +689,34 @@ class DecodedModel:
     def __init__(self, name, version, params, cfg, *, max_batch=None,
                  page_size=None, num_pages=None, page_buckets=None,
                  kernel=None, ring_prefill=None, queue_cap=None,
-                 max_tokens=None, warmup=True):
+                 max_tokens=None, warmup=True, draft=None,
+                 draft_cfg=None, spec_k=None, prefix_cache=None):
         self.name = name
         self.version = int(version)
         self.cfg = cfg
+        # draft spec: a params dict (with draft_cfg), the string
+        # "self" (self-draft: the target drafts for itself — useful
+        # for tests/CI where acceptance is then ~1), or None to read
+        # MXNET_DECODE_SPEC_DRAFT
+        if draft is None and _cfg.spec_draft():
+            draft = _cfg.spec_draft()
+        draft_params = None
+        if isinstance(draft, str):
+            if draft == "self":
+                draft_params, draft_cfg = params, cfg
+            elif draft:
+                raise ServingError(
+                    f"unknown draft spec {draft!r} (expected 'self' "
+                    "or a params dict)")
+        elif draft is not None:
+            draft_params = draft
+            draft_cfg = draft_cfg if draft_cfg is not None else cfg
         self.engine = DecodeEngine(
             params, cfg, max_batch=max_batch, page_size=page_size,
             num_pages=num_pages, page_buckets=page_buckets,
-            kernel=kernel, ring_prefill=ring_prefill)
+            kernel=kernel, ring_prefill=ring_prefill,
+            draft_params=draft_params, draft_cfg=draft_cfg,
+            spec_k=spec_k, prefix_cache=prefix_cache)
         self.stats = DecodeStats(
             key=self.key, traces_fn=self.engine.traces,
             pool_fn=self.engine.pool_stats)
@@ -488,6 +724,8 @@ class DecodedModel:
             self.engine, self.stats, self.key, queue_cap=queue_cap,
             max_tokens=max_tokens)
         self.stats._depth_fn = self.scheduler.depth
+        if self.scheduler.cache is not None:
+            self.stats._prefix_fn = self.scheduler.cache.stats
         self._started = False
         if warmup:
             self.warmup()
@@ -509,24 +747,32 @@ class DecodedModel:
 
     # -------------------------------------------------------- data path
     def submit(self, prompt, max_new_tokens=None, priority=0,
-               deadline_ms=None):
+               deadline_ms=None, sampling=None, seed=None, draft=None):
         return self.scheduler.submit(prompt,
                                      max_new_tokens=max_new_tokens,
                                      priority=priority,
-                                     deadline_ms=deadline_ms)
+                                     deadline_ms=deadline_ms,
+                                     sampling=sampling, seed=seed,
+                                     draft=draft)
 
     def generate(self, prompt, max_new_tokens=None, priority=0,
-                 deadline_ms=None, timeout=None):
+                 deadline_ms=None, timeout=None, sampling=None,
+                 seed=None, draft=None):
         """Sync decode: the full generated token list."""
         return self.submit(prompt, max_new_tokens=max_new_tokens,
-                           priority=priority,
-                           deadline_ms=deadline_ms).result(timeout)
+                           priority=priority, deadline_ms=deadline_ms,
+                           sampling=sampling, seed=seed,
+                           draft=draft).result(timeout)
 
     def stream(self, prompt, max_new_tokens=None, priority=0,
-               deadline_ms=None, timeout=None):
-        """Streaming decode: yields tokens as steps complete."""
+               deadline_ms=None, timeout=None, sampling=None,
+               seed=None, draft=None):
+        """Streaming decode: a TokenStream yielding tokens as steps
+        complete. Close it (or exit its `with` block) to cancel an
+        unfinished request and free its pages."""
         fut = self.submit(prompt, max_new_tokens=max_new_tokens,
-                          priority=priority, deadline_ms=deadline_ms)
+                          priority=priority, deadline_ms=deadline_ms,
+                          sampling=sampling, seed=seed, draft=draft)
         return fut.stream(timeout=timeout)
 
     def close(self, drain=True, timeout=30):

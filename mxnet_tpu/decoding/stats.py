@@ -60,6 +60,13 @@ _NONFINITE = _treg.counter(
     "mxnet_tpu_decode_nonfinite_logits_total",
     "Active rows whose decode logits held NaN/Inf "
     "(MXNET_NUMERICS_DECODE_GUARD)")
+_PREFIX_PAGES = _treg.counter(
+    "mxnet_tpu_decode_prefix_pages_reused_total",
+    "Prompt KV pages mapped from the prefix cache instead of "
+    "prefilled (each one is page_size tokens of avoided compute)")
+_SPEC_TOKENS = _treg.counter(
+    "mxnet_tpu_decode_spec_tokens_total",
+    "Speculative decoding draft tokens (phase=proposed|accepted)")
 
 
 def _register(key, stats):
@@ -96,12 +103,13 @@ class DecodeStats:
     scheduler's (waiting, active) — all live at snapshot time."""
 
     def __init__(self, key=None, traces_fn=None, pool_fn=None,
-                 depth_fn=None):
+                 depth_fn=None, prefix_fn=None):
         self._key = key or ""
         self._lock = threading.Lock()
         self._traces_fn = traces_fn
         self._pool_fn = pool_fn
         self._depth_fn = depth_fn
+        self._prefix_fn = prefix_fn
         self.reset()
 
     def reset(self):
@@ -111,6 +119,9 @@ class DecodeStats:
             self.failed = 0
             self.rejected = 0
             self.expired = 0
+            self.cancelled = 0
+            self.spec_proposed = 0
+            self.spec_accepted = 0
             self.preemptions = 0
             self.readmissions = 0
             self.prefills = 0
@@ -138,6 +149,18 @@ class DecodeStats:
         with self._lock:
             self.expired += n
 
+    def note_cancelled(self, n=1):
+        with self._lock:
+            self.cancelled += n
+
+    def note_spec(self, proposed, accepted):
+        """One speculative step's draft accounting for one row."""
+        with self._lock:
+            self.spec_proposed += proposed
+            self.spec_accepted += accepted
+        _SPEC_TOKENS.inc(proposed, phase="proposed", model=self._key)
+        _SPEC_TOKENS.inc(accepted, phase="accepted", model=self._key)
+
     def note_failed(self, n=1):
         with self._lock:
             self.failed += n
@@ -145,6 +168,13 @@ class DecodeStats:
     def note_completed(self, n=1):
         with self._lock:
             self.completed += n
+
+    def note_prefix_reuse(self, pages):
+        """Prompt pages mapped from the prefix cache at admission
+        (the snapshot's hit/miss detail comes from prefix_fn; this
+        just feeds the native Prometheus counter)."""
+        if pages:
+            _PREFIX_PAGES.inc(pages, model=self._key)
 
     def note_prefill(self, tokens, seconds, readmission=False):
         with self._lock:
@@ -199,6 +229,7 @@ class DecodeStats:
     def snapshot(self):
         traces_now = self._traces_fn() if self._traces_fn else 0
         pool = self._pool_fn() if self._pool_fn else {}
+        prefix = self._prefix_fn() if self._prefix_fn else {}
         waiting, active = self._depth_fn() if self._depth_fn else (0, 0)
         with self._lock:
             lat = sorted(self._token_lat)
@@ -208,6 +239,15 @@ class DecodeStats:
                 "failed": self.failed,
                 "rejected": self.rejected,
                 "expired": self.expired,
+                "cancelled": self.cancelled,
+                "spec_proposed": self.spec_proposed,
+                "spec_accepted": self.spec_accepted,
+                "spec_acceptance_rate": round(
+                    self.spec_accepted / self.spec_proposed, 4)
+                if self.spec_proposed else 0.0,
+                "tokens_per_target_step": round(
+                    self.decode_tokens / self.steps, 3)
+                if self.steps else 0.0,
                 "preemptions": self.preemptions,
                 "readmissions": self.readmissions,
                 "prefills": self.prefills,
@@ -232,4 +272,5 @@ class DecodeStats:
                 "active": active,
             }
         out.update(pool)
+        out.update(prefix)
         return out

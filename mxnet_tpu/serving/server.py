@@ -200,30 +200,42 @@ class ModelServer:
 
     def submit_decode(self, name, prompt, version=None,
                       max_new_tokens=None, priority=0,
-                      deadline_ms=None):
+                      deadline_ms=None, sampling=None, seed=None,
+                      draft=None):
         """Async autoregressive decode: returns a DecodeFuture —
         `result()` for the full token list, `stream()` to iterate
         tokens as continuous-batching steps emit them. `deadline_ms`
-        is enforced EVERY decode step, not only at admission."""
+        is enforced EVERY decode step, not only at admission.
+        `sampling` is a decoding.SamplingParams (None = env-default
+        greedy); `seed` overrides just its stream seed; `draft`
+        opts this request in/out of speculative decoding (None =
+        on when the decoder has a draft model)."""
         return self._decoder(name, version).submit(
             prompt, max_new_tokens=max_new_tokens, priority=priority,
-            deadline_ms=deadline_ms)
+            deadline_ms=deadline_ms, sampling=sampling, seed=seed,
+            draft=draft)
 
     def generate(self, name, prompt, version=None, max_new_tokens=None,
-                 priority=0, deadline_ms=None, timeout=None):
+                 priority=0, deadline_ms=None, timeout=None,
+                 sampling=None, seed=None, draft=None):
         """Sync decode: the complete generated token list."""
         return self.submit_decode(
             name, prompt, version=version,
             max_new_tokens=max_new_tokens, priority=priority,
-            deadline_ms=deadline_ms).result(timeout)
+            deadline_ms=deadline_ms, sampling=sampling, seed=seed,
+            draft=draft).result(timeout)
 
     def stream(self, name, prompt, version=None, max_new_tokens=None,
-               priority=0, deadline_ms=None, timeout=None):
-        """Streaming decode: an iterator of tokens (per-step)."""
+               priority=0, deadline_ms=None, timeout=None,
+               sampling=None, seed=None, draft=None):
+        """Streaming decode: a TokenStream of per-step tokens; close
+        it (or exit its `with` block) to cancel the request and free
+        its KV pages early."""
         return self.submit_decode(
             name, prompt, version=version,
             max_new_tokens=max_new_tokens, priority=priority,
-            deadline_ms=deadline_ms).stream(timeout=timeout)
+            deadline_ms=deadline_ms, sampling=sampling, seed=seed,
+            draft=draft).stream(timeout=timeout)
 
     # ---------------------------------------------------------- worker
     def _worker_loop(self, lane):

@@ -345,6 +345,55 @@ register_env(
     "serving tier).",
 )
 register_env(
+    "MXNET_DECODE_PREFIX_CACHE", bool, True,
+    "decoding: cache full prompt-prefix KV pages in a radix index "
+    "and map them into new sequences via the refcount/COW fork path "
+    "instead of re-prefilling (only the tail past the cached prefix "
+    "is computed). Cached-but-idle pages are evicted LRU under pool "
+    "pressure BEFORE any live sequence is preempted. 0 disables.",
+)
+register_env(
+    "MXNET_DECODE_SPEC_K", int, 4,
+    "decoding: draft tokens proposed per speculative step. The "
+    "target verifies all K+1 positions in one fixed-shape multi-"
+    "query pass and emits 1..K+1 tokens per step; output is "
+    "distribution-identical to target-only decoding (exactly equal "
+    "under greedy). Only active when a draft model is loaded.",
+)
+register_env(
+    "MXNET_DECODE_SPEC_DRAFT", str, "",
+    "decoding: default draft-model spec for load_decoder/"
+    "DecodedModel. 'self' = the target drafts for itself (testing/"
+    "CI: acceptance ~1). Empty = no draft; speculative decoding is "
+    "then off unless a draft params dict is passed explicitly.",
+)
+register_env(
+    "MXNET_DECODE_SAMPLING_TEMPERATURE", float, 0.0,
+    "decoding: default sampling temperature for requests that do "
+    "not pass SamplingParams. <= 0 is greedy argmax (deterministic, "
+    "seed-independent — the historical decode-tier behavior).",
+)
+register_env(
+    "MXNET_DECODE_SAMPLING_TOP_K", int, 0,
+    "decoding: default top-k cutoff for sampled requests (keep the "
+    "k highest-probability tokens before sampling; ties at the "
+    "k-th value are kept). 0 disables the cutoff.",
+)
+register_env(
+    "MXNET_DECODE_SAMPLING_TOP_P", float, 1.0,
+    "decoding: default nucleus (top-p) mass for sampled requests — "
+    "keep the smallest prefix of probability-sorted tokens whose "
+    "mass reaches p (at least one token always survives). 1.0 "
+    "disables the cutoff.",
+)
+register_env(
+    "MXNET_DECODE_SAMPLING_SEED", int, 0,
+    "decoding: default per-request sampling seed. All decode-tier "
+    "randomness is a counter-based stream keyed by (seed, position, "
+    "salt), so a request's sampled output is bit-identical across "
+    "preemption/readmission and across runs.",
+)
+register_env(
     "MXNET_SHARD_KV_MESH", bool, True,
     "sharding: kvstore('tpu') barrier runs as a mesh jit (1-D "
     "all-device mesh, in/out_shardings, no pmap). 0 restores the "

@@ -25,7 +25,13 @@ def _fresh(monkeypatch):
     for var in ("MXNET_DECODE_PAGE_SIZE", "MXNET_DECODE_PAGES",
                 "MXNET_DECODE_MAX_BATCH", "MXNET_DECODE_PAGE_BUCKETS",
                 "MXNET_DECODE_KERNEL", "MXNET_DECODE_RING_PREFILL",
-                "MXNET_DECODE_MAX_TOKENS", "MXNET_DECODE_QUEUE_CAP"):
+                "MXNET_DECODE_MAX_TOKENS", "MXNET_DECODE_QUEUE_CAP",
+                "MXNET_DECODE_PREFIX_CACHE", "MXNET_DECODE_SPEC_K",
+                "MXNET_DECODE_SPEC_DRAFT",
+                "MXNET_DECODE_SAMPLING_TEMPERATURE",
+                "MXNET_DECODE_SAMPLING_TOP_K",
+                "MXNET_DECODE_SAMPLING_TOP_P",
+                "MXNET_DECODE_SAMPLING_SEED"):
         monkeypatch.delenv(var, raising=False)
     dec.stats._registry.clear()
     yield
@@ -205,11 +211,14 @@ def test_get_kernel():
 def test_single_request_parity_and_trace_grid():
     m = _model()
     try:
-        # the warmup grid: one prefill per length bucket, one decode
-        # per pages bucket, plus the page-copy program
+        # the warmup grid: one prefill + one tail-prefill (prefix-
+        # cache hits) per length bucket, one decode per pages bucket,
+        # plus the page-copy program
         counts = m.engine.trace_counts()
         assert counts == {"copy_page": 1, "prefill@4": 1,
                           "prefill@8": 1, "prefill@16": 1,
+                          "prefill_tail@4": 1, "prefill_tail@8": 1,
+                          "prefill_tail@16": 1,
                           "decode@1": 1, "decode@2": 1, "decode@4": 1}
         floor = m.engine.traces()
         for prompt in ([5, 6, 7], [3], list(range(2, 13))):
@@ -239,7 +248,9 @@ def test_continuous_batching_parity_concurrent():
             assert f.result(120) == _ref_greedy(p, n)
         assert m.engine.traces() == floor
         snap = m.stats.snapshot()
-        assert snap["completed"] == 12 and snap["pages_free"] == 63
+        assert snap["completed"] == 12
+        # every non-free page is held by the prefix cache, not leaked
+        assert snap["pages_free"] == 63 - snap["prefix_cached_pages"]
     finally:
         m.close()
 
@@ -263,6 +274,9 @@ def test_preempt_then_readmit_bit_identical():
         assert snap["preemptions"] > 0
         assert snap["readmissions"] == snap["preemptions"]
         assert m.engine.traces() == floor  # readmission retraces nothing
+        # only prefix-cached pages may remain; flushing the cache must
+        # drain the pool to empty (nothing leaked by preempt/readmit)
+        m.scheduler.cache.release_all()
         assert m.engine.allocator.stats()["pages_in_use"] == 0
         m.engine.allocator.check()
     finally:
@@ -389,6 +403,7 @@ def test_randomized_soak():
             except serving.DeadlineExceededError:
                 assert dl is not None
         assert m.engine.traces() == floor
+        m.scheduler.cache.release_all()
         assert m.engine.allocator.stats()["pages_in_use"] == 0
         m.engine.allocator.check()
     finally:
@@ -429,13 +444,18 @@ def test_decoding_stats_view_shape_pinned():
         snap = dec.decoding_stats()[m.key]
         assert sorted(snap) == sorted((
             "submitted", "completed", "failed", "rejected", "expired",
-            "preemptions", "readmissions", "prefills",
+            "cancelled", "preemptions", "readmissions", "prefills",
             "prefill_tokens", "decode_tokens", "steps",
+            "spec_proposed", "spec_accepted", "spec_acceptance_rate",
+            "tokens_per_target_step",
             "nonfinite_logit_steps", "nonfinite_logits",
             "prefill_tokens_per_s", "decode_tokens_per_s",
             "p50_token_ms", "p95_token_ms", "p99_token_ms",
             "traces_since_warmup", "waiting", "active", "pages_total",
-            "pages_free", "kv_occupancy", "free_low_watermark"))
+            "pages_free", "kv_occupancy", "free_low_watermark",
+            "pages_allocated", "prefix_hits", "prefix_misses",
+            "prefix_hit_rate", "prefix_pages_reused",
+            "prefix_evictions", "prefix_cached_pages"))
         assert snap["decode_tokens"] == 2 and snap["prefills"] == 1
         assert snap["prefill_tokens"] == 3
         assert snap["traces_since_warmup"] == 0

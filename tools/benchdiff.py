@@ -37,6 +37,13 @@ LOWER_IS_BETTER = (
     "restore_traces", "restore_compiles",
 )
 
+# secondary per-record keys where BIGGER is better (work avoided per
+# token in the decode tier: prefix-cache reuse and speculative yield)
+HIGHER_IS_BETTER = (
+    "prefix_hit_rate", "prefix_pages_reused",
+    "spec_tokens_per_target_step", "spec_acceptance_rate",
+)
+
 
 def _records_from_text(text):
     out = {}
@@ -93,6 +100,9 @@ def diff_records(old, new, threshold):
         for key in LOWER_IS_BETTER:
             if key in o and key in n:
                 checks.append((key, o[key], n[key], False, key))
+        for key in HIGHER_IS_BETTER:
+            if key in o and key in n:
+                checks.append((key, o[key], n[key], True, key))
         for key, ov, nv, higher_better, unit in checks:
             r = _ratio(ov, nv)
             if r is None:

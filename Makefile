@@ -12,6 +12,7 @@ XLA8    := XLA_FLAGS=--xla_force_host_platform_device_count=8
 .PHONY: all test nightly examples lint lint-check libs predict perl \
 	docs dryrun cache-check serving-check sync-check data-check \
 	passes-check telemetry-check decode-check race-check \
+	fusion-check \
 	shard-check profiling-check numerics-check coldstart-check \
 	bench-diff clean
 
@@ -108,6 +109,16 @@ telemetry-check:
 # KV-memory bench gate
 decode-check:
 	$(CPUENV) bash ci/check_decode.sh
+
+# generated-kernel codegen gate: test suite + runtime gates (every
+# __fusion_group__ lowers with an interpret-mode parity proof or a
+# counted fallback reason — no silent drops; fused vs fallback
+# programs key separately in the exec cache; kind="kernel"
+# calibration records back the tuner's fuse-vs-fallback call; the
+# merged ragged step drops the tail-prefill programs from the warmup
+# grid at token parity with zero retraces)
+fusion-check:
+	$(CPUENV) bash ci/check_fusion.sh
 
 # concurrency race gate: MX006-MX008 clean tree with no baseline, a
 # seeded lock-order inversion caught both statically (MX007) and by

@@ -538,6 +538,153 @@ def _passes_bench(platform):
     })
 
 
+def _fusion_bench(platform):
+    """BENCH_MODE=fusion: generated-kernel A/B (passes.pallas_codegen).
+
+    A network exercising all three codegen templates — a
+    scale+bias+activation group, a pure elementwise chain, and a
+    chain absorbed into a trailing full reduction — bound twice:
+    MXNET_FUSION_CODEGEN=0 (per-op lax fallback) vs =1 (generated
+    Pallas kernels; interpret-forced on CPU, where the A/B proves
+    mechanism, not speed — the compiled-kernel numbers come from the
+    TPU capture). One record: groups seen/lowered/fallback with
+    reasons, build-time parity totals, bind + steady-step time per
+    arm, output parity — plus the merged-step decode A/B
+    (MXNET_DECODE_MERGED_STEP): ragged prefill+decode tokens/s and
+    warmup trace-grid size vs the split tail-prefill engine."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import exec_cache, passes
+
+    batch, hidden, iters = 32, 256, 30
+
+    def build():
+        d = mx.sym.Variable("data")
+        g = mx.sym.Variable("gain")
+        bb = mx.sym.Variable("bias")
+        fc = mx.sym.FullyConnected(d, num_hidden=hidden, name="fc1")
+        # scale+bias+activation template bait
+        h = mx.sym.elemwise_mul(fc, g)
+        h = mx.sym.elemwise_add(h, bb)
+        h = mx.sym.Activation(h, act_type="tanh")
+        fc2 = mx.sym.FullyConnected(h, num_hidden=hidden, name="fc2")
+        # elementwise chain ending in a full reduce (absorbed)
+        t = mx.sym.sigmoid(fc2)
+        t = mx.sym.square(t)
+        t = t * 0.5
+        return mx.sym.sum(t)
+
+    ctx = mx.cpu() if platform == "cpu" else mx.tpu()
+    rs = np.random.RandomState(0)
+    x = mx.nd.array(rs.rand(batch, 64).astype("float32"))
+    gn = mx.nd.array(rs.rand(batch, hidden).astype("float32"))
+    bs = mx.nd.array(rs.rand(batch, hidden).astype("float32"))
+
+    def arm(codegen):
+        os.environ["MXNET_FUSION_CODEGEN"] = "1" if codegen else "0"
+        exec_cache.clear()
+        passes.clear_memo()
+        passes.reset_fusion_stats()
+        t0 = time.perf_counter()
+        exe = build().simple_bind(ctx, grad_req="null",
+                                  data=(batch, 64),
+                                  gain=(batch, hidden),
+                                  bias=(batch, hidden))
+        exe.forward(is_train=False, data=x, gain=gn, bias=bs)
+        val = float(exe.outputs[0].asnumpy())
+        bind_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            exe.forward(is_train=False, data=x, gain=gn, bias=bs)
+        exe.outputs[0].asnumpy()
+        step_us = (time.perf_counter() - t0) / iters * 1e6
+        return bind_s, step_us, val, passes.fusion_stats()
+
+    old = {k: os.environ.get(k) for k in
+           ("MXNET_FUSION_CODEGEN", "MXNET_FUSION_INTERPRET")}
+    try:
+        if platform == "cpu":
+            # no TPU: force interpret so the generated-kernel path
+            # actually executes instead of counting fallback:platform
+            os.environ["MXNET_FUSION_INTERPRET"] = "1"
+        bind_off, step_off, val_off, _ = arm(False)
+        bind_on, step_on, val_on, fst = arm(True)
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    rel = abs(val_off - val_on) / max(abs(val_off), 1e-9)
+
+    # merged-step decode A/B: same prefix-heavy traffic, split
+    # tail-prefill engine vs ragged single-step engine
+    from mxnet_tpu import decoding as dec
+
+    cfg = dec.DecoderConfig(vocab=128, d_model=64, n_layers=2,
+                            n_heads=4, d_ff=128, max_len=256)
+    params = dec.init_decoder_params(cfg, seed=0)
+    shared = rs.randint(2, cfg.vocab, size=16).tolist()
+    prompts = [shared + rs.randint(2, cfg.vocab,
+                                   size=int(rs.randint(4, 9))).tolist()
+               for _ in range(24)]
+
+    def decode_arm(merged):
+        model = dec.DecodedModel(
+            "bench-fusion", 1, params, cfg, max_batch=8, page_size=8,
+            num_pages=128, page_buckets=(1, 2, 4), queue_cap=256,
+            max_tokens=12, prefix_cache=True, merged_step=merged)
+        grid = sum(model.engine.trace_counts().values())
+        futs = [model.submit(p, max_new_tokens=12) for p in prompts]
+        for f in futs:
+            f.result(600)
+        snap = model.stats.snapshot()
+        model.close()
+        return {
+            "decode_tokens_per_s": snap["decode_tokens_per_s"],
+            "prefill_tokens_per_s": snap["prefill_tokens_per_s"],
+            "warmup_programs": grid,
+            "traces_since_warmup": snap["traces_since_warmup"],
+            "prefix_hit_rate": snap["prefix_hit_rate"],
+        }
+
+    split = decode_arm(False)
+    merged = decode_arm(True)
+
+    _emit({
+        "metric": f"fusion_codegen_{platform}_b{batch}_h{hidden}",
+        "value": round(step_off / max(step_on, 1e-9), 3),
+        "unit": "x",
+        "mode": "fusion", "platform": platform,
+        "groups_seen": fst["groups_seen"],
+        "groups_lowered": fst["groups_lowered"],
+        "groups_fallback": fst["groups_fallback"],
+        "fallback_reasons": fst["fallback_reasons"],
+        "templates": fst["templates"],
+        "kernels_built": fst["kernels_built"],
+        "parity_checks": fst["parity_checks"],
+        "parity_failures": fst["parity_failures"],
+        "bind_s_fallback": round(bind_off, 4),
+        "bind_s_fused": round(bind_on, 4),
+        "step_us_fallback": round(step_off, 1),
+        "step_us_fused": round(step_on, 1),
+        "fused_step_speedup": round(step_off / max(step_on, 1e-9), 3),
+        "parity_rel_err": rel,
+        "decode_tokens_per_s_split": split["decode_tokens_per_s"],
+        "decode_tokens_per_s_merged": merged["decode_tokens_per_s"],
+        "merged_decode_speedup": round(
+            merged["decode_tokens_per_s"]
+            / max(split["decode_tokens_per_s"], 1e-9), 3),
+        "warmup_programs_split": split["warmup_programs"],
+        "warmup_programs_merged": merged["warmup_programs"],
+        "traces_since_warmup": merged["traces_since_warmup"],
+        "prefix_hit_rate_merged": merged["prefix_hit_rate"],
+        "telemetry": _telemetry_snapshot(),
+    })
+
+
 def _decode_bench(platform):
     """BENCH_MODE=decode: continuous-batching autoregressive serving.
 
@@ -1133,6 +1280,8 @@ def main():
         return _passes_bench(jax.devices()[0].platform)
     if os.environ.get("BENCH_MODE", "train") == "decode":
         return _decode_bench(jax.devices()[0].platform)
+    if os.environ.get("BENCH_MODE", "train") == "fusion":
+        return _fusion_bench(jax.devices()[0].platform)
     if os.environ.get("BENCH_MODE", "train") == "sharding":
         return _sharding_bench(jax.devices()[0].platform)
     if os.environ.get("BENCH_MODE", "train") == "profiling":

@@ -17,6 +17,8 @@ backend):
          raw Lock.acquire)
   MX005  nondeterminism: global-RNG draws outside mxnet_tpu.random,
          wall-clock in cache keys
+  MX009  raw pl.pallas_call outside the codegen entry points, or an
+         allowlisted kernel module missing its lax fallback twin
 
 Every rule is a pure function over one parsed file (`FileContext`);
 the engine (lint.py) owns walking, suppression, baseline, and output.
@@ -553,6 +555,77 @@ def check_mx005(ctx):
     return findings
 
 
+# --------------------------------------------------------------------------
+# MX009 — pallas_call outside the sanctioned kernel entry points
+# --------------------------------------------------------------------------
+# Generated kernels flow through ONE pass (passes/pallas_codegen.py),
+# which guarantees every kernel a lax twin: build-time interpret parity,
+# a counted runtime fallback, and calibration records. A raw
+# pl.pallas_call anywhere else reintroduces exactly the hand-rolled,
+# unverified kernel the codegen tier exists to retire. The two
+# attention modules predate the pass and carry their own reference
+# implementations, so they are allowlisted — but even there the rule
+# demands visible fallback evidence (a module-level def whose name
+# says "lax"/"reference", or a kernel-registry dict with a "lax" key),
+# so the escape hatch never silently loses its escape.
+_MX009_ALLOWED = {
+    "mxnet_tpu/passes/pallas_codegen.py",
+    "mxnet_tpu/decoding/attention.py",
+    "mxnet_tpu/parallel/attention.py",
+}
+
+
+def _mx009_has_fallback(tree):
+    """Module-level evidence of a lax twin: a top-level (or class-level)
+    function whose name advertises the reference path, or a registry
+    dict literal that maps the "lax" choice."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            name = node.name.lower()
+            if "lax" in name or "reference" in name:
+                return True
+        elif isinstance(node, ast.Dict):
+            for key in node.keys:
+                if _str_const(key) == "lax":
+                    return True
+    return False
+
+
+def check_mx009(ctx):
+    imports = _import_map(ctx.tree)
+    calls = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dn = _dotted(node.func, imports)
+        if dn is not None and (dn == "pallas_call"
+                               or dn.endswith(".pallas_call")):
+            calls.append(node)
+    if not calls:
+        return []
+    findings = []
+    if ctx.relpath not in _MX009_ALLOWED:
+        for node in calls:
+            findings.append(RawFinding(
+                "MX009", node.lineno, node.col_offset,
+                "raw `pl.pallas_call` outside the codegen entry points "
+                "(passes/pallas_codegen.py, decoding/attention.py, "
+                "parallel/attention.py): hand-rolled kernels skip the "
+                "build-time parity proof, the counted lax fallback, and "
+                "calibration — emit through passes.pallas_codegen, or "
+                "add the file to the allowlist WITH a lax twin"))
+    elif not _mx009_has_fallback(ctx.tree):
+        for node in calls:
+            findings.append(RawFinding(
+                "MX009", node.lineno, node.col_offset,
+                "`pl.pallas_call` in an allowlisted kernel module with "
+                "no registered lax fallback: keep a module-level "
+                "reference implementation (a `*_lax`/`*_reference` def "
+                "or a kernel dict with a \"lax\" entry) so non-TPU "
+                "platforms and parity checks always have a twin"))
+    return findings
+
+
 #: rule code -> (checker, one-line summary) — the engine iterates this.
 ALL_RULES = {
     "MX001": (check_mx001, "host-sync call on a declared hot path"),
@@ -560,6 +633,7 @@ ALL_RULES = {
     "MX003": (check_mx003, "unregistered MXNET_* environment read"),
     "MX004": (check_mx004, "concurrency hygiene"),
     "MX005": (check_mx005, "nondeterministic draw / wall-clock key"),
+    "MX009": (check_mx009, "pallas_call outside codegen entry points"),
 }
 
 #: project-scope rules — computed once over the whole tree by

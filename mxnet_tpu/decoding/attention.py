@@ -27,6 +27,11 @@ Two implementations behind `MXNET_DECODE_KERNEL`:
           prefetch (PrefetchScalarGridSpec) — pages stream HBM->VMEM
           per grid step instead of materializing the gathered
           context. Interpret-mode on CPU, compiled on TPU.
+
+The knob is read through `passes.codegen_config()` (one switch
+surface with the MXNET_FUSION_* kernel-generation flags); the
+`ragged_paged_attention_*` entries below serve MIXED prefill+decode
+batches for the merged-step engine (MXNET_DECODE_MERGED_STEP).
 """
 from __future__ import annotations
 
@@ -201,10 +206,56 @@ def paged_attention_pallas(q, k_pages, v_pages, page_table, lengths,
     return fn(page_table, lengths, q, k_pages, v_pages)
 
 
+# ---------------------------------------------------------------- ragged
+def ragged_paged_attention_lax(q, k_pages, v_pages, page_table,
+                               lengths, scale=None):
+    """Ragged paged attention (PAPERS.md), lax path: ONE fixed-shape
+    kernel serving a MIXED batch of decode rows and tail-prefill rows.
+
+    The single-query paged kernel is already position-agnostic per
+    row: row b attends exactly the context positions < lengths[b] of
+    its own page table. A decode row passes its full context length; a
+    tail-prefill row passes `position + 1` for the prompt token it is
+    processing (intra-chunk causality — the token at position p sees
+    positions <= p, which its engine-side scatter has already written).
+    Nothing else distinguishes the two, so prefill and decode share
+    one pre-traced program per pages bucket and the warmup trace grid
+    loses its per-length-bucket tail-prefill programs entirely
+    (docs/serving.md)."""
+    return paged_attention_lax(q, k_pages, v_pages, page_table,
+                               lengths, scale=scale)
+
+
+def ragged_paged_attention_pallas(q, k_pages, v_pages, page_table,
+                                  lengths, scale=None):
+    """Ragged mixed prefill+decode batch through the flash-style paged
+    kernel — same per-row length masking as the lax twin (see
+    `ragged_paged_attention_lax`), pages streamed HBM->VMEM via the
+    scalar-prefetch page table."""
+    return paged_attention_pallas(q, k_pages, v_pages, page_table,
+                                  lengths, scale=scale)
+
+
 _KERNELS = {
     "lax": paged_attention_lax,
     "pallas": paged_attention_pallas,
 }
+
+_RAGGED_KERNELS = {
+    "lax": ragged_paged_attention_lax,
+    "pallas": ragged_paged_attention_pallas,
+}
+
+
+def get_ragged_kernel(name):
+    """Resolve MXNET_DECODE_KERNEL to the mixed prefill+decode ragged
+    implementation (the merged-step engine path)."""
+    try:
+        return _RAGGED_KERNELS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown MXNET_DECODE_KERNEL {name!r} "
+            f"(choices: {sorted(_RAGGED_KERNELS)})") from None
 
 # the multi-query paths (tail prefill, speculative verify) have one
 # implementation today; the pallas flash variant is a silicon item

@@ -28,7 +28,15 @@ def page_buckets():
 
 
 def kernel():
-    return utils.getenv("MXNET_DECODE_KERNEL")
+    # read through the codegen config: MXNET_DECODE_KERNEL is part of
+    # the one kernel-generation switch surface (passes.pallas_codegen)
+    from ..passes import codegen_config
+
+    return codegen_config().decode_kernel
+
+
+def merged_step():
+    return bool(utils.getenv("MXNET_DECODE_MERGED_STEP"))
 
 
 def ring_prefill():

@@ -10,11 +10,19 @@ a FIXED grid of jitted programs:
   tail     one tail-prefill program per length bucket (prefix-cache
            hits: compute only the uncached prompt tail, attending over
            the shared pages — page table padded to the largest bucket
-           for one static shape per tail bucket)
+           for one static shape per tail bucket). NOT BUILT in
+           merged-step mode (MXNET_DECODE_MERGED_STEP, the default
+           with the prefix cache on): tail tokens ride the decode
+           step as extra ragged rows instead, one program family
+           fewer in the warmup grid
   decode   one program per pages-per-sequence bucket; the step shape
-           is a function ONLY of (max_batch, bucket) — never of real
-           lengths or batch composition — so `warmup()` pre-traces the
-           full grid and steady-state decode adds zero traces
+           is a function ONLY of (step_rows, bucket) — step_rows =
+           max_batch plus, in merged mode, page_size tail rows —
+           never of real lengths or batch composition — so `warmup()`
+           pre-traces the full grid and steady-state decode adds zero
+           traces. In merged mode the rows mix decode queries and
+           tail-prefill prompt tokens through the ragged paged
+           attention kernel (decoding/attention.py)
   draft/   with a draft model configured, one K-token draft proposer
   verify   and one K+1-position target verifier per pages bucket —
            the speculative pair joins the same pinned trace grid, and
@@ -57,7 +65,7 @@ class DecodeEngine:
     def __init__(self, params, cfg, *, max_batch=None, page_size=None,
                  num_pages=None, page_buckets=None, kernel=None,
                  ring_prefill=None, draft_params=None, draft_cfg=None,
-                 spec_k=None, prefix_cache=None):
+                 spec_k=None, prefix_cache=None, merged_step=None):
         self.cfg = cfg
         self.max_batch = max_batch if max_batch is not None \
             else _cfg.max_batch()
@@ -121,6 +129,24 @@ class DecodeEngine:
                       dcfg.n_heads, dcfg.head_dim)
             self._dk = jnp.zeros(dshape, jnp.float32)
             self._dv = jnp.zeros(dshape, jnp.float32)
+        # merged ragged step (MXNET_DECODE_MERGED_STEP): prefix-cache
+        # tail-prefill tokens ride the decode step as extra rows
+        # through the ragged paged kernel — the per-length-bucket tail
+        # programs are never built and the warmup grid shrinks by one
+        # program per prefill bucket. Requires the prefix cache (the
+        # only producer of tails) and no speculation (the verify pair
+        # owns its own multi-query shape).
+        want_merged = merged_step if merged_step is not None \
+            else _cfg.merged_step()
+        self.merged_step_enabled = bool(
+            want_merged and self.prefix_cache_enabled
+            and not self.spec_enabled)
+        # extra step rows available for tail tokens each merged step;
+        # one page's worth keeps the row overhead bounded while a tail
+        # still advances a full page per step
+        self.tail_budget = self.page_size if self.merged_step_enabled \
+            else 0
+        self.step_rows = self.max_batch + self.tail_budget
         # donation lets XLA update the pool in place; CPU falls back
         # with a warning, so only donate where it pays
         self._donate = jax.default_backend() != "cpu"
@@ -147,7 +173,8 @@ class DecodeEngine:
         self._digest = _hashlib.sha1(repr(
             (cfg, self.max_batch, self.page_size, self.num_pages,
              self.kernel_name, self.draft_cfg,
-             self.spec_k if self.spec_enabled else 0)
+             self.spec_k if self.spec_enabled else 0,
+             self.step_rows if self.merged_step_enabled else 0)
         ).encode()).hexdigest()[:12]
 
     def _instrument(self, fn, kind):
@@ -236,7 +263,11 @@ class DecodeEngine:
 
     # -------------------------------------------------------- builders
     def _build_decode_fn(self, bucket):
-        cfg, attn = self.cfg, self._attn
+        # merged mode routes through the ragged entry: same per-row
+        # contract, named for what the mixed batch actually is
+        cfg = self.cfg
+        attn = (_attn.get_ragged_kernel(self.kernel_name)
+                if self.merged_step_enabled else self._attn)
         guard = self._guard
 
         def impl(params, tokens, k_pages, v_pages, page_table,
@@ -344,21 +375,25 @@ class DecodeEngine:
                 np.float32(temperature), np.int32(top_k),
                 np.float32(top_p))
 
-    def _samp_arrays(self, seeds, temps, top_ks, top_ps):
-        """(B,) sampling arrays, defaulting to greedy, fixed dtypes."""
-        b = self.max_batch
-        if seeds is None:
-            seeds = np.zeros((b,), np.uint32)
-        if temps is None:
-            temps = np.zeros((b,), np.float32)
-        if top_ks is None:
-            top_ks = np.zeros((b,), np.int32)
-        if top_ps is None:
-            top_ps = np.ones((b,), np.float32)
-        return (np.asarray(seeds, np.uint32),
-                np.asarray(temps, np.float32),
-                np.asarray(top_ks, np.int32),
-                np.asarray(top_ps, np.float32))
+    def _samp_arrays(self, seeds, temps, top_ks, top_ps, rows=None):
+        """(rows,) sampling arrays, defaulting to greedy, fixed
+        dtypes, padded up to `rows` (default step_rows — the merged
+        step's width; the speculative pair passes max_batch)."""
+        b = rows if rows is not None else self.step_rows
+
+        def _fill(arr, dtype, fill):
+            if arr is None:
+                return np.full((b,), fill, dtype)
+            arr = np.asarray(arr, dtype)
+            if len(arr) < b:
+                arr = np.concatenate(
+                    [arr, np.full((b - len(arr),), fill, dtype)])
+            return arr
+
+        return (_fill(seeds, np.uint32, 0),
+                _fill(temps, np.float32, 0.0),
+                _fill(top_ks, np.int32, 0),
+                _fill(top_ps, np.float32, 1.0))
 
     # ---------------------------------------------------------- warmup
     def warmup(self):
@@ -385,7 +420,11 @@ class DecodeEngine:
                 self._params, tokens, jnp.int32(0), self._k, self._v,
                 page_ids, *sargs)
             tok.block_until_ready()
-            if self.prefix_cache_enabled:
+            if self.prefix_cache_enabled \
+                    and not self.merged_step_enabled:
+                # merged mode NEVER builds the per-length tail
+                # programs: tail tokens ride the decode step below —
+                # this is the warmup-grid shrink the merged step buys
                 self._tail_fns[lb] = self._build_tail_fn(lb)
                 tok, self._k, self._v = self._tail_fns[lb](
                     self._params, tokens, jnp.int32(0), jnp.int32(0),
@@ -406,12 +445,13 @@ class DecodeEngine:
                         jnp.int32(0), self._dk, self._dv, full_ids,
                         *sargs)
                     tok.block_until_ready()
+        r = self.step_rows
         b = self.max_batch
-        dry = (np.zeros((b,), np.int32), np.zeros((b,), np.int32),
-               np.zeros((b,), bool))
+        dry = (np.zeros((r,), np.int32), np.zeros((r,), np.int32),
+               np.zeros((r,), bool))
         sarr = self._samp_arrays(None, None, None, None)
         for bucket in self.page_buckets:
-            table = np.zeros((b, bucket), np.int32)
+            table = np.zeros((r, bucket), np.int32)
             self._decode_fns[bucket] = self._build_decode_fn(bucket)
             out = self._run_decode(
                 self._decode_fns[bucket], self._params, dry[0],
@@ -422,8 +462,11 @@ class DecodeEngine:
                     bucket)
                 self._verify_fns[bucket] = self._build_verify_fn(
                     bucket)
-                self.spec_step(dry[0], table, dry[1], dry[2],
-                               np.zeros((b,), bool), *sarr)
+                self.spec_step(
+                    np.zeros((b,), np.int32), np.zeros((b, bucket),
+                                                       np.int32),
+                    np.zeros((b,), np.int32), np.zeros((b,), bool),
+                    np.zeros((b,), bool))
         self._harvest_calibration()
         self._guard_pending = []  # warmup rows are all-masked noise
         self._warm = True
@@ -443,7 +486,7 @@ class DecodeEngine:
                 return
             store = _profiling.calibration_store()
             platform = jax.default_backend()
-            b = self.max_batch
+            b = self.step_rows
             sarr = self._samp_arrays(None, None, None, None)
             for bucket in self.page_buckets:
                 t0 = _time.perf_counter()
@@ -490,6 +533,12 @@ class DecodeEngine:
         n = len(token_ids)
         sargs = self._samp_scalars(seed, temperature, top_k, top_p)
         zargs = self._samp_scalars()  # draft prefill output is unused
+        if start and self.merged_step_enabled:
+            raise PageError(
+                "tail prefill has no dedicated program in merged-step "
+                "mode: the scheduler feeds tail tokens through step() "
+                "rows (MXNET_DECODE_MERGED_STEP=0 restores the split "
+                "tail-prefill grid)")
         if start:
             tail = token_ids[start:]
             lb = pick_bucket(len(tail), self.prefill_buckets)
@@ -525,17 +574,39 @@ class DecodeEngine:
 
     def step(self, tokens, page_table, lengths, active, seeds=None,
              temps=None, top_ks=None, top_ps=None):
-        """One continuous-decode step. All arrays are the full
-        (max_batch, ...) fixed shapes; `page_table.shape[1]` must be a
-        configured bucket. Per-row sampling params default to greedy.
-        Returns next tokens as a host (B,) array (the stream/EOS sync
-        — one fetch per step, by design)."""
+        """One continuous-decode step. Row arrays are the fixed
+        (step_rows, ...) shapes — max_batch decode rows plus, in
+        merged mode, tail_budget ragged tail-prefill rows;
+        `page_table.shape[1]` must be a configured bucket. Narrower
+        (e.g. legacy (max_batch,)) inputs are padded with masked rows
+        so every dispatch replays the one warmed shape, and the
+        return is sliced back to the caller's width. Per-row sampling
+        params default to greedy. Returns next tokens as a host array
+        (the stream/EOS sync — one fetch per step, by design)."""
         bucket = page_table.shape[1]
+        b_in = len(tokens)
+        r = self.step_rows
+        tokens = self._pad_rows(tokens, np.int32, 0)
+        lengths = self._pad_rows(lengths, np.int32, 0)
+        active = self._pad_rows(active, bool, False)
+        if page_table.shape[0] < r:
+            page_table = np.concatenate(
+                [np.asarray(page_table, np.int32),
+                 np.full((r - page_table.shape[0], bucket),
+                         SCRATCH_PAGE, np.int32)])
         sarr = self._samp_arrays(seeds, temps, top_ks, top_ps)
         out = self._run_decode(
             self._decode_fns[bucket], self._params, tokens,
             self._k, self._v, page_table, lengths, active, *sarr)
-        return np.asarray(out)
+        return np.asarray(out)[:b_in]
+
+    def _pad_rows(self, arr, dtype, fill):
+        arr = np.asarray(arr, dtype)
+        if len(arr) < self.step_rows:
+            arr = np.concatenate(
+                [arr, np.full((self.step_rows - len(arr),), fill,
+                              dtype)])
+        return arr
 
     def spec_step(self, tokens, page_table, lengths, active,
                   use_draft, seeds=None, temps=None, top_ks=None,

@@ -185,7 +185,8 @@ class _Sequence:
     __slots__ = ("prompt", "max_new", "priority", "deadline", "future",
                  "trace_id", "order", "sampling", "use_draft",
                  "generated", "table", "length", "last_token",
-                 "preempted", "t_submit_pc")
+                 "preempted", "t_submit_pc", "pending_tail",
+                 "tail_meta")
 
     def __init__(self, prompt, max_new, priority, deadline, future,
                  trace_id, order, sampling, use_draft):
@@ -204,6 +205,12 @@ class _Sequence:
         self.last_token = -1
         self.preempted = False
         self.t_submit_pc = _trace.now()
+        # merged-step tail prefill (engine.merged_step_enabled): the
+        # uncached prompt tail still to be fed through step() rows,
+        # and (t0, n_ctx, start, need_total, n_matched) bookkeeping
+        # for the note_prefill/span record at completion
+        self.pending_tail = None
+        self.tail_meta = None
 
     def context_tokens(self):
         """Tokens the KV cache must hold for this sequence: the prompt
@@ -233,6 +240,7 @@ class ContinuousScheduler:
         self._cond = threading.Condition()
         self._waiting = []
         self._rows = [None] * engine.max_batch
+        self._tail_plan = []           # (seq, chunk) for this step
         self._order = itertools.count()
         self._closed = False
         self._drain = True
@@ -361,6 +369,10 @@ class ContinuousScheduler:
             self.engine.allocator.free(seq.table)
             seq.table = None
         seq.preempted = True
+        # a merged-step tail in flight dies with the pages: readmission
+        # re-plans the whole prompt (possibly re-matching the cache)
+        seq.pending_tail = None
+        seq.tail_meta = None
         with self._cond:
             for row, s in enumerate(self._rows):
                 if s is seq:
@@ -441,6 +453,38 @@ class ContinuousScheduler:
             # no page can hold the next position: capacity stop
             self._resolve(seq, reason="length")
 
+    def _finish_tail(self, seq, first_tok):
+        """Merged-step tail completion: the bookkeeping a dedicated
+        tail-prefill dispatch would have done at admission — prefill
+        stats, span record, cache publish, first-token handling —
+        deferred to the decode step that wrote the final tail token
+        (so cached pages are only published once actually filled)."""
+        t0, n_ctx, start, need_total, n_matched = seq.tail_meta
+        seq.tail_meta = None
+        seq.pending_tail = None
+        dt = _trace.now() - t0
+        self.stats.note_prefill(n_ctx - start, dt,
+                                readmission=seq.preempted)
+        _trace.record_span(
+            "decoding.prefill", seq.trace_id, t0, t0 + dt,
+            {"model": self.key, "tokens": n_ctx,
+             "cached_tokens": start, "pages": need_total,
+             "pages_reused": n_matched,
+             "readmission": seq.preempted, "merged": True})
+        if self.cache is not None:
+            P = self.engine.page_size
+            n_full = len(seq.prompt) // P
+            if n_full:
+                self.cache.insert(seq.prompt[:n_full * P],
+                                  seq.table[:n_full])
+        was_preempted, seq.preempted = seq.preempted, False
+        if was_preempted and seq.generated:
+            # tail replay of a preempted run reproduces the token
+            # already emitted; restore, don't re-emit (see _admit)
+            seq.last_token = seq.generated[-1]
+        else:
+            self._handle_token(seq, first_tok)
+
     # -------------------------------------------------------- admission
     def _admit(self):
         """Fill free batch rows from the waiting queue in (priority,
@@ -492,6 +536,19 @@ class ContinuousScheduler:
                 row = self._rows.index(None)
                 self._rows[row] = seq
             t0 = _trace.now()
+            if start and self.engine.merged_step_enabled:
+                # merged-step deferral: no tail-prefill dispatch here —
+                # the uncached tail rides the next decode step(s) as
+                # ragged rows (_grow plans the chunks, _step packs
+                # them). length stays at the cached prefix until those
+                # rows actually write; the cache insert and the
+                # note_prefill/first-token bookkeeping happen at tail
+                # completion (_finish_tail), when the pages are real.
+                seq.pending_tail = list(tokens[start:])
+                seq.length = start
+                seq.tail_meta = (t0, len(tokens), start, need_total,
+                                 len(matched))
+                continue
             first = self.engine.prefill(
                 tokens, seq.table, start=start,
                 seed=seq.sampling.seed,
@@ -514,11 +571,13 @@ class ContinuousScheduler:
                 if n_full:
                     self.cache.insert(seq.prompt[:n_full * P],
                                       seq.table[:n_full])
-            if seq.preempted:
+            was_preempted, seq.preempted = seq.preempted, False
+            if was_preempted and seq.generated:
                 # the re-prefill reproduces the token already emitted
                 # (prefix stability — sampled streams are (seed,
-                # position)-pure); restore, don't re-emit
-                seq.preempted = False
+                # position)-pure); restore, don't re-emit. A sequence
+                # preempted mid-tail (merged-step mode) may have no
+                # token yet — its first token is genuinely new.
                 seq.last_token = seq.generated[-1]
             else:
                 self._handle_token(seq, int(first))
@@ -536,8 +595,8 @@ class ContinuousScheduler:
         P = self.engine.page_size
         k = self.engine.spec_k if self.engine.spec_enabled else 0
         for seq in self._active():
-            if seq.table is None:
-                continue
+            if seq.table is None or seq.pending_tail:
+                continue    # tail seqs: write range planned below
             # pages covering the step's write positions (clamped to
             # capacity: the host stops at max_context before any
             # clamped write could be read back)
@@ -573,6 +632,58 @@ class ContinuousScheduler:
                     break
                 if copy_from is not None:
                     self.engine.copy_page(copy_from, page)
+        # merged-step tail plan: split this step's tail_budget extra
+        # rows across sequences still holding a pending prompt tail,
+        # sizing each one's page table for the chunk it will write.
+        # Tail pages sit past the cached prefix (the cache matches
+        # full pages only), so they are exclusively owned — the
+        # make_writable pass below is the same COW discipline as
+        # above and never copies in practice.
+        self._tail_plan = []
+        if not self.engine.merged_step_enabled:
+            return
+        budget = self.engine.tail_budget
+        for seq in self._active():
+            if budget <= 0:
+                break
+            if seq.table is None or not seq.pending_tail:
+                continue
+            chunk = min(len(seq.pending_tail), budget)
+            cover = min(seq.length + chunk, self.engine.max_context)
+            need = pages_needed(cover, P)
+            while seq.table is not None and len(seq.table) < need:
+                try:
+                    seq.table.extend(alloc.alloc(1))
+                except PagePoolExhausted:
+                    if self.cache is not None and self.cache.evict_lru():
+                        continue
+                    if self._reclaim_one(None) is None:
+                        break
+            if seq.table is None or len(seq.table) < need:
+                continue
+            first = seq.length // P
+            last = min((cover - 1) // P, len(seq.table) - 1)
+            ok = True
+            for idx in range(first, last + 1):
+                page, copy_from = None, None
+                while seq.table is not None:
+                    try:
+                        page, copy_from = alloc.make_writable(
+                            seq.table, idx)
+                        break
+                    except PagePoolExhausted:
+                        if (self.cache is not None
+                                and self.cache.evict_lru()):
+                            continue
+                        self._preempt(seq)
+                if seq.table is None or page is None:
+                    ok = False
+                    break
+                if copy_from is not None:
+                    self.engine.copy_page(copy_from, page)
+            if ok and seq.table is not None:
+                self._tail_plan.append((seq, chunk))
+                budget -= chunk
 
     # -------------------------------------------------------------- step
     def _step(self):
@@ -582,22 +693,25 @@ class ContinuousScheduler:
         if not live:
             return
         b = engine.max_batch
+        r = engine.step_rows        # == b + tail_budget when merged
         spec = engine.spec_enabled
         k = engine.spec_k if spec else 0
         # _grow already sized every table for the full write range;
         # span over table lengths keeps the bucket consistent with it
         span = max(len(s.table) for _, s in live)
         bucket = pick_bucket(span, engine.page_buckets)
-        tokens = np.zeros((b,), np.int32)
-        table = np.full((b, bucket), SCRATCH_PAGE, np.int32)
-        lengths = np.zeros((b,), np.int32)
-        active = np.zeros((b,), bool)
-        use_draft = np.zeros((b,), bool)
-        seeds = np.zeros((b,), np.uint32)
-        temps = np.zeros((b,), np.float32)
-        top_ks = np.zeros((b,), np.int32)
-        top_ps = np.ones((b,), np.float32)
+        tokens = np.zeros((r,), np.int32)
+        table = np.full((r, bucket), SCRATCH_PAGE, np.int32)
+        lengths = np.zeros((r,), np.int32)
+        active = np.zeros((r,), bool)
+        use_draft = np.zeros((r,), bool)
+        seeds = np.zeros((r,), np.uint32)
+        temps = np.zeros((r,), np.float32)
+        top_ks = np.zeros((r,), np.int32)
+        top_ps = np.ones((r,), np.float32)
         for row, s in live:
+            if s.pending_tail:
+                continue    # fed through the ragged tail rows below
             tokens[row] = s.last_token
             table[row, :len(s.table)] = s.table
             lengths[row] = s.length
@@ -607,6 +721,32 @@ class ContinuousScheduler:
             temps[row] = s.sampling.temperature
             top_ks[row] = s.sampling.top_k
             top_ps[row] = s.sampling.top_p
+        # ragged rows b..r-1: planned prompt-tail chunks ride the same
+        # fixed-shape step. Row j of a chunk holds prompt token at
+        # absolute position lengths[row] (= count of context tokens
+        # already written); the kernel's per-row length masking gives
+        # intra-chunk causality for free, and the chunk's LAST row
+        # samples the sequence's first token at its true position —
+        # bit-identical to the dedicated tail-prefill program.
+        tail_rows = []
+        next_row = b
+        for seq, chunk in self._tail_plan:
+            if seq.table is None or not seq.pending_tail \
+                    or seq.future.done():
+                continue    # resolved/preempted after planning
+            chunk = min(chunk, len(seq.pending_tail))
+            for j in range(chunk):
+                row = next_row
+                next_row += 1
+                tokens[row] = seq.pending_tail[j]
+                table[row, :len(seq.table)] = seq.table
+                lengths[row] = seq.length + j
+                active[row] = True
+                seeds[row] = seq.sampling.seed & 0xFFFFFFFF
+                temps[row] = seq.sampling.temperature
+                top_ks[row] = seq.sampling.top_k
+                top_ps[row] = seq.sampling.top_p
+            tail_rows.append((seq, next_row - 1, chunk))
         t0 = _trace.now()
         if spec:
             out, n_emit = engine.spec_step(
@@ -630,9 +770,20 @@ class ContinuousScheduler:
                     self._handle_token(s, int(out[row, j]))
         else:
             for row, s in live:
+                if s.pending_tail:
+                    continue    # decode row was inactive this step
                 s.length += 1
                 emitted += 1
                 self._handle_token(s, int(out[row]))
+            for seq, last_row, chunk in tail_rows:
+                if seq.table is None or seq.future.done():
+                    continue
+                seq.length += chunk
+                del seq.pending_tail[:chunk]
+                if not seq.pending_tail:
+                    # tail tokens are prefill work, not emitted tokens:
+                    # counted via note_prefill in _finish_tail
+                    self._finish_tail(seq, int(out[last_row]))
         self.stats.note_step(emitted, dt)
         _trace.record_span(
             "decoding.step", None, t0, t0 + dt,
@@ -690,7 +841,8 @@ class DecodedModel:
                  page_size=None, num_pages=None, page_buckets=None,
                  kernel=None, ring_prefill=None, queue_cap=None,
                  max_tokens=None, warmup=True, draft=None,
-                 draft_cfg=None, spec_k=None, prefix_cache=None):
+                 draft_cfg=None, spec_k=None, prefix_cache=None,
+                 merged_step=None):
         self.name = name
         self.version = int(version)
         self.cfg = cfg
@@ -716,7 +868,8 @@ class DecodedModel:
             num_pages=num_pages, page_buckets=page_buckets,
             kernel=kernel, ring_prefill=ring_prefill,
             draft_params=draft_params, draft_cfg=draft_cfg,
-            spec_k=spec_k, prefix_cache=prefix_cache)
+            spec_k=spec_k, prefix_cache=prefix_cache,
+            merged_step=merged_step)
         self.stats = DecodeStats(
             key=self.key, traces_fn=self.engine.traces,
             pool_fn=self.engine.pool_stats)

@@ -19,8 +19,15 @@ around. Pipeline order (manager.DEFAULT_PIPELINE):
                of the structural passes so names reflect the final
                graph (and a second pipeline run is a no-op)
   fusion_hints annotate single-consumer elementwise chains with
-               `__fusion_group__` (advisory: surfaced to profiling /
-               future kernel fusion; not part of the cache key)
+               `__fusion_group__` (advisory: surfaced to profiling
+               and consumed by the codegen stage below)
+  pallas_codegen
+               absorb eligible trailing reductions into their chains
+               and stamp each group `candidate:<digest>` or
+               `fallback:<reason>` — the lowering verdict
+               `plan_for`/Executor turn into generated Pallas kernels
+               (pallas_codegen.py; docs/passes.md "From hints to
+               kernels")
 
 Invariants every pass preserves: variable nodes are never renamed,
 created, or merged away (binding is by-name against the ORIGINAL

@@ -229,7 +229,8 @@ register_env(
     "MXNET_GRAPH_PASSES", str, "1",
     "graph-optimization pass pipeline run on every bind ahead of the "
     "exec-cache lookup (mxnet_tpu.passes): '1'/'on' = the default "
-    "pipeline (dce, fold, cse, canonicalize, fusion_hints); '0'/'off' "
+    "pipeline (dce, fold, cse, canonicalize, fusion_hints, "
+    "pallas_codegen); '0'/'off' "
     "= trace graphs exactly as constructed; a comma list selects and "
     "orders passes explicitly, e.g. 'dce,fold,cse,layout,"
     "canonicalize' to add the opt-in NCHW->NHWC layout rewrite "
@@ -241,6 +242,29 @@ register_env(
     "const subgraph whose result (or declared shape param) exceeds "
     "this many elements stays in the traced graph instead of being "
     "baked into the serialized form as a _graph_constant.",
+)
+register_env(
+    "MXNET_FUSION_CODEGEN", bool, True,
+    "pallas codegen (passes.pallas_codegen): lower __fusion_group__ "
+    "chains to generated Pallas kernels at bind time. 0 = every group "
+    "takes the composed lax fallback path (counted, never dropped); "
+    "the exec-cache key records the decision either way so fused and "
+    "fallback programs never collide (docs/passes.md).",
+)
+register_env(
+    "MXNET_FUSION_MIN_GROUP", int, 2,
+    "pallas codegen: minimum elementwise ops in a fusion group before "
+    "a kernel is generated; smaller groups fall back with reason "
+    "'too_small'. The fusion win is HBM round-trips saved, so a "
+    "1-op 'chain' has nothing to fuse.",
+)
+register_env(
+    "MXNET_FUSION_INTERPRET", bool, False,
+    "pallas codegen: force every generated kernel to run in Pallas "
+    "interpret mode even on TPU — the parity-debugging escape hatch, "
+    "and the switch that lets the codegen path (and its tests) run "
+    "on CPU. Off-TPU platforms use interpret mode implicitly only "
+    "when this flag is set; otherwise they take the lax fallback.",
 )
 register_env(
     "MXNET_TUNING_CACHE", str, "~/.cache/mxnet_tpu/tuning.json",
@@ -322,7 +346,18 @@ register_env(
     "decoding: page-table attention implementation: 'lax' (gather + "
     "masked softmax, runs anywhere) or 'pallas' (flash-style online-"
     "softmax kernel whose K/V block index maps read the page table "
-    "via scalar prefetch; interpret-mode on CPU).",
+    "via scalar prefetch; interpret-mode on CPU). Read through "
+    "passes.codegen_config() — one switch surface with the "
+    "MXNET_FUSION_* kernel-generation knobs.",
+)
+register_env(
+    "MXNET_DECODE_MERGED_STEP", bool, True,
+    "decoding: run tail-prefill tokens and decode rows in ONE "
+    "fixed-shape ragged step program (the Ragged Paged Attention "
+    "unification) instead of separate pre-traced tail-prefill "
+    "programs per length bucket — shrinks the warmup trace grid. "
+    "Applies when the prefix cache is on and speculative decoding "
+    "is off; 0 restores the split prefill/decode grid.",
 )
 register_env(
     "MXNET_DECODE_RING_PREFILL", int, 0,

@@ -211,14 +211,15 @@ def test_get_kernel():
 def test_single_request_parity_and_trace_grid():
     m = _model()
     try:
-        # the warmup grid: one prefill + one tail-prefill (prefix-
-        # cache hits) per length bucket, one decode per pages bucket,
-        # plus the page-copy program
+        # the warmup grid with the merged step (default): one prefill
+        # per length bucket, one ragged decode per pages bucket, plus
+        # the page-copy program. The per-length-bucket tail-prefill
+        # programs are GONE — prompt tails after a prefix-cache hit
+        # ride the decode step's extra rows instead of a dedicated
+        # program (MXNET_DECODE_MERGED_STEP=0 restores the old grid).
         counts = m.engine.trace_counts()
         assert counts == {"copy_page": 1, "prefill@4": 1,
                           "prefill@8": 1, "prefill@16": 1,
-                          "prefill_tail@4": 1, "prefill_tail@8": 1,
-                          "prefill_tail@16": 1,
                           "decode@1": 1, "decode@2": 1, "decode@4": 1}
         floor = m.engine.traces()
         for prompt in ([5, 6, 7], [3], list(range(2, 13))):

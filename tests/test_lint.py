@@ -65,3 +65,42 @@ def test_mxlint_exits_nonzero_on_violation(tmp_path):
         cwd=str(tmp_path), capture_output=True, text=True, timeout=60)
     assert proc.returncode == 1, proc.stdout + proc.stderr
     assert "MX003" in proc.stdout
+
+
+def test_mx009_pallas_call_containment():
+    """MX009 keeps pl.pallas_call behind the codegen entry points: a
+    raw call anywhere else is flagged, and even the allowlisted kernel
+    modules must carry a visible lax/reference twin."""
+    import ast
+
+    from mxnet_tpu.analysis.rules import FileContext, check_mx009
+
+    raw_kernel = ("from jax.experimental import pallas as pl\n"
+                  "fn = pl.pallas_call(lambda i_ref, o_ref: None,\n"
+                  "                    out_shape=None)\n")
+
+    def findings(relpath, src):
+        ctx = FileContext(relpath=relpath, tree=ast.parse(src),
+                          lines=src.splitlines())
+        return check_mx009(ctx)
+
+    # outside the allowlist: flagged no matter what else the file has
+    found = findings("mxnet_tpu/my_kernel.py", raw_kernel)
+    assert len(found) == 1 and found[0].rule == "MX009"
+    assert "outside the codegen entry points" in found[0].message
+
+    # allowlisted module WITHOUT a lax twin: still flagged
+    found = findings("mxnet_tpu/decoding/attention.py", raw_kernel)
+    assert len(found) == 1 and "fallback" in found[0].message
+
+    # allowlisted module WITH a module-level lax twin: clean; a
+    # kernel-registry dict with a "lax" entry also counts
+    twin = "def attention_lax(q, k, v):\n    return q\n\n"
+    assert findings("mxnet_tpu/decoding/attention.py",
+                    twin + raw_kernel) == []
+    registry = 'KERNELS = {"lax": None}\n'
+    assert findings("mxnet_tpu/parallel/attention.py",
+                    registry + raw_kernel) == []
+
+    # no pallas_call at all: nothing to say
+    assert findings("mxnet_tpu/anything.py", "x = 1\n") == []

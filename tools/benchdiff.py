@@ -42,6 +42,11 @@ LOWER_IS_BETTER = (
 HIGHER_IS_BETTER = (
     "prefix_hit_rate", "prefix_pages_reused",
     "spec_tokens_per_target_step", "spec_acceptance_rate",
+    # BENCH_MODE=fusion (generated-kernel A/B): more groups lowered
+    # and a faster fused step are the codegen tier paying rent; the
+    # merged ragged step must win on decode throughput
+    "groups_lowered", "fused_step_speedup", "merged_decode_speedup",
+    "decode_tokens_per_s_merged",
 )
 
 

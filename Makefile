@@ -14,7 +14,7 @@ XLA8    := XLA_FLAGS=--xla_force_host_platform_device_count=8
 	passes-check telemetry-check decode-check race-check \
 	fusion-check \
 	shard-check profiling-check numerics-check coldstart-check \
-	bench-diff clean
+	fleet-check bench-diff clean
 
 all: libs test
 
@@ -156,6 +156,13 @@ numerics-check:
 # tampered bundle rejected)
 coldstart-check:
 	$(CPUENV) bash ci/check_coldstart.sh
+
+# fleet tier: control-plane test suite, then the three-replica
+# runtime gate (one bundle -> 0 traces/0 compiles per replica;
+# SIGKILL + graceful drain both zero-loss and bit-identical) and the
+# affinity-vs-random routing bench A/B
+fleet-check:
+	$(CPUENV) bash ci/check_fleet.sh
 
 # regression diff of two bench captures (nonzero exit on >10% drops):
 #   make bench-diff OLD=BENCH_r04.json NEW=BENCH_r05.json

@@ -795,6 +795,136 @@ def _decode_bench(platform):
     })
 
 
+def _fleet_bench(platform):
+    """BENCH_MODE=fleet: multi-replica routing A/B.
+
+    Two fleets of N thread-backed replicas (each its own ModelServer
+    + paged decoder; the subprocess/bundle path is ci/check_fleet's
+    job) serve the same chat-shaped traffic — F prompt families
+    sharing multi-page prefixes — once routed by prefix affinity and
+    once routed randomly (the baseline arm). Affinity concentrates
+    each family on one replica, so its radix cache serves the family's
+    later prompts from pages already prefilled; random routing dilutes
+    every family's hit rate by ~1/N and re-prefills (allocates) the
+    same prefix pages on every replica. Reported: fleet-wide prefix
+    hit rate and total pages allocated for BOTH arms. Gate
+    (ci/check_fleet.sh): affinity strictly beats random on both."""
+    import socket as _socket
+    import threading
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import decoding as dec, fleet
+    from mxnet_tpu.serving import ModelServer
+
+    n_replicas = int(os.environ.get("BENCH_FLEET_REPLICAS", "3"))
+    n_requests = int(os.environ.get("BENCH_FLEET_REQUESTS", "36"))
+    max_new = int(os.environ.get("BENCH_FLEET_MAX_NEW", "8"))
+    page_size = 8
+    families = 6
+    cfg = dec.DecoderConfig(vocab=128, d_model=64, n_layers=2,
+                            n_heads=4, d_ff=128, max_len=256)
+    params = dec.init_decoder_params(cfg, seed=0)
+
+    # chat-shaped traffic: every request opens with one of F shared
+    # 3-page family preambles, then a short unique tail
+    rs = np.random.RandomState(0)
+    heads = [rs.randint(2, cfg.vocab, size=3 * page_size).tolist()
+             for _ in range(families)]
+    prompts = []
+    for i in range(n_requests):
+        tail = rs.randint(2, cfg.vocab,
+                          size=int(rs.randint(2, 7))).tolist()
+        prompts.append(heads[i % families] + tail)
+
+    def run_arm(policy):
+        servers, models = [], {}
+
+        def spawn(rid, port):
+            def run():
+                server = ModelServer()
+                model = server.load_decoder(
+                    f"lm-{policy}-{rid}", params, cfg, max_batch=8,
+                    page_size=page_size, num_pages=128,
+                    page_buckets=(1, 2, 4, 8), queue_cap=256,
+                    max_tokens=max_new)
+                servers.append(server)
+                models[rid] = model
+                sock = _socket.create_connection(("127.0.0.1", port))
+                fleet.ReplicaWorker(
+                    server, model, fleet.Channel(sock, name=rid), rid,
+                    heartbeat_ms=50,
+                    hello_extra={"traces": 0, "compiles": 0}).run()
+            threading.Thread(target=run, daemon=True).start()
+
+        router = fleet.FleetRouter(
+            replicas=n_replicas, heartbeat_ms=50,
+            page_size=page_size, policy=policy, spawn_fn=spawn,
+            name=f"bench-{policy}", seed=0)
+        router.start(wait=True, timeout=120)
+        t0 = time.perf_counter()
+        futs = []
+        # waves of one request per family, so heartbeats can
+        # advertise each wave's freshly cached prefixes before the
+        # next wave routes (the steady-state serving shape)
+        for i, p in enumerate(prompts):
+            futs.append(router.submit(p, max_new_tokens=max_new))
+            if (i + 1) % families == 0:
+                for f in futs:
+                    f.result(600)
+                futs = []
+                time.sleep(0.2)
+        for f in futs:
+            f.result(600)
+        dt = time.perf_counter() - t0
+        rsnap = router.stats.snapshot()
+        router.stop()
+        snaps = [m.stats.snapshot() for m in models.values()]
+        for s in servers:
+            s.stop(drain=False)
+        hits = sum(s.get("prefix_hits", 0) for s in snaps)
+        misses = sum(s.get("prefix_misses", 0) for s in snaps)
+        return {
+            "hit_rate": round(hits / max(1, hits + misses), 4),
+            "pages": sum(s.get("pages_allocated", 0) for s in snaps),
+            "pages_reused": sum(s.get("prefix_pages_reused", 0)
+                                for s in snaps),
+            "p50": round(max(s.get("p50_token_ms", 0.0)
+                             for s in snaps), 3),
+            "p99": round(max(s.get("p99_token_ms", 0.0)
+                             for s in snaps), 3),
+            "rps": round(n_requests / dt, 2),
+            "routed": rsnap,
+        }
+
+    aff = run_arm("affinity")
+    rnd = run_arm("random")
+    _emit({
+        "metric": f"fleet_routing_{platform}"
+                  f"_r{n_replicas}_n{n_requests}",
+        "value": aff["hit_rate"],
+        "unit": "hit_rate",
+        "fleet_prefix_hit_rate": aff["hit_rate"],
+        "fleet_prefix_hit_rate_random": rnd["hit_rate"],
+        "fleet_pages_allocated": aff["pages"],
+        "fleet_pages_allocated_random": rnd["pages"],
+        "fleet_pages_reused": aff["pages_reused"],
+        "fleet_affinity_advantage": round(
+            aff["hit_rate"] - rnd["hit_rate"], 4),
+        "fleet_requests_per_s": aff["rps"],
+        "p50_token_ms": aff["p50"],
+        "p99_token_ms": aff["p99"],
+        "routed_affinity": aff["routed"]["routed_affinity"],
+        "routed_least_loaded": aff["routed"]["routed_least_loaded"],
+        "replicas": n_replicas,
+        "requests": n_requests,
+        "families": families,
+        "telemetry": _telemetry_snapshot(),
+        "platform": platform,
+    })
+
+
 def _profiling_bench(platform):
     """BENCH_MODE=profiling: the device-side observability ledger.
 
@@ -1280,6 +1410,8 @@ def main():
         return _passes_bench(jax.devices()[0].platform)
     if os.environ.get("BENCH_MODE", "train") == "decode":
         return _decode_bench(jax.devices()[0].platform)
+    if os.environ.get("BENCH_MODE", "train") == "fleet":
+        return _fleet_bench(jax.devices()[0].platform)
     if os.environ.get("BENCH_MODE", "train") == "fusion":
         return _fusion_bench(jax.devices()[0].platform)
     if os.environ.get("BENCH_MODE", "train") == "sharding":

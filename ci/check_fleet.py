@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Fleet CI gate: the multi-replica control plane, proven with real
+process boundaries.
+
+One warm parent builds a decoder bundle and a single-process
+reference token stream for every probe request; then a FleetRouter
+spawns THREE real replica subprocesses (`python -m
+mxnet_tpu.fleet.replica`) that each restore that one bundle.
+
+Gates:
+
+1. restore cost — every replica's hello reports zero traces and zero
+   XLA compiles (the PR 13 bundle contract, now once per replica);
+2. SIGKILL mid-stream — kill -9 one replica while it streams: every
+   in-flight request completes with tokens BIT-IDENTICAL to the
+   uninterrupted single-process reference (the router rebuilds from
+   its own token record; counter-based sampling does the rest), the
+   death is counted, and the fleet heals back to 3 replicas — whose
+   replacement also restored with zero traces/compiles;
+3. graceful drain — drain one replica mid-stream: same zero-loss,
+   bit-identical completion through the handoff path, and the fleet
+   shrinks by exactly one (drains are deliberate; no heal).
+
+MXNET_EXEC_CACHE_DIR is emptied (see check_fleet.sh) so the bundle
+alone carries each replica's zero-compile restore.
+"""
+import os
+import signal
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+SAMP = {"temperature": 0.8, "top_k": 0, "top_p": 1.0}
+MAX_NEW = 48
+
+
+def _prompts():
+    # two families sharing multi-page prefixes + unique tails
+    heads = [list(range(2, 18)), list(range(30, 46))]
+    return [heads[i % 2] + [50 + i, 51 + i] for i in range(6)]
+
+
+def main():
+    failures = []
+
+    def check(name, ok, detail=""):
+        print(f"  {'ok  ' if ok else 'FAIL'} {name}"
+              + (f" ({detail})" if detail else ""))
+        if not ok:
+            failures.append(name)
+
+    from mxnet_tpu import decoding as dec, fleet, serving
+
+    print("fleet gate: warm parent (bundle + reference streams)")
+    cfg = dec.DecoderConfig(vocab=64, d_model=32, n_layers=2,
+                            n_heads=2, d_ff=64, max_len=128)
+    params = dec.init_decoder_params(cfg, seed=0)
+    reg = serving.ModelRegistry()
+    warm = reg.load_decoder("lm", params, cfg, max_batch=4,
+                            page_size=4, num_pages=64)
+    prompts = _prompts()
+    refs = [warm.generate(p, max_new_tokens=MAX_NEW,
+                          sampling=dict(SAMP, seed=i))
+            for i, p in enumerate(prompts)]
+    # request 0 is the one streamed and interrupted in both phases;
+    # the rest may EOS whenever they like
+    check("kill/drain target streams long enough to interrupt",
+          len(refs[0]) >= 12, f"lens={[len(r) for r in refs]}")
+    work = tempfile.mkdtemp(prefix="mx_fleet_gate_")
+    bundle = os.path.join(work, "lm.bundle")
+    serving.save_bundle(warm, bundle)
+    warm.close()
+
+    print("fleet gate: 3 replica subprocesses, one shared bundle")
+    router = fleet.FleetRouter(bundle, replicas=3, heartbeat_ms=100,
+                               name="gate")
+    router.start(wait=True, timeout=600)
+    try:
+        rows = router.status()["replicas"]
+        check("three replicas up", len(rows) == 3, str(sorted(rows)))
+        for rid, row in sorted(rows.items()):
+            check(f"replica {rid} restored with zero traces",
+                  row["traces"] == 0, f"traces={row['traces']}")
+            check(f"replica {rid} restored with zero compiles",
+                  row["compiles"] == 0, f"compiles={row['compiles']}")
+
+        # ---------------------------------------- SIGKILL mid-stream
+        print("fleet gate: SIGKILL one replica mid-stream")
+        futs = [router.submit(p, max_new_tokens=MAX_NEW,
+                              sampling=dict(SAMP, seed=i))
+                for i, p in enumerate(prompts)]
+        st = futs[0].stream(timeout=300)
+        first = [next(st), next(st)]      # victim is mid-stream NOW
+        with router._lock:
+            pend = router._pending.get(futs[0].mid)
+            victim = (pend.replica_id if pend and pend.replica_id
+                      else next(iter(router._handles)))
+        pid = router.status()["replicas"][victim]["pid"]
+        os.kill(pid, signal.SIGKILL)
+        outs = [first + list(st)] + [f.result(300) for f in futs[1:]]
+        check("zero failed requests across the kill",
+              all(f.exception() is None for f in futs))
+        check("every stream bit-identical to the reference",
+              outs == refs,
+              f"mismatched={[i for i, (o, r) in enumerate(zip(outs, refs)) if o != r]}")
+        snap = router.stats.snapshot()
+        check("the death was counted",
+              snap["replica_deaths"] == 1, str(snap["replica_deaths"]))
+        check("orphans were re-admitted",
+              snap["readmissions"] >= 1, str(snap["readmissions"]))
+
+        router.wait_ready(3, timeout=600)
+        rows = router.status()["replicas"]
+        check("fleet healed back to three replicas",
+              len(rows) == 3 and victim not in rows,
+              str(sorted(rows)))
+        check("replacement replica also restored for free",
+              all(r["traces"] == 0 and r["compiles"] == 0
+                  for r in rows.values()))
+
+        # ---------------------------------------- graceful drain
+        print("fleet gate: graceful drain mid-stream")
+        futs = [router.submit(p, max_new_tokens=MAX_NEW,
+                              sampling=dict(SAMP, seed=i))
+                for i, p in enumerate(prompts)]
+        st = futs[0].stream(timeout=300)
+        first = [next(st)]
+        with router._lock:
+            pend = router._pending.get(futs[0].mid)
+            victim = (pend.replica_id if pend and pend.replica_id
+                      else next(iter(router._handles)))
+        handoffs = router.drain_replica(victim, timeout_ms=0,
+                                        wait=True, timeout=300)
+        outs = [first + list(st)] + [f.result(300) for f in futs[1:]]
+        check("zero failed requests across the drain",
+              all(f.exception() is None for f in futs))
+        check("drained streams bit-identical to the reference",
+              outs == refs,
+              f"mismatched={[i for i, (o, r) in enumerate(zip(outs, refs)) if o != r]}")
+        check("the drain handed off live work",
+              handoffs >= 1, f"handoffs={handoffs}")
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            rows = router.status()["replicas"]
+            if len(rows) == 2 and victim not in rows:
+                break
+            time.sleep(0.2)
+        check("drain shrank the fleet by exactly one (no heal)",
+              len(rows) == 2 and victim not in rows,
+              str(sorted(rows)))
+        check("the drain completed, not escalated",
+              router.ledger.snapshot()["drains_escalated"] == 0)
+    finally:
+        router.stop()
+
+    if failures:
+        print(f"fleet gate: FAIL — {', '.join(failures)}")
+        return 1
+    print("fleet gate: OK — 3 zero-compile replicas off one bundle; "
+          "SIGKILL and graceful drain both zero-loss with "
+          "bit-identical streams")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,51 @@
+#!/usr/bin/env bash
+# Fleet control-plane CI hook (tier-1 safe: CPU backend, local
+# sockets only).
+#
+# 1. Behavioral: tests/test_fleet.py — prefix digests and the
+#    affinity index, autoscaler hysteresis, drain ledger, wire
+#    framing, router routing/death-rebuild/staleness/deadline paths
+#    against fake replicas, the admin protocol + CLI, and the real
+#    in-process drain-handoff bit-identity suite.
+# 2. Runtime gates (ci/check_fleet.py): a 3-replica fleet of REAL
+#    subprocesses off one shared bundle — every replica (and the
+#    healed replacement) restores with 0 traces / 0 compiles;
+#    SIGKILL mid-stream and graceful drain both finish every request
+#    with zero failures and token streams bit-identical to an
+#    uninterrupted single-process reference.
+# 3. Benchmark gate: BENCH_MODE=fleet runs the affinity-vs-random
+#    routing A/B; affinity must strictly win on fleet-wide prefix
+#    hit rate AND on total KV pages allocated for the same traffic.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export PALLAS_AXON_POOL_IPS=
+# replicas must restore from the bundle alone, not an ambient disk
+# exec cache
+export MXNET_EXEC_CACHE_DIR=
+
+python -m pytest tests/test_fleet.py -q -p no:cacheprovider
+
+python ci/check_fleet.py
+
+out=$(BENCH_MODE=fleet BENCH_PLATFORM=cpu python bench.py)
+echo "$out"
+RECORD="$out" python - <<'EOF'
+import json, os
+rec = json.loads(os.environ["RECORD"].strip().splitlines()[-1])
+assert rec.get("unit") == "hit_rate", rec
+aff, rnd = rec["fleet_prefix_hit_rate"], \
+    rec["fleet_prefix_hit_rate_random"]
+assert aff > rnd, (
+    f"affinity routing does not beat random on fleet-wide prefix "
+    f"hit rate: {aff} vs {rnd}")
+pages, pages_rnd = rec["fleet_pages_allocated"], \
+    rec["fleet_pages_allocated_random"]
+assert pages < pages_rnd, (
+    f"affinity routing does not beat random on total pages "
+    f"allocated: {pages} vs {pages_rnd}")
+print(f"fleet bench OK: hit rate {aff} vs {rnd} random, "
+      f"{pages} vs {pages_rnd} pages, advantage "
+      f"{rec['fleet_affinity_advantage']}")
+EOF

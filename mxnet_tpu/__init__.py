@@ -71,6 +71,7 @@ from . import predictor
 from .predictor import Predictor
 from . import serving
 from . import decoding
+from . import fleet
 from . import module
 from . import module as mod
 from . import parallel

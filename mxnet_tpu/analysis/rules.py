@@ -142,6 +142,19 @@ HOT_PATH_MANIFEST = {
     "mxnet_tpu/profiling/timeline.py": (
         "timeline_stats", "aggregate_device_events",
     ),
+    # fleet control plane (PR 17): routing and frame relay sit on
+    # every fleet request and every streamed token; the wire send is
+    # an outbox enqueue and the affinity lookup is pure digest math —
+    # none may fetch, sleep, or wait
+    "mxnet_tpu/fleet/router.py": (
+        "FleetRouter.submit", "FleetRouter._pick_replica",
+        "FleetRouter._load", "FleetRouter._on_message",
+    ),
+    "mxnet_tpu/fleet/replica.py": (
+        "ReplicaWorker._handle_decode", "ReplicaWorker._heartbeat",
+    ),
+    "mxnet_tpu/fleet/affinity.py": "*",
+    "mxnet_tpu/fleet/wire.py": ("Channel.send", "send_frame"),
 }
 
 # Methods that force a host<->device round-trip (MX001).

@@ -48,20 +48,21 @@ from .attention import (get_kernel, get_multi_kernel,
                         paged_attention_lax, paged_attention_pallas)
 from .engine import DecodeEngine
 from .model import DecoderConfig, init_decoder_params, reference_logits
-from .prefix import PrefixCache
+from .prefix import PrefixCache, page_digests
 from .sampling import SamplingParams
 from .scheduler import (ContinuousScheduler, DecodeFuture,
-                        DecodedModel, TokenStream)
+                        DecodedModel, RequestHandedOff, TokenStream)
 from .stats import DecodeStats, decoding_stats, reset_decoding_stats
 
 __all__ = [
     "BlockAllocator", "ContinuousScheduler", "DecodeEngine",
     "DecodeFuture", "DecodeStats", "DecodedModel", "DecoderConfig",
-    "PageError", "PagePoolExhausted", "PrefixCache", "SCRATCH_PAGE",
-    "SamplingParams", "TokenStream", "attention", "blocks", "config",
+    "PageError", "PagePoolExhausted", "PrefixCache",
+    "RequestHandedOff", "SCRATCH_PAGE", "SamplingParams",
+    "TokenStream", "attention", "blocks", "config",
     "decoding_stats", "engine", "get_kernel", "get_multi_kernel",
-    "init_decoder_params", "model", "paged_attention_lax",
-    "paged_attention_pallas", "pages_needed", "prefix",
-    "reference_logits", "reset_decoding_stats", "sampling",
+    "init_decoder_params", "model", "page_digests",
+    "paged_attention_lax", "paged_attention_pallas", "pages_needed",
+    "prefix", "reference_logits", "reset_decoding_stats", "sampling",
     "scheduler", "speculative", "stats",
 ]

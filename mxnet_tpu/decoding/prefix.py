@@ -30,8 +30,37 @@ decodingStats snapshot path).
 """
 from __future__ import annotations
 
+import hashlib
 import itertools
 import threading
+
+
+def _chain(prev, page_tokens):
+    """One link of the page-digest chain: digest over (previous
+    digest, this page's tokens). 8 bytes of blake2b is plenty for an
+    advertisement index (collisions cost one wasted routing choice,
+    never correctness — the cache itself matches exact tokens)."""
+    h = hashlib.blake2b(prev, digest_size=8)
+    for tok in page_tokens:
+        h.update(int(tok).to_bytes(8, "little", signed=True))
+    return h.digest()
+
+
+def page_digests(tokens, page_size):
+    """Chain digests of the page-aligned prefix of `tokens`: entry i
+    summarizes tokens[0 : (i+1)*page_size], and because each entry
+    chains through the previous one, digest equality IS prefix
+    equality (up to hash collision). The fleet router hashes prompts
+    with this same function, so a digest advertised by
+    `PrefixCache.cached_prefixes` matches exactly the prompts whose
+    pages that replica already holds. The trailing partial page is
+    ignored — the cache only ever holds full pages."""
+    t = [int(x) for x in tokens]
+    out, prev = [], b""
+    for i in range(len(t) // page_size):
+        prev = _chain(prev, t[i * page_size:(i + 1) * page_size])
+        out.append(prev.hex())
+    return out
 
 
 class _Node:
@@ -188,6 +217,45 @@ class PrefixCache:
             pass
         with self._lock:
             self.evictions = 0  # shutdown flush is not pool pressure
+
+    # ---------------------------------------------------- advertisement
+    def cached_prefixes(self, max_entries=256):
+        """Page-chain digests of every cached page boundary, hottest
+        subtrees first, capped at `max_entries` — the heartbeat
+        payload a replica advertises to the fleet router. Each entry
+        is the hex chain digest of one page-aligned prefix held by
+        this cache (same chain as `page_digests`, so the router can
+        match prompts against it without seeing any tokens). The list
+        is JSON-ready (plain strings)."""
+        out = []
+        with self._lock:
+            # recency-ordered DFS: when the cap truncates, the cold
+            # tail drops first and hot prefixes stay advertised
+            stack = [(self._root, b"")]
+            while stack and len(out) < max_entries:
+                node, prev = stack.pop()
+                for j in range(len(node.pages)):
+                    if len(out) >= max_entries:
+                        break
+                    p = self.page_size
+                    prev = _chain(prev, node.tokens[j * p:(j + 1) * p])
+                    out.append(prev.hex())
+                kids = sorted(node.children.values(),
+                              key=lambda c: c.stamp)
+                stack.extend((c, prev) for c in kids)
+        return out
+
+    def cache_digest(self):
+        """One hex digest summarizing the whole cached-prefix set —
+        order-independent (sorted before hashing) so it is stable
+        across LRU stamp churn. Replicas send this every heartbeat
+        and only attach the full `cached_prefixes` list when it
+        changes."""
+        entries = self.cached_prefixes(max_entries=1 << 16)
+        h = hashlib.blake2b(digest_size=8)
+        for e in sorted(entries):
+            h.update(bytes.fromhex(e))
+        return h.hexdigest()
 
     # ------------------------------------------------------------ stats
     @property

@@ -64,6 +64,23 @@ from .stats import DecodeStats
 _DONE = object()
 
 
+class RequestHandedOff(ServingError):
+    """Raised into a request's future/stream when a draining decoder
+    hands the request off instead of finishing it locally. `.state`
+    is the JSON-ready resume record (prompt, tokens generated so far,
+    sampling seed + position, remaining deadline) that
+    `admit_resumed` on any other replica accepts — under counter-based
+    sampling the continuation there is bit-identical to the
+    uninterrupted run, so a caller (normally the fleet router) loses
+    nothing but a little latency."""
+
+    def __init__(self, state):
+        super().__init__(
+            "request handed off mid-decode; resume elsewhere with "
+            "admit_resumed(exc.state)")
+        self.state = state
+
+
 class DecodeFuture:
     """Handle for one decode request: both a future and a stream.
 
@@ -244,6 +261,9 @@ class ContinuousScheduler:
         self._order = itertools.count()
         self._closed = False
         self._drain = True
+        self._draining = False         # drain(): admission closed
+        self._handoff = False          # leftovers hand off, not fail
+        self._handoff_states = []      # loop/backstop-thread only
         self._thread = None
 
     # ------------------------------------------------------ public API
@@ -304,7 +324,7 @@ class ContinuousScheduler:
                         if deadline_ms is not None else None)
             fut = DecodeFuture(tid)
             with self._cond:
-                if self._closed:
+                if self._closed or self._draining:
                     raise ServerClosedError("decoder is shut down")
                 if len(self._waiting) >= self.queue_cap:
                     self.stats.note_rejected()
@@ -319,6 +339,115 @@ class ContinuousScheduler:
         self.stats.note_submitted()
         return fut
 
+    def admit_resumed(self, state):
+        """Admit a request handed off by another scheduler's `drain()`
+        (or rebuilt by the fleet router from its own token record
+        after a replica died). The resumed future's STREAM emits only
+        NEW tokens — everything in state["generated"] was already
+        delivered by the original replica — while `result()` returns
+        the full list. Counter-based sampling (token at position P is
+        a pure function of the request seed and P) plus the XLA
+        prefix-stability property make the continuation bit-identical
+        to the uninterrupted run; internally this rides the exact
+        readmission path preemption uses."""
+        prompt = [int(t) for t in state["prompt"]]
+        generated = [int(t) for t in state.get("generated", ())]
+        sp = SamplingParams.resolve(state.get("sampling"), None)
+        sp.validate(self.engine.cfg.vocab)
+        max_new = int(state["max_new_tokens"])
+        if not prompt:
+            raise ServingError("empty prompt in resume state")
+        if any(t < 0 or t >= self.engine.cfg.vocab
+               for t in prompt + generated):
+            raise ServingError("resume state token outside vocab")
+        if len(generated) >= max_new:
+            raise ServingError(
+                "resume state is already at max_new_tokens; nothing "
+                "left to decode")
+        if len(prompt) + len(generated) > self.engine.max_context:
+            raise ServingError(
+                "resume state exceeds the decode context capacity "
+                f"{self.engine.max_context}")
+        use_draft = bool(state.get("draft")) and self.engine.spec_enabled
+        deadline_ms = state.get("deadline_ms")
+        deadline = (time.monotonic() + float(deadline_ms) / 1e3
+                    if deadline_ms is not None else None)
+        tid = _trace.new_trace_id()
+        fut = DecodeFuture(tid)
+        with self._cond:
+            if self._closed or self._draining:
+                raise ServerClosedError("decoder is shut down")
+            if len(self._waiting) >= self.queue_cap:
+                self.stats.note_rejected()
+                raise ServerBusyError(
+                    f"decode queue full ({self.queue_cap}); "
+                    "retry with backoff")
+            seq = _Sequence(prompt, max_new,
+                            int(state.get("priority", 0)), deadline,
+                            fut, tid, next(self._order), sp, use_draft)
+            seq.generated = generated
+            if generated:
+                # the preemption-readmission contract: _admit restores
+                # last_token without re-emitting the replayed token
+                seq.preempted = True
+                seq.last_token = generated[-1]
+            self._waiting.append(seq)
+            self._cond.notify()
+        self.stats.note_submitted()
+        return fut
+
+    def _handoff_state(self, seq, now=None):
+        """JSON-ready resume record for one unfinished sequence (the
+        payload of RequestHandedOff / input of admit_resumed)."""
+        sp = seq.sampling
+        st = {
+            "prompt": list(seq.prompt),
+            "generated": list(seq.generated),
+            "max_new_tokens": seq.max_new,
+            "priority": seq.priority,
+            "position": len(seq.generated),
+            "draft": bool(seq.use_draft),
+            "sampling": {"temperature": sp.temperature,
+                         "top_k": sp.top_k, "top_p": sp.top_p,
+                         "seed": sp.seed},
+        }
+        if seq.deadline is not None:
+            if now is None:
+                now = time.monotonic()
+            st["deadline_ms"] = max(0.0, (seq.deadline - now) * 1e3)
+        return st
+
+    def drain(self, timeout=30):
+        """Graceful shutdown with zero request loss: stop admitting,
+        let live decodes run to completion for up to `timeout`
+        seconds, then hand off whatever is still unfinished — each
+        leftover future resolves with RequestHandedOff carrying the
+        resume record, and the full list of records is returned so a
+        control plane (the fleet router) can re-admit them elsewhere.
+        With timeout=0 everything in flight hands off immediately."""
+        deadline = time.monotonic() + max(0.0, float(timeout))
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+        while time.monotonic() < deadline:
+            with self._cond:
+                busy = bool(self._waiting) or any(
+                    s is not None for s in self._rows)
+            if not busy:
+                break
+            time.sleep(0.01)        # poll outside the lock
+        with self._cond:
+            self._closed = True
+            self._drain = False
+            self._handoff = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+        self._fail_leftovers()
+        if self.cache is not None:
+            self.cache.release_all()
+        return [dict(st) for st in self._handoff_states]
+
     def stop(self, drain=True, timeout=30):
         """Close admission; drain=True finishes in-flight sequences,
         drain=False fails them fast with ServerClosedError."""
@@ -328,10 +457,39 @@ class ContinuousScheduler:
             self._cond.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=timeout)
+        self._fail_leftovers()
         if self.cache is not None:
             # the loop is down: flush the cache's page refs so the
             # pool drains to empty (pages_in_use == 0 after close)
             self.cache.release_all()
+
+    def _fail_leftovers(self):
+        """Backstop against stranded futures: if the loop thread is
+        down (never started, died on a persistent engine error, or
+        outlived its join timeout and then exited) any request still
+        queued or rowed would otherwise wait forever. Sweep them into
+        a terminal state — handoff records when draining, a
+        ServerClosedError otherwise. No-op while the loop is alive
+        (it owns the sweep then)."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        with self._cond:
+            leftovers = self._waiting[:]
+            self._waiting.clear()
+            leftovers.extend(s for s in self._rows if s is not None)
+            handoff = self._handoff
+        for s in leftovers:
+            if s.future.done():
+                continue
+            if handoff:
+                st = self._handoff_state(s)
+                self._handoff_states.append(st)
+                self.stats.note_cancelled()
+                self._resolve(s, exc=RequestHandedOff(st))
+            else:
+                self.stats.note_failed()
+                self._resolve(s, exc=ServerClosedError(
+                    "decoder stopped"))
 
     # ---------------------------------------------------- loop helpers
     def _active(self):
@@ -815,10 +973,20 @@ class ContinuousScheduler:
                         return
             if self._closed and not self._drain:
                 doomed.extend(self._active())
-                for s in doomed:
-                    self.stats.note_failed()
-                    self._resolve(s, exc=ServerClosedError(
-                        "decoder stopped"))
+                if self._handoff:
+                    # drain() timed out with work in flight: every
+                    # leftover resolves with its resume record
+                    now = time.monotonic()
+                    for s in doomed:
+                        st = self._handoff_state(s, now)
+                        self._handoff_states.append(st)
+                        self.stats.note_cancelled()
+                        self._resolve(s, exc=RequestHandedOff(st))
+                else:
+                    for s in doomed:
+                        self.stats.note_failed()
+                        self._resolve(s, exc=ServerClosedError(
+                            "decoder stopped"))
                 return
             try:
                 self._check_deadlines(time.monotonic())
@@ -830,6 +998,21 @@ class ContinuousScheduler:
                 for s in self._active():
                     self.stats.note_failed()
                     self._resolve(s, exc=exc)
+                with self._cond:
+                    bail = self._closed
+                    stranded = self._waiting[:] if bail else []
+                    if bail:
+                        self._waiting.clear()
+                if bail:
+                    # shutting down on a persistently-raising engine:
+                    # spinning admit->fail forever would outlive the
+                    # join timeout and strand the queue — fail it and
+                    # exit (stop()/drain() backstops anything admitted
+                    # between the sweep above and this return)
+                    for s in stranded:
+                        self.stats.note_failed()
+                        self._resolve(s, exc=exc)
+                    return
 
 
 class DecodedModel:
@@ -927,6 +1110,17 @@ class DecodedModel:
                           priority=priority, deadline_ms=deadline_ms,
                           sampling=sampling, seed=seed, draft=draft)
         return fut.stream(timeout=timeout)
+
+    def admit_resumed(self, state):
+        """Admit a handed-off request (see ContinuousScheduler
+        .admit_resumed): returns a DecodeFuture whose stream emits
+        only the tokens not yet delivered elsewhere."""
+        return self.scheduler.admit_resumed(state)
+
+    def drain(self, timeout=30):
+        """Stop admitting, finish live decodes (up to `timeout` s),
+        hand off the rest; returns the handoff records."""
+        return self.scheduler.drain(timeout=timeout)
 
     def close(self, drain=True, timeout=30):
         self.scheduler.stop(drain=drain, timeout=timeout)

@@ -1,0 +1,64 @@
+"""mxnet_tpu.fleet — the multi-replica serving control plane.
+
+One process serves; a fleet SCALES. This package turns the
+single-process serving/decoding stack into N replica worker
+processes behind a router, without giving up any of the properties
+the lower tiers fought for:
+
+  router     FleetRouter — spawns replicas from one shared serving
+             bundle (zero traces/compiles per replica, the PR 13
+             restore contract), routes predict/generate/stream over
+             a length-prefixed JSON control plane, and is the order
+             of record for every in-flight request
+  affinity   AffinityIndex — prefix-affinity routing: prompts hash
+             to page-chain digests (decoding.prefix.page_digests)
+             and land on the replica whose advertised radix cache
+             covers the longest prefix, so the per-process prefix
+             cache becomes a fleet-wide asset
+  replica    ReplicaWorker + the `python -m mxnet_tpu.fleet.replica`
+             entry point: bundle restore, request handler threads,
+             heartbeats (depth + stats + cache digests)
+  autoscale  Autoscaler — queue-depth/p99 thresholds with a
+             hysteresis band and patience (no flapping)
+  drain      DrainLedger + handoff validation — shrink and shutdown
+             go through drain: stop admitting, finish or hand off
+             live decodes, seal, exit. A SIGKILL mid-stream or a
+             blown drain deadline lands in the same re-admission
+             path (the router rebuilds from its own token record),
+             so both are zero-loss and — under counter-based
+             sampling — bit-identical
+  stats      FleetStats -> the `fleetStats` view (routing decisions,
+             handoffs, deaths, autoscale churn, per-replica rows) +
+             Prometheus gauges
+  wire       the framing + Channel discipline (writer-thread outbox,
+             single reader, nothing blocking under a lock)
+  config     MXNET_FLEET_* env knob resolution
+
+    from mxnet_tpu import fleet
+    router = fleet.FleetRouter("./bundle", replicas=3).start()
+    toks = router.generate(prompt, max_new_tokens=64)
+    for tok in router.stream(prompt): ...
+    router.scale(5); router.drain_replica("r0"); router.stop()
+
+CLI: tools/mx_fleet.py (start/status/scale/drain). Guide:
+docs/fleet.md. Knobs: MXNET_FLEET_* (docs/env_vars.md).
+"""
+from . import affinity, autoscale, config, drain, replica, router, \
+    stats, wire
+from .affinity import AffinityIndex
+from .autoscale import Autoscaler
+from .drain import DrainLedger, check_handoff_state
+from .replica import ReplicaWorker
+from .router import FleetFuture, FleetRouter, ReplicaHandle
+from .stats import FleetStats, fleet_stats
+from .wire import Channel, MAX_FRAME, WireError, recv_frame, \
+    send_frame
+
+__all__ = [
+    "AffinityIndex", "Autoscaler", "Channel", "DrainLedger",
+    "FleetFuture", "FleetRouter", "FleetStats", "MAX_FRAME",
+    "ReplicaHandle", "ReplicaWorker", "WireError", "affinity",
+    "autoscale", "check_handoff_state", "config", "drain",
+    "fleet_stats", "recv_frame", "replica", "router", "send_frame",
+    "stats", "wire",
+]

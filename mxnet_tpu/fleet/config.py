@@ -1,0 +1,33 @@
+"""Env-knob resolution for the fleet tier (registered in
+mxnet_tpu.utils so `describe_env()`/docs/env_vars.md cover them).
+
+Resolution order everywhere: explicit constructor argument > MXNET_*
+env var > built-in default (the serving/decoding config convention).
+"""
+from __future__ import annotations
+
+from .. import utils
+
+
+def replicas():
+    return utils.getenv("MXNET_FLEET_REPLICAS")
+
+
+def port():
+    return utils.getenv("MXNET_FLEET_PORT")
+
+
+def heartbeat_ms():
+    return utils.getenv("MXNET_FLEET_HEARTBEAT_MS")
+
+
+def queue_high():
+    return utils.getenv("MXNET_FLEET_QUEUE_HIGH")
+
+
+def queue_low():
+    return utils.getenv("MXNET_FLEET_QUEUE_LOW")
+
+
+def drain_timeout_ms():
+    return utils.getenv("MXNET_FLEET_DRAIN_TIMEOUT_MS")

@@ -113,6 +113,26 @@ class ModelServer:
             self._decoders[model.key] = model
         return model
 
+    def load_bundle(self, path, name=None, version=None, warmup=True):
+        """Restore an AOT serving bundle straight into this server:
+        registry restore (zero traces / zero compiles when
+        env-compatible) plus the server-side wiring — a batching lane
+        for a ServedModel, decoder registration for a DecodedModel.
+        This is how fleet replicas come up: every worker process
+        calls this on the same shared bundle."""
+        with self._lock:
+            if self._closed:
+                raise ServerClosedError("server is stopped")
+        model = self.registry.load_bundle(path, name=name,
+                                          version=version,
+                                          warmup=warmup)
+        if hasattr(model, "spec"):
+            self._start_lane(model)
+        else:
+            with self._lock:
+                self._decoders[model.key] = model
+        return model
+
     def serve(self, model):
         """Attach a lane to an already-registered ServedModel (for a
         registry shared across servers)."""
@@ -237,6 +257,14 @@ class ModelServer:
             deadline_ms=deadline_ms, sampling=sampling, seed=seed,
             draft=draft).stream(timeout=timeout)
 
+    def admit_resumed(self, name, state, version=None):
+        """Admit a handed-off decode request (a record from `drain()`
+        on another server/replica, or one the fleet router rebuilt
+        after a replica died). Returns a DecodeFuture whose stream
+        emits only tokens not yet delivered elsewhere; counter-based
+        sampling makes the continuation bit-identical."""
+        return self._decoder(name, version).admit_resumed(state)
+
     # ---------------------------------------------------------- worker
     def _worker_loop(self, lane):
         model, batcher = lane.model, lane.batcher
@@ -315,6 +343,29 @@ class ModelServer:
                                     model=model.key)
 
     # -------------------------------------------------------- lifecycle
+    def drain(self, timeout=30):
+        """Zero-loss shutdown: stop admitting, let live work finish
+        for up to `timeout` seconds per decoder, hand off the rest.
+        Returns {decoder_key: [handoff records]} — every unfinished
+        decode request's resume state (its future resolves with
+        RequestHandedOff). One-shot lanes have no mid-request state
+        to hand off; their queues drain normally."""
+        with self._lock:
+            self._closed = True
+            lanes = list(self._lanes.values())
+            decoders = list(self._decoders.values())
+        handoffs = {}
+        for dm in decoders:
+            states = dm.drain(timeout=timeout)
+            if states:
+                handoffs[dm.key] = states
+        for lane in lanes:
+            lane.batcher.close()
+        for lane in lanes:
+            if lane.thread is not None:
+                lane.thread.join(timeout=timeout)
+        return handoffs
+
     def stop(self, drain=True, timeout=30):
         """Close admission and shut the workers down. drain=True lets
         queued requests complete; drain=False fails them fast."""

@@ -65,7 +65,7 @@ import pytest  # noqa: E402
 # factories.
 _WITNESS_MODULES = {
     "test_serving", "test_decoding", "test_data_pipeline",
-    "test_telemetry",
+    "test_telemetry", "test_fleet",
 }
 
 

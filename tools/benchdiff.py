@@ -35,6 +35,10 @@ LOWER_IS_BETTER = (
     # its compile bill must only ever shrink
     "warm_wall_s", "restore_wall_s", "restore_frac",
     "restore_traces", "restore_compiles",
+    # BENCH_MODE=fleet: total KV pages the fleet allocated for the
+    # same traffic (affinity arm) — duplicated prefix prefill shows
+    # up here first
+    "fleet_pages_allocated",
 )
 
 # secondary per-record keys where BIGGER is better (work avoided per
@@ -47,6 +51,11 @@ HIGHER_IS_BETTER = (
     # merged ragged step must win on decode throughput
     "groups_lowered", "fused_step_speedup", "merged_decode_speedup",
     "decode_tokens_per_s_merged",
+    # BENCH_MODE=fleet (multi-replica routing A/B): prefix-affinity
+    # routing must keep beating the random baseline on fleet-wide
+    # cache reuse
+    "fleet_prefix_hit_rate", "fleet_affinity_advantage",
+    "fleet_pages_reused", "fleet_requests_per_s",
 )
 
 

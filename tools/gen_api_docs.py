@@ -43,6 +43,8 @@ MODULES = [
     ("mxnet_tpu.serving", "dynamic-batching inference server"),
     ("mxnet_tpu.decoding",
      "continuous-batching autoregressive decode, paged KV cache"),
+    ("mxnet_tpu.fleet",
+     "multi-replica serving control plane (routing, autoscale, drain)"),
     ("mxnet_tpu.analysis", "static analyzer (mxlint) + graph verifier"),
     ("mxnet_tpu.passes", "graph-optimization pass pipeline + autotuner"),
     ("mxnet_tpu.visualization", "network plots/summaries"),

@@ -14,7 +14,7 @@ XLA8    := XLA_FLAGS=--xla_force_host_platform_device_count=8
 	passes-check telemetry-check decode-check race-check \
 	fusion-check \
 	shard-check profiling-check numerics-check coldstart-check \
-	fleet-check bench-diff clean
+	fleet-check quant-check bench-diff clean
 
 all: libs test
 
@@ -163,6 +163,14 @@ coldstart-check:
 # affinity-vs-random routing bench A/B
 fleet-check:
 	$(CPUENV) bash ci/check_fleet.sh
+
+# quantized-serving tier: int8 KV-page test suite, then the runtime
+# gates (greedy top-1 agreement >= 0.9 vs float32, measured pool
+# capacity >= 1.9x, zero steady-state retraces at int8, a
+# quantize="int8" bundle restored in a fresh process at 0 traces /
+# 0 compiles, stripped quantization record refused)
+quant-check:
+	$(CPUENV) bash ci/check_quant.sh
 
 # regression diff of two bench captures (nonzero exit on >10% drops):
 #   make bench-diff OLD=BENCH_r04.json NEW=BENCH_r05.json

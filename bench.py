@@ -694,9 +694,13 @@ def _decode_bench(platform):
     KV-page occupancy, and KV-memory padding waste versus the
     rectangular (batch, max_context) cache a one-shot batcher would
     pin per request — plus a speculative arm (K=4 self-draft)
-    reporting emitted tokens per target step. Gate
-    (ci/check_decode.sh): zero retraces in steady state and paged
-    waste strictly below rectangular."""
+    reporting emitted tokens per target step — plus an int8 KV-page
+    arm: same traffic through a kv_dtype="int8" model for throughput,
+    and a teacher-forced parity probe for `kv_pool_capacity_ratio`
+    (sequences-per-pool vs float32), greedy top-1 agreement, and
+    logit drift. Gates: zero retraces in steady state and paged waste
+    strictly below rectangular (ci/check_decode.sh); capacity >= 1.9x
+    with top-1 agreement in tolerance (ci/check_quant.sh)."""
     import numpy as np
 
     import mxnet_tpu as mx
@@ -763,6 +767,27 @@ def _decode_bench(platform):
     spec_snap = spec_model.stats.snapshot()
     spec_model.close()
 
+    # int8 KV-page arm: throughput at quantized precision + the
+    # teacher-forced parity probe (agreement/drift/capacity oracle)
+    q_model = dec.DecodedModel(
+        "bench-int8", 1, params, cfg, max_batch=8,
+        page_size=page_size, num_pages=128, page_buckets=(1, 2, 4, 8),
+        queue_cap=max(256, n_requests), max_tokens=max_new,
+        kv_dtype="int8")
+    q_floor = q_model.engine.traces()
+    qt0 = time.perf_counter()
+    qfuts = [q_model.submit(p, max_new_tokens=max_new)
+             for p in prompts]
+    for f in qfuts:
+        f.result(600)
+    q_dt = time.perf_counter() - qt0
+    q_traces = q_model.engine.traces() - q_floor
+    q_snap = q_model.stats.snapshot()
+    q_model.close()
+    probe = dec.quant_parity_probe(
+        params, cfg, prompt=prompts[0], max_new=max_new,
+        page_size=page_size, num_pages=32, kv_dtype="int8")
+
     _emit({
         "metric": f"decode_throughput_{platform}"
                   f"_b8_p{page_size}_n{n_requests}",
@@ -787,7 +812,16 @@ def _decode_bench(platform):
         "spec_tokens_per_target_step":
             spec_snap["tokens_per_target_step"],
         "spec_acceptance_rate": spec_snap["spec_acceptance_rate"],
-        "traces_added": traces_added + spec_traces,
+        "decode_tokens_per_s_int8": q_snap["decode_tokens_per_s"],
+        "int8_requests_per_s": round(n_requests / q_dt, 2),
+        "kv_pool_capacity_ratio": probe["kv_pool_capacity_ratio"],
+        "kv_bytes_per_token_float32":
+            probe["kv_bytes_per_token_float32"],
+        "kv_bytes_per_token_int8": probe["kv_bytes_per_token_quant"],
+        "int8_top1_agreement": probe["top1_agreement"],
+        "int8_logit_drift": probe["logit_drift_max"],
+        "int8_quant_clip_values": q_snap["quant_clip_values"],
+        "traces_added": traces_added + spec_traces + q_traces,
         "traces_since_warmup": snap["traces_since_warmup"],
         "requests": n_requests,
         "telemetry": _telemetry_snapshot(),

@@ -121,7 +121,12 @@ HOT_PATH_MANIFEST = {
         "DecodeStats.note_step", "DecodeStats.note_prefill",
         "DecodeStats.note_preempted", "DecodeStats.note_pool",
         "DecodeStats.note_spec", "DecodeStats.note_prefix_reuse",
+        "DecodeStats.note_quant_clips",
     ),
+    # KV quantization (quant PR): quantize-at-scatter / dequantize-at-
+    # gather run INSIDE the jitted prefill/decode/attention programs —
+    # pure jax ops on traced values, never a fetch or a retrace
+    "mxnet_tpu/decoding/quant.py": "*",
     # sharding plan resolution + jit lowering (PR 11): resolve/digest
     # run inside every bind (ahead of the exec-cache lookup) and the
     # lower helpers run inside the fused-step trace — metadata only,

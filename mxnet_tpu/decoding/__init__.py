@@ -21,6 +21,10 @@ package applies the Ragged Paged Attention recipe (PAPERS.md) instead:
   prefix     PrefixCache — radix index over cached prompt KV pages;
              admission maps shared prefixes via the fork path and
              prefills only the tail
+  quant      precision-polymorphic page pools (KVPool pytree):
+             int8 pages with per-page scale planes, quantized at
+             scatter and dequantized in-kernel
+             (MXNET_DECODE_KV_DTYPE=float32|bf16|int8)
   sampling   SamplingParams + the (seed, position, salt) counter
              streams: temperature/top-k/top-p inside the jitted step,
              bit-reproducible across preemption
@@ -41,12 +45,13 @@ Knobs: MXNET_DECODE_* (docs/env_vars.md). Guide: docs/serving.md
 ("Continuous decoding").
 """
 from . import attention, blocks, config, engine, model, prefix, \
-    sampling, scheduler, speculative, stats
+    quant, sampling, scheduler, speculative, stats
 from .blocks import (SCRATCH_PAGE, BlockAllocator, PageError,
                      PagePoolExhausted, pages_needed)
 from .attention import (get_kernel, get_multi_kernel,
                         paged_attention_lax, paged_attention_pallas)
-from .engine import DecodeEngine
+from .engine import DecodeEngine, quant_parity_probe
+from .quant import KVPool
 from .model import DecoderConfig, init_decoder_params, reference_logits
 from .prefix import PrefixCache, page_digests
 from .sampling import SamplingParams
@@ -57,12 +62,13 @@ from .stats import DecodeStats, decoding_stats, reset_decoding_stats
 __all__ = [
     "BlockAllocator", "ContinuousScheduler", "DecodeEngine",
     "DecodeFuture", "DecodeStats", "DecodedModel", "DecoderConfig",
-    "PageError", "PagePoolExhausted", "PrefixCache",
+    "KVPool", "PageError", "PagePoolExhausted", "PrefixCache",
     "RequestHandedOff", "SCRATCH_PAGE", "SamplingParams",
     "TokenStream", "attention", "blocks", "config",
     "decoding_stats", "engine", "get_kernel", "get_multi_kernel",
     "init_decoder_params", "model", "page_digests",
     "paged_attention_lax", "paged_attention_pallas", "pages_needed",
-    "prefix", "reference_logits", "reset_decoding_stats", "sampling",
-    "scheduler", "speculative", "stats",
+    "prefix", "quant", "quant_parity_probe", "reference_logits",
+    "reset_decoding_stats", "sampling", "scheduler", "speculative",
+    "stats",
 ]

@@ -4,7 +4,9 @@ KV pool (the device half of Ragged Paged Attention, PAPERS.md).
 Contract shared by both kernels:
 
   q           (B, H, D)        one query token per batch row
-  k_pages     (N, P, H, D)     the pool (one layer's K pages)
+  k_pages     (N, P, H, D)     the pool (one layer's K pages) — a raw
+                               float array or a quant.KVPool, whose
+                               int8 pages dequantize INSIDE the kernel
   v_pages     (N, P, H, D)     the pool (one layer's V pages)
   page_table  (B, Bp) int32    per-row page ids, seq-ordered; padding
                                entries point at the scratch page 0
@@ -40,6 +42,8 @@ import math
 import jax
 import jax.numpy as jnp
 
+from . import quant as _quant
+
 NEG_INF = -1e30
 
 
@@ -59,15 +63,18 @@ def _check_shapes(q, k_pages, v_pages, page_table, lengths):
 def paged_attention_lax(q, k_pages, v_pages, page_table, lengths,
                         scale=None):
     """Gather-based reference kernel (see module docstring)."""
+    k_pages = _quant.as_pool(k_pages)
+    v_pages = _quant.as_pool(v_pages)
     b, h, d, _, p, bp = _check_shapes(
         q, k_pages, v_pages, page_table, lengths)
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     t = bp * p
     # (B, Bp, P, H, D) -> (B, T, H, D): pages are seq-ordered, so the
-    # flattened axis IS the token axis (positions >= length masked)
-    k_ctx = k_pages[page_table].reshape(b, t, h, d)
-    v_ctx = v_pages[page_table].reshape(b, t, h, d)
+    # flattened axis IS the token axis (positions >= length masked);
+    # gather_ctx dequantizes only the gathered pages, never the pool
+    k_ctx = _quant.gather_ctx(k_pages, page_table).reshape(b, t, h, d)
+    v_ctx = _quant.gather_ctx(v_pages, page_table).reshape(b, t, h, d)
     s = jnp.einsum("bhd,bthd->bht", q, k_ctx,
                    preferred_element_type=jnp.float32) * scale
     mask = jnp.arange(t)[None, :] < lengths[:, None]
@@ -96,6 +103,8 @@ def paged_attention_lax_multi(q, k_pages, v_pages, page_table,
     speculative verify step (queries = last_token + K drafts). Shapes
     are a function of (B, S, pages bucket) only.
     """
+    k_pages = _quant.as_pool(k_pages)
+    v_pages = _quant.as_pool(v_pages)
     b, s, h, d = q.shape
     n, p, hh, dd = k_pages.shape
     if (hh, dd) != (h, d):
@@ -106,8 +115,8 @@ def paged_attention_lax_multi(q, k_pages, v_pages, page_table,
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     t = page_table.shape[1] * p
-    k_ctx = k_pages[page_table].reshape(b, t, h, d)
-    v_ctx = v_pages[page_table].reshape(b, t, h, d)
+    k_ctx = _quant.gather_ctx(k_pages, page_table).reshape(b, t, h, d)
+    v_ctx = _quant.gather_ctx(v_pages, page_table).reshape(b, t, h, d)
     sc = jnp.einsum("bshd,bthd->bhst", q, k_ctx,
                     preferred_element_type=jnp.float32) * scale
     mask = (jnp.arange(t)[None, None, :]
@@ -165,30 +174,92 @@ def _paged_attn_kernel(page_size):
     return kernel
 
 
+def _paged_attn_kernel_int8(page_size):
+    """Quantized twin of `_paged_attn_kernel`: two extra scale refs
+    (one per K/V page, gathered by the SAME page-table index maps)
+    dequantize each int8 page as it lands in VMEM — the pool is never
+    upcast in HBM, which is the whole point of int8 pages."""
+    from jax.experimental import pallas as pl
+
+    def kernel(pt_ref, len_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref,
+               o_ref, acc_ref, m_ref, l_ref):
+        i = pl.program_id(1)
+        nbp = pl.num_programs(1)
+        b = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _init():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+            m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+            l_ref[...] = jnp.zeros_like(l_ref)
+
+        qb = q_ref[0].astype(jnp.float32)          # (H, D)
+        # per-(slot, head) dequant: (P, H, D) int8 * (P, H, 1) f32
+        kb = k_ref[0].astype(jnp.float32) * ks_ref[0][..., None]
+        vb = v_ref[0].astype(jnp.float32) * vs_ref[0][..., None]
+        scale = 1.0 / math.sqrt(qb.shape[-1])
+        s = jnp.einsum("hd,phd->hp", qb, kb) * scale   # (H, P)
+        pos = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        valid = pos < len_ref[b]
+        s = jnp.where(valid, s, NEG_INF)
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        e = jnp.exp(s - m_new)                          # (H, P)
+        l_new = l_prev * corr + e.sum(axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jnp.einsum(
+            "hp,phd->hd", e, vb)
+        m_ref[...] = m_new
+        l_ref[...] = l_new
+
+        @pl.when(i == nbp - 1)
+        def _flush():
+            o_ref[0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+
+    return kernel
+
+
 def paged_attention_pallas(q, k_pages, v_pages, page_table, lengths,
                            scale=None):
     """Flash-style paged kernel; page ids drive the K/V block index
     maps through scalar prefetch, so only the pages a row actually
-    owns ever move HBM->VMEM."""
+    owns ever move HBM->VMEM. Quantized pools route through the int8
+    kernel body, whose scale planes ride the same index maps."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    k_pages = _quant.as_pool(k_pages)
+    v_pages = _quant.as_pool(v_pages)
     b, h, d, _, p, bp = _check_shapes(
         q, k_pages, v_pages, page_table, lengths)
     if scale is not None and not math.isclose(
             scale, 1.0 / math.sqrt(d)):
         raise ValueError(
             "pallas kernel hard-codes scale=1/sqrt(head_dim)")
+    quantized = k_pages.scale is not None
+
+    def page_spec(bs):
+        return pl.BlockSpec(
+            bs, lambda bb, i, pt, ln: (pt[bb, i],) + (0,) * (len(bs) - 1))
+
+    in_specs = [
+        pl.BlockSpec((1, h, d), lambda bb, i, pt, ln: (bb, 0, 0)),
+        page_spec((1, p, h, d)),
+    ]
+    operands = [q, k_pages.data]
+    if quantized:
+        in_specs.append(page_spec((1, p, h)))
+        operands.append(k_pages.scale)
+    in_specs.append(page_spec((1, p, h, d)))
+    operands.append(v_pages.data)
+    if quantized:
+        in_specs.append(page_spec((1, p, h)))
+        operands.append(v_pages.scale)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,   # page_table, lengths
         grid=(b, bp),
-        in_specs=[
-            pl.BlockSpec((1, h, d), lambda bb, i, pt, ln: (bb, 0, 0)),
-            pl.BlockSpec((1, p, h, d),
-                         lambda bb, i, pt, ln: (pt[bb, i], 0, 0, 0)),
-            pl.BlockSpec((1, p, h, d),
-                         lambda bb, i, pt, ln: (pt[bb, i], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, h, d), lambda bb, i, pt, ln: (bb, 0, 0)),
         scratch_shapes=[
@@ -197,13 +268,15 @@ def paged_attention_pallas(q, k_pages, v_pages, page_table, lengths,
             pltpu.VMEM((h, 1), jnp.float32),
         ],
     )
+    body = (_paged_attn_kernel_int8(p) if quantized
+            else _paged_attn_kernel(p))
     fn = pl.pallas_call(
-        _paged_attn_kernel(p),
+        body,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
         interpret=jax.default_backend() == "cpu",
     )
-    return fn(page_table, lengths, q, k_pages, v_pages)
+    return fn(page_table, lengths, *operands)
 
 
 # ---------------------------------------------------------------- ragged

@@ -39,6 +39,12 @@ def merged_step():
     return bool(utils.getenv("MXNET_DECODE_MERGED_STEP"))
 
 
+def kv_dtype():
+    # KV-page storage precision: float32 | bf16 | int8 (fp8 reserved);
+    # validated/normalized by decoding.quant.canonical at engine build
+    return str(utils.getenv("MXNET_DECODE_KV_DTYPE") or "float32")
+
+
 def ring_prefill():
     return utils.getenv("MXNET_DECODE_RING_PREFILL")
 

@@ -52,6 +52,7 @@ from ..serving.batcher import pick_bucket
 from . import config as _cfg
 from . import attention as _attn
 from . import model as _model
+from . import quant as _quant
 from . import speculative as _spec
 
 # warn-once latch for calibration-harvest failures (the serving
@@ -65,8 +66,14 @@ class DecodeEngine:
     def __init__(self, params, cfg, *, max_batch=None, page_size=None,
                  num_pages=None, page_buckets=None, kernel=None,
                  ring_prefill=None, draft_params=None, draft_cfg=None,
-                 spec_k=None, prefix_cache=None, merged_step=None):
+                 spec_k=None, prefix_cache=None, merged_step=None,
+                 kv_dtype=None):
         self.cfg = cfg
+        # KV storage precision (MXNET_DECODE_KV_DTYPE): the page pools
+        # — target AND draft — store at this dtype; int8 pools carry
+        # per-(slot, head) scale planes through every pytree hop
+        self.kv_dtype = _quant.canonical(
+            kv_dtype if kv_dtype is not None else _cfg.kv_dtype())
         self.max_batch = max_batch if max_batch is not None \
             else _cfg.max_batch()
         self.page_size = page_size if page_size is not None \
@@ -103,8 +110,8 @@ class DecodeEngine:
         self._params = jax.tree_util.tree_map(jnp.asarray, dict(params))
         shape = (cfg.n_layers, self.num_pages, self.page_size,
                  cfg.n_heads, cfg.head_dim)
-        self._k = jnp.zeros(shape, jnp.float32)
-        self._v = jnp.zeros(shape, jnp.float32)
+        self._k = _quant.make_pool(shape, self.kv_dtype)
+        self._v = _quant.make_pool(shape, self.kv_dtype)
         self.prefix_cache_enabled = prefix_cache if prefix_cache \
             is not None else _cfg.prefix_cache()
         self.spec_k = int(spec_k) if spec_k is not None \
@@ -127,8 +134,8 @@ class DecodeEngine:
                 jnp.asarray, dict(draft_params))
             dshape = (dcfg.n_layers, self.num_pages, self.page_size,
                       dcfg.n_heads, dcfg.head_dim)
-            self._dk = jnp.zeros(dshape, jnp.float32)
-            self._dv = jnp.zeros(dshape, jnp.float32)
+            self._dk = _quant.make_pool(dshape, self.kv_dtype)
+            self._dv = _quant.make_pool(dshape, self.kv_dtype)
         # merged ragged step (MXNET_DECODE_MERGED_STEP): prefix-cache
         # tail-prefill tokens ride the decode step as extra rows
         # through the ragged paged kernel — the per-length-bucket tail
@@ -174,7 +181,8 @@ class DecodeEngine:
             (cfg, self.max_batch, self.page_size, self.num_pages,
              self.kernel_name, self.draft_cfg,
              self.spec_k if self.spec_enabled else 0,
-             self.step_rows if self.merged_step_enabled else 0)
+             self.step_rows if self.merged_step_enabled else 0,
+             self.kv_dtype)
         ).encode()).hexdigest()[:12]
 
     def _instrument(self, fn, kind):
@@ -218,6 +226,11 @@ class DecodeEngine:
 
     def pool_stats(self):
         st = self.allocator.stats()
+        # measured K+V bytes per pooled token position (scale planes
+        # included): the float32/int8 ratio of this number is the
+        # capacity multiplier BENCH_MODE=decode and quant-check report
+        per_tok = (_quant.kv_bytes_per_token(self._k)
+                   + _quant.kv_bytes_per_token(self._v))
         return {
             "pages_total": st["pages_total"],
             "pages_free": st["pages_free"],
@@ -225,6 +238,10 @@ class DecodeEngine:
                 st["pages_in_use"] / max(1, st["pages_total"]), 4),
             "free_low_watermark": st["free_low_watermark"],
             "pages_allocated": st["pages_allocated"],
+            "kv_dtype": self.kv_dtype,
+            "kv_bytes_per_token": round(per_tok, 2),
+            "pool_capacity_tokens": (self.num_pages - 1)
+            * self.page_size,
         }
 
     def _note_trace(self, name):
@@ -236,7 +253,7 @@ class DecodeEngine:
     _GUARD_CAP = 1024  # device scalars between drains
 
     def _run_decode(self, fn, *args):
-        """Dispatch one decode program; absorb the guard scalar (still
+        """Dispatch one decode program; absorb the guard vector (still
         on device — zero sync) when the guard is enabled."""
         res = fn(*args)
         if not self._guard:
@@ -249,17 +266,20 @@ class DecodeEngine:
         return out
 
     def drain_guard(self):
-        """Pending nonfinite-logit counts -> host in ONE blocking fetch
+        """Pending guard vectors -> host in ONE blocking fetch
         (counted in hostSyncStats); [] (no fetch) when empty or the
-        guard is off. The scheduler drains on an interval and feeds
-        nonzero counts into DecodeStats (`decodingStats` view)."""
+        guard is off. Each entry is an (nonfinite_rows, quant_clips)
+        pair per drained step — NaN/Inf logit rows and dequant-
+        overflow clip events of the step's quantized K/V writes. The
+        scheduler drains on an interval and feeds nonzero counts into
+        DecodeStats (`decodingStats` view)."""
         if not self._guard_pending:
             return []
         pending, self._guard_pending = self._guard_pending, []
         host = jax.device_get(pending)
         _profiler.count_host_sync("blocking_fetches")
         _profiler.count_host_sync("metric_fetches")
-        return [int(v) for v in host]
+        return [(int(v[0]), int(v[1])) for v in host]
 
     # -------------------------------------------------------- builders
     def _build_decode_fn(self, bucket):
@@ -358,9 +378,18 @@ class DecodeEngine:
                                 f"verify@{bucket}")
 
     def _build_copy_fn(self):
+        # the pool argument is a quant.KVPool pytree: ONE traced
+        # program moves data AND scale plane together, so COW copies
+        # can never split a page from its scales. K and V share the
+        # pytree structure — still a single trace, like the bare-array
+        # version this replaces.
         def impl(pool, src, dst):
             self._note_trace("copy_page")
-            return pool.at[:, dst].set(pool[:, src])
+            data = pool.data.at[:, dst].set(pool.data[:, src])
+            if pool.scale is None:
+                return _quant.KVPool(data, None)
+            scale = pool.scale.at[:, dst].set(pool.scale[:, src])
+            return _quant.KVPool(data, scale)
 
         donate = (0,) if self._donate else ()
         return self._instrument(jax.jit(impl, donate_argnums=donate),
@@ -643,6 +672,117 @@ class DecodeEngine:
 
     # ----------------------------------------------------- test hooks
     def read_page(self, layer, page):
-        """Host copy of one page's (K, V) — test/debug only."""
-        return (np.asarray(self._k[layer, page]),
-                np.asarray(self._v[layer, page]))
+        """Host copy of one page's (K, V), dequantized to float32 —
+        test/debug only (the hot paths never materialize this)."""
+        return (np.asarray(_quant.dequant_page(self._k, layer, page)),
+                np.asarray(_quant.dequant_page(self._v, layer, page)))
+
+    def read_page_raw(self, layer, page):
+        """Host copy of one page's stored (K, V, k_scale, v_scale) —
+        the bit-level view quantization tests compare (scale entries
+        are None on non-int8 pools)."""
+        k, v = self._k, self._v
+        return (np.asarray(k.data[layer, page]),
+                np.asarray(v.data[layer, page]),
+                None if k.scale is None
+                else np.asarray(k.scale[layer, page]),
+                None if v.scale is None
+                else np.asarray(v.scale[layer, page]))
+
+    def probe_logits(self, tokens, page_table, lengths, active):
+        """Eager (un-jitted) logits of one decode step over the
+        CURRENT pool state, discarding the step's K/V writes — the
+        drift oracle bench/CI use to compare kv dtypes position by
+        position under teacher forcing. Adds zero traces (nothing is
+        jitted) and never mutates the pools."""
+        attn = (_attn.get_ragged_kernel(self.kernel_name)
+                if self.merged_step_enabled else self._attn)
+        logits, _k, _v, _c = _model.decode_logits(
+            self._params, jnp.asarray(tokens, jnp.int32), self._k,
+            self._v, jnp.asarray(page_table, jnp.int32),
+            jnp.asarray(lengths, jnp.int32), jnp.asarray(active, bool),
+            cfg=self.cfg, attn=attn)
+        return np.asarray(logits, np.float32)
+
+
+def quant_parity_probe(params, cfg, prompt, max_new=16, *,
+                       kv_dtype="int8", page_size=None, num_pages=None,
+                       page_buckets=None, kernel=None):
+    """Teacher-forced A/B of one greedy decode at float32 vs
+    `kv_dtype`: the float32 arm's token stream is replayed through
+    BOTH engines token by token, so every step compares the two
+    precisions over IDENTICAL context (a free-running comparison
+    would stop counting at the first divergence, understating
+    agreement). The drift/agreement oracle behind BENCH_MODE=decode's
+    quantization keys, ci/check_quant.py, and tests/test_quant.py.
+
+    Returns a dict: `top1_agreement` (fraction of positions where the
+    quantized argmax matches float32's), `logit_drift_max` /
+    `logit_drift_mean` (abs logit gap via `probe_logits`),
+    `kv_pool_capacity_ratio` (measured bytes-per-token ratio),
+    `retraces` (quantized arm's post-warmup traces — must be 0), and
+    `tokens` (the float32 greedy stream)."""
+    names = ("float32", kv_dtype)
+    engines, tables, firsts = {}, {}, {}
+    for name in names:
+        engines[name] = DecodeEngine(
+            params, cfg, max_batch=1, page_size=page_size,
+            num_pages=num_pages, page_buckets=page_buckets,
+            kernel=kernel, prefix_cache=False, merged_step=False,
+            kv_dtype=name).warmup()
+    ref, alt = engines["float32"], engines[kv_dtype]
+    prompt = [int(t) for t in prompt]
+    total = len(prompt) + int(max_new)
+    if total > ref.max_context:
+        raise PageError(
+            f"probe needs {total} tokens > context capacity "
+            f"{ref.max_context}")
+    need = pages_needed(total, ref.page_size)
+    bucket = pick_bucket(need, ref.page_buckets)
+    p_need = pages_needed(len(prompt), ref.page_size)
+    for name in names:
+        tables[name] = engines[name].allocator.alloc(need)
+        # prefill sees only the prompt-covering prefix of the table
+        # (its program sizes page slots by the prompt length bucket);
+        # decode steps use the full `need`-page table below
+        firsts[name] = engines[name].prefill(
+            prompt, tables[name][:p_need])
+    floor = {name: engines[name].traces() for name in names}
+    agree = 1 if firsts[kv_dtype] == firsts["float32"] else 0
+    n_cmp = 1
+    drift_max, drift_sum = 0.0, 0.0
+    tok = firsts["float32"]
+    tokens_out = [tok]
+    for t in range(int(max_new) - 1):
+        length = len(prompt) + t
+        lg, out = {}, {}
+        for name in names:
+            tbl = np.full((1, bucket), SCRATCH_PAGE, np.int32)
+            tbl[0, :need] = tables[name]
+            lg[name] = engines[name].probe_logits(
+                np.array([tok], np.int32), tbl,
+                np.array([length], np.int32),
+                np.array([True], bool))[0]
+            out[name] = int(engines[name].step(
+                [tok], tbl, [length], [True])[0])
+        gap = np.abs(lg[kv_dtype] - lg["float32"])
+        drift_max = max(drift_max, float(gap.max()))
+        drift_sum += float(gap.mean())
+        agree += 1 if out[kv_dtype] == out["float32"] else 0
+        n_cmp += 1
+        tok = out["float32"]
+        tokens_out.append(tok)
+    ref_bpt = ref.pool_stats()["kv_bytes_per_token"]
+    alt_bpt = alt.pool_stats()["kv_bytes_per_token"]
+    return {
+        "kv_dtype": kv_dtype,
+        "top1_agreement": round(agree / n_cmp, 4),
+        "positions_compared": n_cmp,
+        "logit_drift_max": round(drift_max, 6),
+        "logit_drift_mean": round(drift_sum / max(1, n_cmp - 1), 6),
+        "kv_pool_capacity_ratio": round(ref_bpt / alt_bpt, 4),
+        "kv_bytes_per_token_float32": ref_bpt,
+        "kv_bytes_per_token_quant": alt_bpt,
+        "retraces": alt.traces() - floor[kv_dtype],
+        "tokens": tokens_out,
+    }

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import quant as _quant
 from . import sampling as _sampling
 from .blocks import SCRATCH_PAGE
 
@@ -163,7 +164,9 @@ def prefill_forward(params, tokens, length, k_pages, v_pages,
     given, greedy argmax otherwise.
     """
     scale = 1.0 / math.sqrt(cfg.head_dim)
-    page_size = k_pages.shape[2]
+    k_pages = _quant.as_pool(k_pages)
+    v_pages = _quant.as_pool(v_pages)
+    page_size = k_pages.page_size
     _, t = tokens.shape
     pos = jnp.arange(t)
     # per-position scatter targets: (page, slot) through the table,
@@ -176,8 +179,10 @@ def prefill_forward(params, tokens, length, k_pages, v_pages,
     for i in range(cfg.n_layers):
         h1 = _rms(x, params[f"l{i}.ln1"])
         q, k, v = _qkv(params, i, h1, cfg)
-        k_pages = k_pages.at[i, tgt_pages, slots].set(k[0])
-        v_pages = v_pages.at[i, tgt_pages, slots].set(v[0])
+        k_pages, _ = _quant.kv_scatter(k_pages, i, tgt_pages, slots,
+                                       k[0])
+        v_pages, _ = _quant.kv_scatter(v_pages, i, tgt_pages, slots,
+                                       v[0])
         if attn_fn is None:
             o = _dense_causal_attention(q, k, v, scale)
         else:
@@ -209,7 +214,9 @@ def tail_prefill_forward(params, tokens, start, length, k_pages,
     (shared prefix + just-written tail) with per-query causal masks —
     FLOPs scale with tail x context instead of prompt^2.
     """
-    page_size = k_pages.shape[2]
+    k_pages = _quant.as_pool(k_pages)
+    v_pages = _quant.as_pool(v_pages)
+    page_size = k_pages.page_size
     _, t = tokens.shape
     cap = page_ids.shape[0] * page_size
     pos = start + jnp.arange(t)                      # absolute
@@ -224,10 +231,12 @@ def tail_prefill_forward(params, tokens, start, length, k_pages,
     for i in range(cfg.n_layers):
         h1 = _rms(x, params[f"l{i}.ln1"])
         q, k, v = _qkv(params, i, h1, cfg)
-        k_pages = k_pages.at[i, tgt_pages, slots].set(k[0])
-        v_pages = v_pages.at[i, tgt_pages, slots].set(v[0])
-        o = attn_multi(q, k_pages[i], v_pages[i], page_ids[None],
-                       pos_safe[None])
+        k_pages, _ = _quant.kv_scatter(k_pages, i, tgt_pages, slots,
+                                       k[0])
+        v_pages, _ = _quant.kv_scatter(v_pages, i, tgt_pages, slots,
+                                       v[0])
+        o = attn_multi(q, k_pages.layer(i), v_pages.layer(i),
+                       page_ids[None], pos_safe[None])
         x = x + o.reshape(1, t, cfg.d_model) @ params[f"l{i}.wo"]
         x = x + _mlp(params, i, _rms(x, params[f"l{i}.ln2"]))
     x = _rms(x, params["ln_f"])
@@ -242,9 +251,14 @@ def decode_logits(params, tokens, k_pages, v_pages, page_table,
                   lengths, active, *, cfg, attn):
     """The shared decode-step body: embed each row's last token, append
     its K/V at index `lengths` through the page table, attend over the
-    pages, return (logits (B, V), k_pages, v_pages). decode_forward and
-    the speculative draft proposer both build on this."""
-    page_size = k_pages.shape[2]
+    pages, return (logits (B, V), k_pages, v_pages, clips). `clips` is
+    the summed dequant-overflow clip count of this step's quantized
+    K/V writes (always 0 for float pools — and for healthy int8 ones;
+    see quant.quantize_values). decode_forward and the speculative
+    draft proposer both build on this."""
+    k_pages = _quant.as_pool(k_pages)
+    v_pages = _quant.as_pool(v_pages)
+    page_size = k_pages.page_size
     b = tokens.shape[0]
     bp = page_table.shape[1]
     rows = jnp.arange(b)
@@ -256,18 +270,21 @@ def decode_logits(params, tokens, k_pages, v_pages, page_table,
     slots = lengths % page_size
     ctx_len = jnp.where(active, lengths + 1, 1)
 
+    clips = jnp.int32(0)
     x = params["embed"][tokens] + params["pos"][
         jnp.clip(lengths, 0, cfg.max_len - 1)]
     for i in range(cfg.n_layers):
         h1 = _rms(x, params[f"l{i}.ln1"])
         q, k, v = _qkv(params, i, h1, cfg)
-        k_pages = k_pages.at[i, w_pages, slots].set(k)
-        v_pages = v_pages.at[i, w_pages, slots].set(v)
-        o = attn(q, k_pages[i], v_pages[i], page_table, ctx_len)
+        k_pages, ck = _quant.kv_scatter(k_pages, i, w_pages, slots, k)
+        v_pages, cv = _quant.kv_scatter(v_pages, i, w_pages, slots, v)
+        clips = clips + ck + cv
+        o = attn(q, k_pages.layer(i), v_pages.layer(i), page_table,
+                 ctx_len)
         x = x + o.reshape(b, cfg.d_model) @ params[f"l{i}.wo"]
         x = x + _mlp(params, i, _rms(x, params[f"l{i}.ln2"]))
     x = _rms(x, params["ln_f"])
-    return x @ params["embed"].T, k_pages, v_pages
+    return x @ params["embed"].T, k_pages, v_pages, clips
 
 
 def decode_forward(params, tokens, k_pages, v_pages, page_table,
@@ -283,11 +300,13 @@ def decode_forward(params, tokens, k_pages, v_pages, page_table,
     per row on its (seed, position=lengths+1) stream (temperature 0 =
     exact greedy); without them it is the argmax (PR 8 behavior).
     Returns (next_tokens (B,), k_pages, v_pages); with_stats=True
-    (the MXNET_NUMERICS_DECODE_GUARD path) appends a scalar count of
-    ACTIVE rows whose logits hold any NaN/Inf — computed inside the
-    jit, so the guard adds zero host syncs to the step.
+    (the MXNET_NUMERICS_DECODE_GUARD path) appends a (2,) int32
+    vector [nonfinite_rows, quant_clips]: ACTIVE rows whose logits
+    hold any NaN/Inf, and K/V values this step's quantized writes had
+    to clip (dequant-overflow events — 0 on float pools). Both are
+    computed inside the jit, so the guard adds zero host syncs.
     """
-    logits, k_pages, v_pages = decode_logits(
+    logits, k_pages, v_pages, clips = decode_logits(
         params, tokens, k_pages, v_pages, page_table, lengths, active,
         cfg=cfg, attn=attn)
     if seeds is None:
@@ -301,5 +320,6 @@ def decode_forward(params, tokens, k_pages, v_pages, page_table,
         bad_rows = jnp.any(~jnp.isfinite(logits), axis=-1)
         nonfinite = jnp.sum(
             jnp.where(active, bad_rows, False).astype(jnp.int32))
-        return next_tokens, k_pages, v_pages, nonfinite
+        guard = jnp.stack([nonfinite, clips])
+        return next_tokens, k_pages, v_pages, guard
     return next_tokens, k_pages, v_pages

@@ -46,17 +46,38 @@ def _chain(prev, page_tokens):
     return h.digest()
 
 
-def page_digests(tokens, page_size):
+def _chain_seed(kv_dtype):
+    """First link of the digest chain, salted by the pool's storage
+    dtype. A cached page's BYTES are a function of (token prefix,
+    params, kv_dtype): an int8 page holds quantized payload plus a
+    scale plane, so advertising it under the same digest as a float32
+    page would let the fleet router route a float32-pool prompt to an
+    int8 replica (and vice versa) on a match that cannot transfer.
+    Seeding the chain with the dtype is equivalent to hashing the
+    quantized payload + scale plane alongside the tokens — the
+    payload is fully determined by what's hashed. float32 keeps the
+    historical empty seed so existing fleet advertisements and
+    recorded digests stay valid byte-for-byte."""
+    if kv_dtype in (None, "float32"):
+        return b""
+    return hashlib.blake2b(
+        f"kv:{kv_dtype}".encode(), digest_size=8).digest()
+
+
+def page_digests(tokens, page_size, kv_dtype="float32"):
     """Chain digests of the page-aligned prefix of `tokens`: entry i
     summarizes tokens[0 : (i+1)*page_size], and because each entry
     chains through the previous one, digest equality IS prefix
-    equality (up to hash collision). The fleet router hashes prompts
-    with this same function, so a digest advertised by
+    equality (up to hash collision) — AT the same KV storage dtype;
+    the chain is seeded per dtype (`_chain_seed`) so quantized and
+    full-precision pages can never collide. The fleet router hashes
+    prompts with this same function, so a digest advertised by
     `PrefixCache.cached_prefixes` matches exactly the prompts whose
-    pages that replica already holds. The trailing partial page is
-    ignored — the cache only ever holds full pages."""
+    pages that replica already holds at a compatible precision. The
+    trailing partial page is ignored — the cache only ever holds full
+    pages."""
     t = [int(x) for x in tokens]
-    out, prev = [], b""
+    out, prev = [], _chain_seed(kv_dtype)
     for i in range(len(t) // page_size):
         prev = _chain(prev, t[i * page_size:(i + 1) * page_size])
         out.append(prev.hex())
@@ -80,9 +101,10 @@ class _Node:
 class PrefixCache:
     """Radix index over cached prompt pages (see module docstring)."""
 
-    def __init__(self, allocator):
+    def __init__(self, allocator, kv_dtype="float32"):
         self.allocator = allocator
         self.page_size = allocator.page_size
+        self.kv_dtype = kv_dtype
         self._lock = threading.Lock()
         self._root = _Node((), (), 0)
         self._clock = itertools.count(1)   # LRU clock: counter, not time
@@ -231,7 +253,7 @@ class PrefixCache:
         with self._lock:
             # recency-ordered DFS: when the cap truncates, the cold
             # tail drops first and hot prefixes stay advertised
-            stack = [(self._root, b"")]
+            stack = [(self._root, _chain_seed(self.kv_dtype))]
             while stack and len(out) < max_entries:
                 node, prev = stack.pop()
                 for j in range(len(node.pages)):

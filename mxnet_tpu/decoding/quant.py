@@ -1,0 +1,227 @@
+"""Precision-polymorphic KV page pool (ROADMAP item 2's decode half).
+
+The paged KV cache is the decode tier's HBM budget: every sequence
+costs `2 * n_layers * H * D * itemsize` bytes per token. Storing pages
+as int8 with a float32 scale plane cuts that to `D + 4` bytes per
+(token, head) against float32's `4 * D` — a `4D / (D + 4)` capacity
+multiplier (3.2x at D=16, asymptotically 4x) that compounds with
+prefix sharing and speculation because all three trade the SAME pool
+bytes.
+
+`KVPool` is a NamedTuple — jax registers those as pytrees — so a
+quantized pool threads through every existing jit signature,
+`donate_argnums` slot and device-copy exactly like the bare array it
+replaces: the fixed-shape program grid is UNCHANGED IN COUNT and the
+scale plane rides along wherever its pages go (COW copies, prefix
+shares, fleet handoffs).
+
+Quantization scheme (symmetric, zero-point-free):
+
+  scale[l, page, slot, head] = max|K/V[l, page, slot, head, :]| / 127
+  data = round(value / scale) in [-127, 127] int8
+
+Per-(slot, head) granularity — "a per-page scale plane" in the
+coarse-to-fine sense: the plane is allocated per page, with one scalar
+per (slot, head) entry INSIDE the page. Anything coarser would force
+re-quantizing already-written slots on every decode append (one token
+lands per step), destroying the bit-identical page sharing the prefix
+cache and fleet affinity routing depend on. With maxabs scaling the
+round-trip error is bounded by scale/2 per element and quantizing a
+value twice is idempotent — cached pages stay byte-stable.
+
+Dequantization happens INSIDE the attention paths (the lax gather and
+the pallas kernel both upcast per page as they read), so no
+full-precision copy of the pool is ever materialized.
+
+Dtype enum (MXNET_DECODE_KV_DTYPE): float32 (default), bf16 (plain
+storage cast, no scale plane), int8 (scaled), fp8 — ACCEPTED by the
+enum but reserved: fp8 stores need the TPU's native f8 converts to
+beat int8, a silicon-backlog item; selecting it raises today so the
+knob's surface is already the final one.
+
+Hot paths: `kv_scatter` runs inside every prefill/decode/verify
+program and `gather_ctx` inside every lax attention call — both are
+pure jax (listed in the mxlint HOT_PATH_MANIFEST; no blocking calls).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+
+from .blocks import PageError
+
+# the knob's full surface; "fp8" is reserved (see module docstring)
+KV_DTYPES = ("float32", "bf16", "int8", "fp8")
+
+# scale floor: keeps an all-zero (or denormal) K/V row from dividing
+# by zero; 1e-8/127 quantizes everything below float32 noise to 0
+_SCALE_FLOOR = 1e-8
+
+
+def canonical(kv_dtype):
+    """Validate + normalize an MXNET_DECODE_KV_DTYPE value."""
+    name = str(kv_dtype or "float32").strip().lower()
+    if name in ("bfloat16",):
+        name = "bf16"
+    if name not in KV_DTYPES:
+        raise PageError(
+            f"unknown kv dtype {kv_dtype!r} "
+            f"(MXNET_DECODE_KV_DTYPE choices: {KV_DTYPES})")
+    if name == "fp8":
+        raise PageError(
+            "kv dtype 'fp8' is reserved: fp8 page stores need native "
+            "f8 converts (silicon backlog); use 'int8' today")
+    return name
+
+
+def storage_dtype(kv_dtype):
+    return {"float32": jnp.float32, "bf16": jnp.bfloat16,
+            "int8": jnp.int8}[canonical(kv_dtype)]
+
+
+class KVPool(NamedTuple):
+    """One K (or V) page pool: `data` is (layers, pages, page_size,
+    heads, head_dim) in the storage dtype; `scale` is the per-(page,
+    slot, head) float32 plane for int8 pools, None otherwise.
+
+    NamedTuple => pytree: jit, donation and device copies treat the
+    pair as one value, which is what keeps the trace grid count
+    identical across dtypes."""
+
+    data: jnp.ndarray
+    scale: Optional[jnp.ndarray]
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def page_size(self):
+        return self.data.shape[2]
+
+    @property
+    def kv_dtype(self):
+        if self.scale is not None:
+            return "int8"
+        return "bf16" if self.data.dtype == jnp.bfloat16 else "float32"
+
+    def layer(self, i):
+        """The (pages, page_size, heads, head_dim) view of one layer
+        — what the attention kernels consume."""
+        return KVPool(self.data[i],
+                      None if self.scale is None else self.scale[i])
+
+
+def as_pool(x):
+    """Adopt a bare (quantization-naive) pool array as a float KVPool
+    so the attention kernels keep accepting raw arrays (tests and the
+    parity harness build those directly)."""
+    return x if isinstance(x, KVPool) else KVPool(x, None)
+
+
+def make_pool(shape, kv_dtype):
+    """A zeroed pool of `shape` (layers, pages, page_size, heads,
+    head_dim) at `kv_dtype`; int8 pools get their scale plane."""
+    name = canonical(kv_dtype)
+    data = jnp.zeros(shape, storage_dtype(name))
+    if name != "int8":
+        return KVPool(data, None)
+    return KVPool(data, jnp.zeros(shape[:-1], jnp.float32))
+
+
+def quantize_values(values):
+    """Symmetric int8 quantization of K/V rows: values (..., H, D)
+    float -> (q int8 (..., H, D), scale f32 (..., H), clips () i32).
+
+    `clips` counts elements that could NOT be represented even after
+    scaling — nonfinite inputs, or magnitudes beyond scale*127 when
+    the scale saturated. With healthy numerics it is exactly 0 (the
+    scale is derived from the row's own maxabs), so a nonzero value is
+    a numerics event: MXNET_NUMERICS_DECODE_GUARD surfaces it as the
+    dequant-overflow clip counter."""
+    v = values.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(v), axis=-1)
+    amax = jnp.where(jnp.isfinite(amax), amax, 0.0)
+    scale = jnp.maximum(amax, _SCALE_FLOOR) / 127.0
+    q = v / scale[..., None]
+    overflow = ~jnp.isfinite(v) | (jnp.abs(q) > 127.5)
+    clips = jnp.sum(overflow.astype(jnp.int32))
+    q = jnp.clip(jnp.round(q), -127, 127).astype(jnp.int8)
+    return q, scale, clips
+
+
+def dequantize_values(q, scale):
+    """Inverse of `quantize_values` (exact float arithmetic: int8 *
+    f32 is lossless)."""
+    return q.astype(jnp.float32) * scale[..., None]
+
+
+def kv_scatter(pool, layer, pages, slots, values):
+    """Quantize-at-scatter: write `values` (..., H, D) float at
+    [layer, pages, slots] (index arrays shaped like values minus the
+    trailing (H, D)), quantizing INTO the pool's storage dtype so a
+    full-precision K/V tensor never exists outside the current
+    activations. Returns (pool', clips () i32); clips is 0 for
+    non-int8 pools."""
+    if pool.scale is None:
+        data = pool.data.at[layer, pages, slots].set(
+            values.astype(pool.data.dtype))
+        return KVPool(data, None), jnp.int32(0)
+    q, scale, clips = quantize_values(values)
+    data = pool.data.at[layer, pages, slots].set(q)
+    sc = pool.scale.at[layer, pages, slots].set(scale)
+    return KVPool(data, sc), clips
+
+
+def gather_ctx(layer_pool, page_table):
+    """The lax attention paths' read: gather page_table's pages from
+    one layer's pool and dequantize them in-flight — (B, Bp) int32 ->
+    (B, Bp, P, H, D) float32. Only the gathered pages are ever
+    upcast, never the pool."""
+    pool = as_pool(layer_pool)
+    d = pool.data[page_table]
+    if pool.scale is None:
+        return d.astype(jnp.float32)
+    return d.astype(jnp.float32) * pool.scale[page_table][..., None]
+
+
+def dequant_page(pool, layer, page):
+    """One page, dequantized to float32 (test/debug reads)."""
+    d = pool.data[layer, page]
+    if pool.scale is None:
+        return d.astype(jnp.float32)
+    return dequantize_values(d, pool.scale[layer, page])
+
+
+def pool_nbytes(pool):
+    """Device bytes one pool owns (data + scale plane)."""
+    n = int(pool.data.size) * pool.data.dtype.itemsize
+    if pool.scale is not None:
+        n += int(pool.scale.size) * pool.scale.dtype.itemsize
+    return n
+
+
+def kv_bytes_per_token(pool):
+    """Measured K-or-V bytes per pooled token position (pool bytes /
+    (pages * page_size)); double it for K+V. The float32-vs-int8
+    ratio of this number IS the capacity multiplier the bench and CI
+    gate report."""
+    _, pages, page_size = pool.data.shape[:3]
+    return pool_nbytes(pool) / float(pages * page_size)
+
+
+def capacity_ratio(head_dim):
+    """Analytic sequences-per-pool multiplier of int8 over float32:
+    4D / (D + 4) for head_dim D (data shrinks 4x, the scale plane
+    adds 4 bytes per (slot, head)). >= 1.9 for every D >= 4."""
+    return 4.0 * head_dim / (head_dim + 4.0)
+
+
+def check_capacity(head_dim, floor=1.9):
+    if capacity_ratio(head_dim) < floor:
+        raise PageError(
+            f"int8 pages at head_dim {head_dim} only buy "
+            f"{capacity_ratio(head_dim):.2f}x capacity (< {floor}); "
+            "quantization is not worth the drift here")
+    return True

@@ -251,8 +251,11 @@ class ContinuousScheduler:
             else _cfg.max_tokens()
         self.eos_id = eos_id if eos_id is not None \
             else engine.cfg.eos_id
-        # prompt-prefix page cache: admission-side work avoidance
-        self.cache = PrefixCache(engine.allocator) \
+        # prompt-prefix page cache: admission-side work avoidance; the
+        # cache inherits the engine's kv dtype so its advertised
+        # digests can never match pages stored at another precision
+        self.cache = PrefixCache(engine.allocator,
+                                 kv_dtype=engine.kv_dtype) \
             if engine.prefix_cache_enabled else None
         self._cond = threading.Condition()
         self._waiting = []
@@ -950,11 +953,14 @@ class ContinuousScheduler:
              "tokens": emitted})
         self.stats.note_pool()
         if engine._guard and self.stats.steps % 16 == 0:
-            # interval drain of the logits guard (one fetch per 16
-            # steps); counts surface in decodingStats/nonfinite_*
-            for n in engine.drain_guard():
-                if n:
-                    self.stats.note_nonfinite(n)
+            # interval drain of the numerics guard (one fetch per 16
+            # steps); nonfinite rows surface in nonfinite_*, dequant-
+            # overflow clips in quant_clip_* (decodingStats view)
+            for nf, clips in engine.drain_guard():
+                if nf:
+                    self.stats.note_nonfinite(nf)
+                if clips:
+                    self.stats.note_quant_clips(clips)
 
     # -------------------------------------------------------------- loop
     def _loop(self):
@@ -1025,7 +1031,7 @@ class DecodedModel:
                  kernel=None, ring_prefill=None, queue_cap=None,
                  max_tokens=None, warmup=True, draft=None,
                  draft_cfg=None, spec_k=None, prefix_cache=None,
-                 merged_step=None):
+                 merged_step=None, kv_dtype=None):
         self.name = name
         self.version = int(version)
         self.cfg = cfg
@@ -1052,7 +1058,7 @@ class DecodedModel:
             kernel=kernel, ring_prefill=ring_prefill,
             draft_params=draft_params, draft_cfg=draft_cfg,
             spec_k=spec_k, prefix_cache=prefix_cache,
-            merged_step=merged_step)
+            merged_step=merged_step, kv_dtype=kv_dtype)
         self.stats = DecodeStats(
             key=self.key, traces_fn=self.engine.traces,
             pool_fn=self.engine.pool_stats)

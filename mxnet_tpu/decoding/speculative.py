@@ -39,6 +39,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from . import quant as _quant
 from . import sampling as _sampling
 from .blocks import SCRATCH_PAGE
 from .model import _mlp, _qkv, _rms, decode_logits
@@ -59,7 +60,7 @@ def draft_propose_forward(params, last_tokens, k_pages, v_pages,
     tok = last_tokens
     drafts, q_dists = [], []
     for j in range(k):
-        logits, k_pages, v_pages = decode_logits(
+        logits, k_pages, v_pages, _ = decode_logits(
             params, tok, k_pages, v_pages, page_table, lengths + j,
             active, cfg=cfg, attn=attn)
         qd = jax.vmap(
@@ -92,7 +93,9 @@ def verify_forward(params, last_tokens, drafts, q_dists, k_pages,
     slot n_acc holds the correction/bonus token and slots before it
     are the accepted drafts.
     """
-    page_size = k_pages.shape[2]
+    k_pages = _quant.as_pool(k_pages)
+    v_pages = _quant.as_pool(v_pages)
+    page_size = k_pages.page_size
     b = last_tokens.shape[0]
     bp = page_table.shape[1]
     s = k + 1
@@ -113,9 +116,10 @@ def verify_forward(params, last_tokens, drafts, q_dists, k_pages,
     for i in range(cfg.n_layers):
         h1 = _rms(x, params[f"l{i}.ln1"])
         q, kk, vv = _qkv(params, i, h1, cfg)
-        k_pages = k_pages.at[i, w_pages, slots].set(kk)
-        v_pages = v_pages.at[i, w_pages, slots].set(vv)
-        o = attn_multi(q, k_pages[i], v_pages[i], page_table, pos_safe)
+        k_pages, _ = _quant.kv_scatter(k_pages, i, w_pages, slots, kk)
+        v_pages, _ = _quant.kv_scatter(v_pages, i, w_pages, slots, vv)
+        o = attn_multi(q, k_pages.layer(i), v_pages.layer(i),
+                       page_table, pos_safe)
         x = x + o.reshape(b, s, cfg.d_model) @ params[f"l{i}.wo"]
         x = x + _mlp(params, i, _rms(x, params[f"l{i}.ln2"]))
     x = _rms(x, params["ln_f"])

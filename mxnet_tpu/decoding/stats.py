@@ -67,6 +67,15 @@ _PREFIX_PAGES = _treg.counter(
 _SPEC_TOKENS = _treg.counter(
     "mxnet_tpu_decode_spec_tokens_total",
     "Speculative decoding draft tokens (phase=proposed|accepted)")
+_QUANT_CLIPS = _treg.counter(
+    "mxnet_tpu_decode_quant_clip_values_total",
+    "KV values clipped at int8 quantization because the row held "
+    "NaN/Inf or saturated its own scale (MXNET_NUMERICS_DECODE_GUARD "
+    "dequant-overflow watermark; 0 under healthy numerics)")
+_KV_BYTES = _treg.gauge(
+    "mxnet_tpu_decode_kv_bytes_per_token",
+    "Pool bytes per cached token position, K+V combined (4x head_dim "
+    "x layers at float32; int8 shrinks it ~capacity_ratio-fold)")
 
 
 def _register(key, stats):
@@ -130,6 +139,8 @@ class DecodeStats:
             self.steps = 0
             self.nonfinite_logit_steps = 0
             self.nonfinite_logits = 0
+            self.quant_clip_steps = 0
+            self.quant_clip_values = 0
             self.traces_at_warmup = None
             self._prefill_s = 0.0
             self._decode_s = 0.0
@@ -207,6 +218,17 @@ class DecodeStats:
             self.nonfinite_logits += rows
         _NONFINITE.inc(rows, model=self._key)
 
+    def note_quant_clips(self, values, steps=1):
+        """Guard trip, quantization flavor: `values` K/V entries were
+        clipped at int8 scatter time across `steps` decode steps —
+        the dequant-overflow watermark. Healthy numerics quantize with
+        zero clips (each row's scale comes from its own maxabs), so
+        any count means NaN/Inf or saturation reached the cache."""
+        with self._lock:
+            self.quant_clip_steps += steps
+            self.quant_clip_values += values
+        _QUANT_CLIPS.inc(values, model=self._key)
+
     def note_preempted(self, n=1):
         with self._lock:
             self.preemptions += n
@@ -220,10 +242,13 @@ class DecodeStats:
                 self._traces_fn() if self._traces_fn else 0)
 
     def note_pool(self):
-        """Refresh the occupancy gauge (called per step)."""
+        """Refresh the occupancy/bytes gauges (called per step)."""
         if self._pool_fn:
-            _OCCUPANCY.set(self._pool_fn().get("kv_occupancy", 0.0),
+            pool = self._pool_fn()
+            _OCCUPANCY.set(pool.get("kv_occupancy", 0.0),
                            model=self._key)
+            _KV_BYTES.set(pool.get("kv_bytes_per_token", 0.0),
+                          model=self._key)
 
     # ------------------------------------------------------- snapshot
     def snapshot(self):
@@ -256,6 +281,8 @@ class DecodeStats:
                 "steps": self.steps,
                 "nonfinite_logit_steps": self.nonfinite_logit_steps,
                 "nonfinite_logits": self.nonfinite_logits,
+                "quant_clip_steps": self.quant_clip_steps,
+                "quant_clip_values": self.quant_clip_values,
                 "prefill_tokens_per_s": round(
                     self.prefill_tokens / self._prefill_s, 1)
                 if self._prefill_s else 0.0,

@@ -27,8 +27,14 @@ class AffinityIndex:
     lookup. Thread-safe: heartbeats update it while the routing path
     reads it."""
 
-    def __init__(self, page_size):
+    def __init__(self, page_size, kv_dtype="float32"):
         self.page_size = int(page_size)
+        # the fleet's KV storage precision: prompt chains are seeded
+        # with it (decoding.prefix._chain_seed), so an advertisement
+        # recorded at another dtype can never cover a single page —
+        # affinity silently degrades to least-loaded instead of
+        # routing to a replica whose pages hold a different encoding
+        self.kv_dtype = kv_dtype
         self._lock = threading.Lock()
         self._sets = {}          # replica id -> set of hex digests
 
@@ -49,7 +55,7 @@ class AffinityIndex:
         advertisement covers the longest leading run of `prompt`'s
         page digests; (None, 0) when no candidate covers even the
         first page (caller falls back to least-loaded)."""
-        chain = page_digests(prompt, self.page_size)
+        chain = page_digests(prompt, self.page_size, self.kv_dtype)
         if not chain:
             return None, 0
         best_rid, best_cover = None, 0

@@ -83,6 +83,7 @@ class ReplicaWorker:
                "kind": "decoded" if is_decoder else "served"}
         if is_decoder:
             msg["page_size"] = self.model.engine.page_size
+            msg["kv_dtype"] = self.model.engine.kv_dtype
         msg.update(self.hello_extra)
         return msg
 

@@ -208,10 +208,14 @@ class FleetRouter:
             raise ServingError(f"unknown routing policy {policy!r}")
         self.policy = policy
         self.name = name
+        kv_dtype = None
         if page_size is None and self.bundle:
             with open(os.path.join(self.bundle, MANIFEST)) as f:
-                page_size = json.load(f).get("page_size")
-        self.affinity = AffinityIndex(page_size or 1)
+                manifest = json.load(f)
+            page_size = manifest.get("page_size")
+            kv_dtype = manifest.get("kv_dtype")
+        self.affinity = AffinityIndex(page_size or 1,
+                                      kv_dtype or "float32")
         self.ledger = DrainLedger()
         self.stats = FleetStats(name, replicas_fn=self._replica_rows)
         if autoscaler is not None:
@@ -598,6 +602,13 @@ class FleetRouter:
             # router built without a bundle manifest: adopt the page
             # size the replicas actually decode with
             self.affinity.page_size = int(hello["page_size"])
+        if hello.get("kv_dtype"):
+            # adopt the replicas' KV storage precision so prompt
+            # chains are seeded to match their advertisements (a
+            # replica at a DIFFERENT dtype keeps its own seed and
+            # simply never wins affinity — cross-dtype page matches
+            # are impossible by construction)
+            self.affinity.kv_dtype = str(hello["kv_dtype"])
         with self._lock:
             handle.proc = self._procs.get(rid)
             self._handles[rid] = handle

@@ -47,6 +47,7 @@ import numpy as np
 from .. import exec_cache_disk as _disk
 from ..utils import getenv
 from ..utils.persist import atomic_write_json, read_json
+from . import quant as _squant
 from .batcher import ServingError
 
 log = logging.getLogger(__name__)
@@ -195,6 +196,12 @@ def _decoded_payload(model, exec_root):
         "page_buckets": list(eng.page_buckets),
         "kernel": eng.kernel_name,
         "ring_prefill": eng.ring_prefill,
+        "kv_dtype": eng.kv_dtype,
+        # the program grid is a function of these too — restoring
+        # with different values would rebuild a grid none of the
+        # saved executables match (full re-compile)
+        "prefix_cache": eng.prefix_cache_enabled,
+        "merged_step": eng.merged_step_enabled,
         "digests": [eng._digest],
         "decode_kinds": sorted({j.kind for j in jits}),
     }
@@ -226,13 +233,25 @@ def _harvest_tuning(canonicals):
 
 
 # ---------------------------------------------------------------- save
-def save_bundle(model, out_dir):
+def save_bundle(model, out_dir, quantize=None):
     """Snapshot a WARM model (ServedModel or DecodedModel) into the
     atomic directory artifact `out_dir` (must not exist; built in a
     sibling tmp dir and published by one `os.replace`). Returns
-    `out_dir`."""
+    `out_dir`.
+
+    `quantize="int8"` (default: MXNET_BUNDLE_QUANTIZE) stores the
+    parameter set weight-only int8 with per-channel scales — see
+    serving/quant.py for the scheme and the dequant-on-load
+    rationale. The content hash covers the STORED (quantized)
+    arrays, so verification needs no dequantization pass."""
     from .registry import ServedModel
 
+    if quantize is None:
+        quantize = getenv("MXNET_BUNDLE_QUANTIZE") or None
+    if quantize and quantize not in _squant.SCHEMES:
+        raise BundleError(
+            f"unknown bundle quantization {quantize!r} "
+            f"(this build writes {_squant.SCHEMES})")
     out_dir = os.path.abspath(out_dir)
     if os.path.exists(out_dir):
         raise BundleError(f"bundle target exists: {out_dir}")
@@ -262,6 +281,10 @@ def save_bundle(model, out_dir):
                 "no AOT-serializable executables captured — this "
                 "jax/jaxlib cannot export compiled programs, so a "
                 "bundle would not avoid any compile")
+        if quantize:
+            params, qrecord = _squant.quantize_params(
+                params, scheme=quantize)
+            manifest["quantization"] = qrecord
         np.savez(os.path.join(tmp, PARAMS), **params)
         if symbol_json is not None:
             with open(os.path.join(tmp, SYMBOL), "w") as f:
@@ -375,6 +398,27 @@ def load_bundle(path, registry, name=None, version=None, warmup=True):
             "WITHOUT AOT executables (full re-trace)", path,
             manifest.get("env"), _disk.env_fingerprint())
     params = _load_params(path, manifest)
+    qrecord = manifest.get("quantization")
+    if bool(qrecord) != _squant.is_quantized(params):
+        # the manifest and the stored arrays disagree about
+        # precision — a stripped quantization record (or stripped
+        # scale planes) silently changes what the model computes, so
+        # it is a refusal, not a warning
+        if not getenv("MXNET_BUNDLE_QUANTIZE_OVERRIDE"):
+            raise BundleError(
+                f"bundle precision mismatch: manifest says "
+                f"{'quantized ' + str(qrecord.get('scheme')) if qrecord else 'full precision'}, "
+                f"stored params are "
+                f"{'quantized' if _squant.is_quantized(params) else 'full precision'} "
+                f"— refusing (set MXNET_BUNDLE_QUANTIZE_OVERRIDE=1 "
+                f"to load anyway)")
+        log.warning("bundle %s precision mismatch overridden "
+                    "(MXNET_BUNDLE_QUANTIZE_OVERRIDE=1)", path)
+    if qrecord or _squant.is_quantized(params):
+        # dequant-on-load: restore float32 so the saved AOT
+        # executables (compiled against f32 signatures) still match
+        # — zero traces, zero compiles (see serving/quant.py)
+        params = _squant.dequantize_params(params, qrecord)
     if compatible:
         _disk.add_overlay(os.path.join(path, EXEC_CACHE))
     _seed_tuning(manifest)
@@ -391,7 +435,12 @@ def load_bundle(path, registry, name=None, version=None, warmup=True):
             num_pages=manifest["num_pages"],
             page_buckets=tuple(manifest["page_buckets"]),
             kernel=manifest["kernel"],
-            ring_prefill=manifest["ring_prefill"])
+            ring_prefill=manifest["ring_prefill"],
+            kv_dtype=manifest.get("kv_dtype", "float32"),
+            # older bundles predate these keys: leave the env-default
+            # behavior (their grids were also built under it)
+            **{k: manifest[k] for k in ("prefix_cache", "merged_step")
+               if k in manifest})
     with open(os.path.join(path, manifest["symbol"])) as f:
         symbol_json = f.read()
     length_buckets = manifest.get("length_buckets")

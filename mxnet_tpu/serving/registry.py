@@ -207,7 +207,8 @@ class ModelRegistry:
         contract as ServedModel.warmup — and starts its scheduler
         thread. kwargs: DecodedModel knobs (max_batch, page_size,
         num_pages, page_buckets, kernel, ring_prefill, queue_cap,
-        max_tokens, draft, draft_cfg, spec_k, prefix_cache)."""
+        max_tokens, draft, draft_cfg, spec_k, prefix_cache,
+        kv_dtype)."""
         from ..decoding.scheduler import DecodedModel
         from ..decoding import stats as _dec_stats
 

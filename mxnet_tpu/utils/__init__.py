@@ -360,6 +360,19 @@ register_env(
     "is off; 0 restores the split prefill/decode grid.",
 )
 register_env(
+    "MXNET_DECODE_KV_DTYPE", str, "float32",
+    "decoding: KV page-pool storage precision — float32 (default), "
+    "bf16, or int8. int8 stores pages quantized with a per-page "
+    "float32 scale plane (per-(slot,head) granularity), quantized at "
+    "scatter time and dequantized inside the attention kernels, so "
+    "no full-precision KV tensor is ever materialized; the pool "
+    "holds ~4*head_dim/(head_dim+4) times more tokens (2.7-3.6x for "
+    "typical head dims). The dtype joins the engine digest/exec "
+    "cache key — the warmup grid is retraced once per dtype, never "
+    "in steady state. fp8 is reserved (raises until native f8 "
+    "converts land). docs/serving.md 'Quantized serving'.",
+)
+register_env(
     "MXNET_DECODE_RING_PREFILL", int, 0,
     "decoding: minimum PADDED prompt length (length bucket) that "
     "routes prefill attention through parallel.ring_attention on a "
@@ -592,6 +605,24 @@ register_env(
     "mismatch raises BundleError (tamper/corruption rejection). 0 "
     "skips hashing — only for bundles on trusted read-only media "
     "where load latency matters more.",
+)
+register_env(
+    "MXNET_BUNDLE_QUANTIZE", str, "",
+    "serving bundles: default save_bundle quantization scheme. "
+    "'int8' stores the parameter set weight-only int8 with "
+    "per-channel (last-axis) float32 scales — ~4x smaller artifact; "
+    "restore dequantizes on load so saved AOT executables still "
+    "replay at zero traces/compiles. Empty (default) stores full "
+    "precision. The explicit save_bundle(quantize=...) argument "
+    "wins over this env.",
+)
+register_env(
+    "MXNET_BUNDLE_QUANTIZE_OVERRIDE", bool, False,
+    "serving bundles: load a bundle whose manifest quantization "
+    "record and stored arrays DISAGREE about precision (stripped "
+    "scale planes or stripped record). Default refuses with "
+    "BundleError — a silent precision mismatch changes what the "
+    "model computes; 1 downgrades the refusal to a warning.",
 )
 register_env(
     "MXNET_FLEET_REPLICAS", int, 2,

@@ -27,7 +27,7 @@ def _fresh(monkeypatch):
                 "MXNET_DECODE_KERNEL", "MXNET_DECODE_RING_PREFILL",
                 "MXNET_DECODE_MAX_TOKENS", "MXNET_DECODE_QUEUE_CAP",
                 "MXNET_DECODE_PREFIX_CACHE", "MXNET_DECODE_SPEC_K",
-                "MXNET_DECODE_SPEC_DRAFT",
+                "MXNET_DECODE_SPEC_DRAFT", "MXNET_DECODE_KV_DTYPE",
                 "MXNET_DECODE_SAMPLING_TEMPERATURE",
                 "MXNET_DECODE_SAMPLING_TOP_K",
                 "MXNET_DECODE_SAMPLING_TOP_P",
@@ -450,13 +450,15 @@ def test_decoding_stats_view_shape_pinned():
             "spec_proposed", "spec_accepted", "spec_acceptance_rate",
             "tokens_per_target_step",
             "nonfinite_logit_steps", "nonfinite_logits",
+            "quant_clip_steps", "quant_clip_values",
             "prefill_tokens_per_s", "decode_tokens_per_s",
             "p50_token_ms", "p95_token_ms", "p99_token_ms",
             "traces_since_warmup", "waiting", "active", "pages_total",
             "pages_free", "kv_occupancy", "free_low_watermark",
             "pages_allocated", "prefix_hits", "prefix_misses",
             "prefix_hit_rate", "prefix_pages_reused",
-            "prefix_evictions", "prefix_cached_pages"))
+            "prefix_evictions", "prefix_cached_pages",
+            "kv_dtype", "kv_bytes_per_token", "pool_capacity_tokens"))
         assert snap["decode_tokens"] == 2 and snap["prefills"] == 1
         assert snap["prefill_tokens"] == 3
         assert snap["traces_since_warmup"] == 0

@@ -463,7 +463,8 @@ def test_decode_guard_counts_nonfinite_logits(monkeypatch):
     try:
         assert m.engine._guard
         m.generate([5, 6, 7], max_new_tokens=4, timeout=60)
-        total = sum(m.engine.drain_guard()) \
+        # drain_guard yields (nonfinite_rows, quant_clips) pairs
+        total = sum(nf for nf, _ in m.engine.drain_guard()) \
             + m.stats.snapshot()["nonfinite_logits"]
         assert total > 0
     finally:

@@ -39,6 +39,9 @@ LOWER_IS_BETTER = (
     # same traffic (affinity arm) — duplicated prefix prefill shows
     # up here first
     "fleet_pages_allocated",
+    # BENCH_MODE=decode int8 arm: logit drift vs float32 must never
+    # grow (quantization-error regression canary)
+    "int8_logit_drift",
 )
 
 # secondary per-record keys where BIGGER is better (work avoided per
@@ -56,6 +59,11 @@ HIGHER_IS_BETTER = (
     # cache reuse
     "fleet_prefix_hit_rate", "fleet_affinity_advantage",
     "fleet_pages_reused", "fleet_requests_per_s",
+    # BENCH_MODE=decode int8 KV-page arm: how many more sequences the
+    # same pool holds at int8, greedy agreement with float32, and
+    # quantized decode throughput — all must hold or improve
+    "kv_pool_capacity_ratio", "int8_top1_agreement",
+    "decode_tokens_per_s_int8",
 )
 
 

@@ -62,13 +62,16 @@ def cmd_bundle(args):
         batch_buckets=_parse_ints(args.batch_buckets),
         length_buckets=_parse_ints(args.length_buckets),
         warmup=True)
-    out = serving.save_bundle(model, args.out)
+    out = serving.save_bundle(model, args.out,
+                              quantize=args.quantize or None)
     manifest = serving.read_manifest(out)
     print(json.dumps({
         "bundle": out,
         "programs": len(manifest["programs"]),
         "digests": manifest["digests"],
         "param_hash": manifest["params"]["content_hash"][:12],
+        "quantization": (manifest.get("quantization") or {}).get(
+            "scheme"),
     }))
     return 0
 
@@ -80,7 +83,7 @@ def cmd_inspect(args):
     out = {k: manifest.get(k) for k in (
         "format", "kind", "name", "version", "env", "digests",
         "batch_buckets", "length_buckets", "input_specs", "decoder",
-        "decode_kinds")}
+        "decode_kinds", "kv_dtype", "quantization")}
     out["programs"] = len(manifest.get("programs", []))
     out["params"] = manifest.get("params")
     out["tuner_records"] = len(manifest.get("tuner") or {})
@@ -137,6 +140,10 @@ def main(argv=None):
                    metavar="NAME=DTYPE")
     b.add_argument("--batch-buckets", default=None)
     b.add_argument("--length-buckets", default=None)
+    b.add_argument("--quantize", default=None, choices=("int8",),
+                   help="store params weight-only quantized with "
+                        "per-channel scales (default: "
+                        "MXNET_BUNDLE_QUANTIZE)")
     b.set_defaults(fn=cmd_bundle)
 
     i = sub.add_parser("inspect", help="print a bundle's manifest")

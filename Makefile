@@ -14,7 +14,7 @@ XLA8    := XLA_FLAGS=--xla_force_host_platform_device_count=8
 	passes-check telemetry-check decode-check race-check \
 	fusion-check \
 	shard-check profiling-check numerics-check coldstart-check \
-	fleet-check quant-check bench-diff clean
+	fleet-check quant-check elastic-check bench-diff clean
 
 all: libs test
 
@@ -171,6 +171,15 @@ fleet-check:
 # 0 compiles, stripped quantization record refused)
 quant-check:
 	$(CPUENV) bash ci/check_quant.sh
+
+# elastic-training tier: reshard/re-key test suite, then the runtime
+# gates (one of two subprocess workers SIGKILLed mid-epoch by its own
+# fault injector, survivor finishes bitwise equal to the
+# uninterrupted reference with every example consumed exactly once;
+# 1→2 re-grow at zero example loss and zero steady-state retraces)
+# and the transition-cost bench
+elastic-check:
+	$(CPUENV) bash ci/check_elastic.sh
 
 # regression diff of two bench captures (nonzero exit on >10% drops):
 #   make bench-diff OLD=BENCH_r04.json NEW=BENCH_r05.json

@@ -959,6 +959,86 @@ def _fleet_bench(platform):
     })
 
 
+def _elastic_bench(platform):
+    """BENCH_MODE=elastic: membership-transition cost.
+
+    One elastic job (2 logical shards) over the deterministic ci_job
+    MLP suffers both membership changes mid-run: a worker vanishes
+    (shrink 2→1) and a fresh worker joins (grow 1→2). Reported: the
+    quiesce-barrier wall per transition, the reshard bytes the
+    placement delta actually moved vs the restore-everyone baseline a
+    naive transition would broadcast (2·world full state replicas),
+    and end-to-end steps/s across both disruptions. The runtime gate
+    (ci/check_elastic.sh) separately proves the bitwise acceptance
+    bar with real SIGKILLed subprocesses; this bench tracks the COST
+    of the machinery so transitions getting slower or chattier cannot
+    land silently."""
+    import threading
+
+    from mxnet_tpu.elastic import ElasticCoordinator, ElasticWorker
+    from mxnet_tpu.elastic import load_entry
+    from mxnet_tpu.elastic.stats import elastic_stats
+
+    entry = "mxnet_tpu.elastic.ci_job:build"
+    config = {"epochs": int(os.environ.get("BENCH_ELASTIC_EPOCHS",
+                                           "8"))}
+    spec = load_entry(entry)(config)
+
+    def spawn(port, name):
+        w = ElasticWorker(f"127.0.0.1:{port}", entry, config,
+                          name=name)
+
+        def run():
+            try:
+                w.run(rejoin_ms=0)
+            except Exception:
+                pass   # the shrink victim exhausts its budget
+        threading.Thread(target=run, daemon=True).start()
+        return w
+
+    coord = ElasticCoordinator(entry, config, name="bench",
+                               initial_world=2).start()
+    t0 = time.perf_counter()
+    spawn(coord.port, "bench-w0")
+    victim = spawn(coord.port, "bench-w1")
+    third = spec.total_steps // 3
+    while victim.completed_steps < third and not coord.wait(0.02):
+        pass
+    victim.close()                       # shrink 2 -> 1 mid-epoch
+    while coord.status()["step"] < 2 * third and not coord.wait(0.02):
+        pass
+    spawn(coord.port, "bench-w2")        # grow 1 -> 2 mid-epoch
+    done = coord.wait(600)
+    wall = time.perf_counter() - t0
+    snap = elastic_stats()["bench"]
+    coord.stop()
+    if not done:
+        raise RuntimeError(f"elastic bench hung: {snap}")
+
+    transitions = snap["transitions"]
+    moved = snap["reshard_bytes_moved"]
+    full = snap["reshard_bytes_full_restore"]
+    _emit({
+        "metric": f"elastic_transitions_{platform}"
+                  f"_s{spec.logical_shards}_t{spec.total_steps}",
+        "value": round(spec.total_steps / wall, 2),
+        "unit": "steps_per_s",
+        "elastic_steps_per_s": round(spec.total_steps / wall, 2),
+        "elastic_transitions": transitions,
+        "elastic_quiesce_wall_ms": round(
+            snap["quiesce_wall_ms_total"] / max(1, transitions), 3),
+        "elastic_reshard_bytes_moved": moved,
+        "elastic_reshard_bytes_full_restore": full,
+        "elastic_reshard_savings": round(full / max(1, moved), 2),
+        "elastic_examples_rekeyed": snap["examples_rekeyed"],
+        "elastic_digest_mismatches": snap["digest_mismatches"],
+        "total_steps": spec.total_steps,
+        "logical_shards": spec.logical_shards,
+        "telemetry": _telemetry_snapshot(),
+        "platform": platform,
+    })
+
+
 def _profiling_bench(platform):
     """BENCH_MODE=profiling: the device-side observability ledger.
 
@@ -1446,6 +1526,8 @@ def main():
         return _decode_bench(jax.devices()[0].platform)
     if os.environ.get("BENCH_MODE", "train") == "fleet":
         return _fleet_bench(jax.devices()[0].platform)
+    if os.environ.get("BENCH_MODE", "train") == "elastic":
+        return _elastic_bench(jax.devices()[0].platform)
     if os.environ.get("BENCH_MODE", "train") == "fusion":
         return _fusion_bench(jax.devices()[0].platform)
     if os.environ.get("BENCH_MODE", "train") == "sharding":

@@ -72,6 +72,7 @@ from .predictor import Predictor
 from . import serving
 from . import decoding
 from . import fleet
+from . import elastic
 from . import module
 from . import module as mod
 from . import parallel

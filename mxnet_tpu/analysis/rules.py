@@ -160,6 +160,21 @@ HOT_PATH_MANIFEST = {
     ),
     "mxnet_tpu/fleet/affinity.py": "*",
     "mxnet_tpu/fleet/wire.py": ("Channel.send", "send_frame"),
+    # elastic control plane (PR 19): the per-step frame handlers run
+    # once per global step per worker and the heartbeat/codec paths run
+    # continuously — pure numpy + outbox enqueues, never a device
+    # fetch, a sleep, or a socket op under the coordinator lock
+    "mxnet_tpu/elastic/coordinator.py": (
+        "ElasticCoordinator._on_grads",
+        "ElasticCoordinator._on_slices",
+        "ElasticCoordinator._on_heartbeat",
+        "ElasticCoordinator._dispatch",
+    ),
+    "mxnet_tpu/elastic/agent.py": (
+        "ElasticWorker._one_step", "ElasticWorker._hb_loop",
+        "ElasticWorker._await", "ElasticWorker._log_consumed",
+    ),
+    "mxnet_tpu/elastic/codec.py": "*",
 }
 
 # Methods that force a host<->device round-trip (MX001).

@@ -49,12 +49,22 @@ def _tree(fs):
     }
 
 
-def _spec_meta(fs):
-    """{param: 'axis,axis'} from the fused step's bound specs."""
+def spec_strings(specs):
+    """{param: 'axis,axis'} — the per-param layout serialization this
+    tier writes into checkpoint meta, exposed because it is also the
+    layout identity the elastic tier diffs across membership
+    transitions (elastic/reshard.py computes old→new placement deltas
+    from exactly these strings, so a transition checkpoint's meta and
+    a live plan compare without any parsing asymmetry)."""
     from .sharding.spec import spec_to_str
 
-    specs = getattr(fs, "_param_specs", None) or {}
     return {n: spec_to_str(specs[n]) for n in sorted(specs)}
+
+
+def _spec_meta(fs):
+    """{param: 'axis,axis'} from the fused step's bound specs."""
+    specs = getattr(fs, "_param_specs", None) or {}
+    return spec_strings(specs)
 
 
 def _data_state_file(path):

@@ -16,6 +16,19 @@ num_shards` is dropped — at most `num_shards - 1` samples per epoch,
 and a different tail each epoch since the permutation changes), so all
 hosts run the same number of steps per epoch: on TPU a host finishing
 early would desync every collective.
+
+Elastic membership (PR 19): `num_shards` is the number of LOGICAL
+shards — a job-lifetime constant — while the set of physical processes
+may change mid-epoch. `set_membership(rank, world, consumed)` re-keys
+which logical shards this process owns (round-robin: shard `s` belongs
+to rank `s % world`) and from which per-shard batch position the
+stream resumes. Because every logical shard's batch `p` is a pure
+function of `(seed, epoch, shard, p)`, the union of all ranks' re-keyed
+streams is exactly the unconsumed remainder of the epoch — no example
+dropped, none double-seen — for ANY old→new world pair. The pre-PR-19
+behaviour (one contiguous shard per process, fixed for the sampler's
+lifetime, implicitly assuming `jax.process_count()` never changes) is
+the default membership `(rank=shard_id, world=num_shards, consumed=0)`.
 """
 from __future__ import annotations
 
@@ -36,20 +49,52 @@ def epoch_permutation(seed, epoch, num_samples):
 
 def _default_shard():
     """(shard_id, num_shards) of this process: jax.process_index /
-    process_count — the zero-config multihost default."""
+    process_count — the zero-config multihost default. Read at call
+    time, never cached at module scope: an elastic job's process set
+    changes, and `refresh_membership()` must see the current one."""
     import jax
 
     return jax.process_index(), jax.process_count()
 
 
+def remainder_stream(seed, epoch, num_samples, num_shards, batch_size,
+                     consumed=0, shuffle=True):
+    """The unconsumed remainder of one epoch as a single step-major
+    index stream: for each global step `p >= consumed`, the batch of
+    logical shard 0, then shard 1, ... shard S-1.
+
+    This is the membership-independent ground truth the elastic tier
+    is measured against: whatever the physical world size (and however
+    it changed mid-epoch), the union of every rank's re-keyed stream
+    must equal this, and for world=1 the single rank's stream IS this,
+    element for element."""
+    if shuffle:
+        perm = epoch_permutation(seed, epoch, num_samples)
+    else:
+        perm = np.arange(int(num_samples))
+    shard_len = int(num_samples) // int(num_shards)
+    bpe = shard_len // int(batch_size)
+    out = []
+    for p in range(int(consumed), bpe):
+        for s in range(int(num_shards)):
+            lo = s * shard_len + p * int(batch_size)
+            out.append(perm[lo: lo + int(batch_size)])
+    if not out:
+        return np.empty((0,), dtype=np.int64)
+    return np.concatenate(out)
+
+
 class ShardedSampler(object):
     """Epoch-keyed permutation sampling with per-host sharding.
 
-    `batch_indices(k)` is the k-th batch of this host's shard for the
+    `batch_indices(k)` is the k-th batch of this host's stream for the
     current epoch; `set_epoch(e)` rekeys the permutation. Partial
     final batches are dropped (`drop_last` semantics are forced: TPU
     programs are shape-specialized, a ragged last batch would compile
-    a second program and desync multi-host step counts)."""
+    a second program and desync multi-host step counts).
+
+    `num_shards` counts LOGICAL shards; `set_membership` re-keys which
+    of them this process owns when the physical world changes."""
 
     def __init__(self, num_samples, batch_size, seed=0, shard_id=None,
                  num_shards=None, shuffle=True):
@@ -74,7 +119,14 @@ class ShardedSampler(object):
                 f"shard of {self.shard_len} samples "
                 f"({self.num_samples} over {self.num_shards} hosts) "
                 f"yields no full batch of {self.batch_size}")
+        # physical membership: default = one logical shard per process,
+        # the pre-elastic contract (rank == shard_id, world == S).
+        self.rank = self.shard_id
+        self.world = self.num_shards
+        self.consumed = 0
+        self._owned = (self.shard_id,)
         self._epoch = None
+        self._perm = None
         self._shard = None
         self.set_epoch(0)
 
@@ -83,30 +135,125 @@ class ShardedSampler(object):
         return self._epoch
 
     def set_epoch(self, epoch):
-        """Re-key the permutation for `epoch` (no-op when unchanged)."""
+        """Re-key the permutation for `epoch` (no-op when unchanged).
+        The consumed-position base resets to 0 — a new epoch starts
+        from its first step whatever the current membership."""
         epoch = int(epoch)
-        if epoch == self._epoch:
+        if epoch == self._epoch and self.consumed == 0:
             return
         self._epoch = epoch
+        self.consumed = 0
         if self.shuffle:
-            perm = epoch_permutation(self.seed, epoch, self.num_samples)
+            self._perm = epoch_permutation(
+                self.seed, epoch, self.num_samples)
         else:
-            perm = np.arange(self.num_samples)
+            self._perm = np.arange(self.num_samples)
         lo = self.shard_id * self.shard_len
-        self._shard = perm[lo: lo + self.shard_len]
+        self._shard = self._perm[lo: lo + self.shard_len]
+
+    def set_membership(self, rank, world, consumed=0):
+        """Re-key mid-epoch for a new physical membership.
+
+        `rank`/`world` name this process's place in the NEW world;
+        ownership of the job's `num_shards` logical shards follows
+        round-robin (`s % world == rank`). `consumed` is the number of
+        global steps of the current epoch already applied to the model
+        — every logical shard has consumed exactly that many batches
+        (steps are lockstep across shards), so the local stream
+        resumes at per-shard batch `consumed`, interleaved step-major
+        across the owned shards. Idempotent for an unchanged
+        membership triple."""
+        rank, world = int(rank), int(world)
+        consumed = int(consumed)
+        if world < 1 or not 0 <= rank < world:
+            raise MXNetError(
+                f"rank {rank} out of range for world {world}")
+        if world > self.num_shards:
+            raise MXNetError(
+                f"world {world} exceeds the job's {self.num_shards} "
+                "logical shards: extra ranks would own no data")
+        if not 0 <= consumed <= self.batches_per_epoch:
+            raise MXNetError(
+                f"consumed {consumed} out of range "
+                f"[0, {self.batches_per_epoch}]")
+        owned = tuple(s for s in range(self.num_shards)
+                      if s % world == rank)
+        self.rank, self.world = rank, world
+        self.consumed = consumed
+        self._owned = owned
+
+    def refresh_membership(self, consumed=0):
+        """Re-read `jax.process_index()/process_count()` and apply it
+        as the membership — the fix for the historical assumption that
+        the process count observed at construction holds for the
+        sampler's lifetime."""
+        rank, world = _default_shard()
+        self.set_membership(rank, world, consumed=consumed)
+
+    @property
+    def owned_shards(self):
+        """Logical shards this process owns under the current
+        membership (ascending)."""
+        return self._owned
+
+    @property
+    def remaining_batches(self):
+        """Local batches left in the current epoch under the current
+        membership (== batches_per_epoch in the default state)."""
+        return len(self._owned) * (self.batches_per_epoch
+                                   - self.consumed)
+
+    def shard_batch(self, shard, p):
+        """Batch `p` (0-based) of logical shard `shard` — the
+        membership-independent pure function of (seed, epoch, shard,
+        p) everything else is defined in terms of."""
+        if not 0 <= shard < self.num_shards:
+            raise IndexError(
+                f"shard {shard} out of range [0, {self.num_shards})")
+        if not 0 <= p < self.batches_per_epoch:
+            raise IndexError(
+                f"batch {p} out of range [0, {self.batches_per_epoch})")
+        lo = shard * self.shard_len + p * self.batch_size
+        return self._perm[lo: lo + self.batch_size]
 
     def epoch_indices(self):
-        """This host's full shard for the current epoch (a copy)."""
-        return self._shard.copy()
+        """This host's remaining stream for the current epoch (a
+        copy): under default membership the full contiguous shard,
+        after a re-key the step-major interleave of the owned shards'
+        unconsumed batches."""
+        if self._default_membership():
+            return self._shard.copy()
+        n = self.remaining_batches
+        if n == 0:
+            return np.empty((0,), dtype=self._perm.dtype)
+        return np.concatenate(
+            [self.batch_indices(k) for k in range(n)])
 
     def batch_indices(self, k):
-        """Sample indices of batch `k` (0-based) of the current epoch."""
-        if not 0 <= k < self.batches_per_epoch:
+        """Sample indices of local batch `k` (0-based) of the current
+        epoch's remaining stream. Under default membership this is the
+        k-th batch of the contiguous shard (the historical contract);
+        after `set_membership` it interleaves the owned logical shards
+        step-major: k-th local batch = owned[k % m]'s per-shard batch
+        `consumed + k // m`."""
+        if self._default_membership():
+            if not 0 <= k < self.batches_per_epoch:
+                raise IndexError(
+                    f"batch {k} out of range "
+                    f"[0, {self.batches_per_epoch})")
+            lo = k * self.batch_size
+            return self._shard[lo: lo + self.batch_size]
+        if not 0 <= k < self.remaining_batches:
             raise IndexError(
-                f"batch {k} out of range "
-                f"[0, {self.batches_per_epoch})")
-        lo = k * self.batch_size
-        return self._shard[lo: lo + self.batch_size]
+                f"batch {k} out of range [0, {self.remaining_batches})")
+        m = len(self._owned)
+        return self.shard_batch(self._owned[k % m],
+                                self.consumed + k // m)
+
+    def _default_membership(self):
+        return (self.world == self.num_shards
+                and self.rank == self.shard_id
+                and self.consumed == 0)
 
     def __len__(self):
-        return self.batches_per_epoch
+        return self.remaining_batches

@@ -1,0 +1,50 @@
+"""mxnet_tpu.elastic — elastic, preemption-tolerant multi-host training.
+
+The production TPU failure mode the reference framework never solved:
+a fleet host is preempted mid-epoch and the whole job dies with it
+(ps-lite's story ends at `get_num_dead_node` + restart-from-scratch).
+This tier is the control plane that lets a training job SHRINK,
+CONTINUE and RE-GROW on any membership change — at bitwise parity with
+the run that was never interrupted.
+
+Composition of earlier tiers (nothing here reinvents substrate):
+
+  fleet/wire.py        length-prefixed JSON framing, writer-thread
+                       channels, heartbeat/staleness discipline
+  sharding/plan.py     ShardingPlan expresses the before/after
+                       {'fsdp': world} layouts; checkpoint_sharded's
+                       per-param spec strings serialize them
+  data/sampler.py      Philox ShardedSampler re-keys logical-shard
+                       ownership mid-epoch (set_membership)
+  numerics/runlog.py   the kill-surviving run event log persists every
+                       transition's quiesce/resume record
+  fault.py             FaultInjector 'kill:step:N' SIGKILLs a live
+                       worker — the soak's preemption stand-in
+
+The bit-identity invariant (docs/elastic.md): the job is cut into a
+FIXED number of logical shards S. Global step p always consumes the
+same S micro-batches, their gradients always combine in logical-shard
+order, and the elementwise optimizer update decomposes over dim-0
+slices — so which PHYSICAL worker computed what is arithmetically
+invisible, and final params after any shrink/re-grow sequence are
+`np.array_equal` to the uninterrupted run's.
+
+Entry points: `ElasticCoordinator` (membership + step engine + the
+three-step transition: quiesce → reshard → re-key), `run_worker` /
+`python -m mxnet_tpu.elastic.agent` (worker agent), `JobSpec` +
+`elastic_job` entry-point convention, `model.fit_elastic` sugar.
+"""
+from __future__ import annotations
+
+from .trainer import ElasticSGD, JobSpec, load_entry
+from .coordinator import ElasticCoordinator
+from .agent import ElasticWorker, run_worker
+
+__all__ = [
+    "ElasticCoordinator",
+    "ElasticSGD",
+    "ElasticWorker",
+    "JobSpec",
+    "load_entry",
+    "run_worker",
+]

@@ -1,0 +1,67 @@
+"""Exact array transport for the elastic wire protocol.
+
+The fleet wire speaks JSON (fleet/wire.py) — fine for control frames,
+lossy for float payloads if they round-trip through decimal text. The
+elastic tier's whole acceptance bar is BITWISE equality of final
+params, so arrays ride the JSON frames as base64 of their raw
+little-endian bytes: encode/decode is `tobytes()`/`frombuffer()`, no
+textual float ever materializes, and a float32 crosses any number of
+hops unchanged.
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+
+import numpy as np
+
+
+def encode(arr):
+    """np.ndarray -> JSON-safe dict (exact byte round-trip)."""
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype.byteorder == ">":
+        arr = arr.astype(arr.dtype.newbyteorder("<"))
+    return {
+        "d": base64.b64encode(arr.tobytes()).decode("ascii"),
+        "s": list(arr.shape),
+        "t": arr.dtype.str if arr.dtype.byteorder != "=" else
+             arr.dtype.newbyteorder("<").str,
+    }
+
+
+def decode(obj):
+    """Inverse of encode (returns a writable array)."""
+    raw = base64.b64decode(obj["d"])
+    arr = np.frombuffer(raw, dtype=np.dtype(obj["t"]))
+    return arr.reshape(tuple(obj["s"])).copy()
+
+
+def encode_tree(tree):
+    """{name: array} -> {name: encoded}."""
+    return {k: encode(v) for k, v in tree.items()}
+
+
+def decode_tree(tree):
+    """{name: encoded} -> {name: array}."""
+    return {k: decode(v) for k, v in tree.items()}
+
+
+def payload_bytes(obj):
+    """Raw (pre-base64) byte count of one encoded array or a tree of
+    them — what elasticStats counts as 'moved'."""
+    if "d" in obj and "s" in obj:
+        return len(obj["d"]) * 3 // 4
+    return sum(payload_bytes(v) for v in obj.values())
+
+
+def digest(tree):
+    """Order-independent content hash of {name: array} — workers put
+    this in heartbeats so cross-worker param divergence is a counted
+    mismatch, not silent drift."""
+    h = hashlib.sha1()
+    for name in sorted(tree):
+        a = np.ascontiguousarray(tree[name])
+        h.update(name.encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()

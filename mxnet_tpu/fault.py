@@ -21,6 +21,13 @@ rebuild to close the gap with checkpoint-and-restart orchestration.
 - `FaultInjector` (env MXNET_TPU_FAULT_INJECT="epoch:N" or "step:N")
   kills training at epoch N / global step N — the fault-injection
   harness used by the resume tests and ci/check_input_stall.py.
+- MXNET_TPU_FAULT_INJECT="kill:step:N" is the HARD variant: instead
+  of raising (which unwinds `finally:` blocks, flushes buffers, runs
+  atexit hooks — none of which a preempted TPU host gets to do) it
+  SIGKILLs the live process at step N. No Python teardown executes.
+  This is what the elastic-training soak (ci/check_elastic.py) injects:
+  surviving that proves durability came from state persisted BEFORE
+  the step, not from a graceful shutdown path.
 - MXNET_TPU_FAULT_INJECT="nan:step:N[:param]" is the NUMERICS fault:
   instead of killing the process it poisons one gradient tensor with
   NaN on-device at fused step N (parse_nan_inject, consumed by
@@ -82,7 +89,9 @@ class FaultInjector(object):
     """Deterministic crash injection for resilience tests. Spec comes
     from MXNET_TPU_FAULT_INJECT: 'epoch:N' fires after the checkpoint
     of epoch N is durable; 'step:N' fires when the global batch
-    counter reaches N (mid-epoch — the hard resume case). Fires once."""
+    counter reaches N (mid-epoch — the hard resume case). 'kill:step:N'
+    is the no-teardown form: SIGKILL to our own pid instead of a
+    Python exception. Fires once."""
 
     def __init__(self, spec=None):
         self.spec = spec if spec is not None else os.environ.get(
@@ -92,6 +101,17 @@ class FaultInjector(object):
 
     def _parse(self):
         kind, _, val = self.spec.partition(":")
+        if kind == "kill":
+            # "kill:step:N" — the mode is the second field, SIGKILL
+            # the delivery. Only step-keyed kills exist: epoch
+            # boundaries are already durable, killing there is the
+            # easy case the soak is not interested in.
+            sub, _, n = val.partition(":")
+            if sub != "step":
+                raise MXNetError(
+                    f"bad kill fault spec {self.spec!r}: expected "
+                    "'kill:step:N'")
+            return "kill", n
         return kind, val
 
     def maybe_fail(self, epoch):
@@ -121,6 +141,13 @@ class FaultInjector(object):
                 f"[fault-injection] simulated failure at step "
                 f"{self._steps}"
             )
+        if kind == "kill" and self._steps == int(val):
+            # flight record first — it is the only artifact a
+            # SIGKILLed process leaves behind by choice
+            _flight.maybe_dump(f"fault_injector:{self.spec}")
+            import signal
+
+            os.kill(os.getpid(), signal.SIGKILL)
 
 
 def fit_auto_resume(module, train_data, prefix, num_epoch,

@@ -82,3 +82,39 @@ def test_fault_injector_spec_parsing(monkeypatch):
     fi.maybe_fail(2)  # no-op
     with pytest.raises(RuntimeError):
         fi.maybe_fail(3)
+
+
+def test_kill_spec_rejects_non_step():
+    with pytest.raises(fault.MXNetError):
+        fault.FaultInjector("kill:epoch:2").note_step()
+
+
+def test_kill_step_is_sigkill_no_teardown():
+    """'kill:step:N' must take the process down the way a preemption
+    does: SIGKILL, no exception unwind, no atexit, no finally. The
+    child registers every graceful-shutdown hook Python offers and the
+    test asserts none of them ran."""
+    import signal
+    import subprocess
+    import sys
+
+    code = """
+import atexit, sys
+atexit.register(lambda: print("ATEXIT-RAN", flush=True))
+from mxnet_tpu.fault import FaultInjector
+fi = FaultInjector("kill:step:3")
+try:
+    for i in range(10):
+        print("step", i, flush=True)
+        fi.note_step()
+finally:
+    print("FINALLY-RAN", flush=True)
+print("SURVIVED", flush=True)
+"""
+    r = subprocess.run([sys.executable, "-c", code],
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == -signal.SIGKILL
+    assert "step 2" in r.stdout          # the 3rd note_step fired
+    assert "step 3" not in r.stdout
+    for marker in ("SURVIVED", "FINALLY-RAN", "ATEXIT-RAN"):
+        assert marker not in r.stdout

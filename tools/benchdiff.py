@@ -42,6 +42,9 @@ LOWER_IS_BETTER = (
     # BENCH_MODE=decode int8 arm: logit drift vs float32 must never
     # grow (quantization-error regression canary)
     "int8_logit_drift",
+    # BENCH_MODE=elastic: a membership transition's availability cost
+    # (quiesce barrier wall) and the state it ships must only shrink
+    "elastic_quiesce_wall_ms", "elastic_reshard_bytes_moved",
 )
 
 # secondary per-record keys where BIGGER is better (work avoided per
@@ -64,6 +67,10 @@ HIGHER_IS_BETTER = (
     # quantized decode throughput — all must hold or improve
     "kv_pool_capacity_ratio", "int8_top1_agreement",
     "decode_tokens_per_s_int8",
+    # BENCH_MODE=elastic: training throughput across a shrink + grow,
+    # and how much of the naive restore-everyone broadcast the
+    # placement delta avoids
+    "elastic_steps_per_s", "elastic_reshard_savings",
 )
 
 

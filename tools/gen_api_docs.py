@@ -45,6 +45,8 @@ MODULES = [
      "continuous-batching autoregressive decode, paged KV cache"),
     ("mxnet_tpu.fleet",
      "multi-replica serving control plane (routing, autoscale, drain)"),
+    ("mxnet_tpu.elastic",
+     "elastic training control plane (membership, reshard, re-key)"),
     ("mxnet_tpu.analysis", "static analyzer (mxlint) + graph verifier"),
     ("mxnet_tpu.passes", "graph-optimization pass pipeline + autotuner"),
     ("mxnet_tpu.visualization", "network plots/summaries"),

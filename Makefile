@@ -12,7 +12,7 @@ XLA8    := XLA_FLAGS=--xla_force_host_platform_device_count=8
 .PHONY: all test nightly examples lint lint-check libs predict perl \
 	docs dryrun cache-check serving-check sync-check data-check \
 	passes-check telemetry-check decode-check race-check \
-	fusion-check \
+	effects-check fusion-check \
 	shard-check profiling-check numerics-check coldstart-check \
 	fleet-check quant-check elastic-check bench-diff clean
 
@@ -119,6 +119,13 @@ decode-check:
 # grid at token parity with zero retraces)
 fusion-check:
 	$(CPUENV) bash ci/check_fusion.sh
+
+# effects + protocol gate: MX010-MX013 clean tree with no baseline,
+# then one seeded violation per rule (jit impurity, use-after-donate,
+# unordered digest iteration, orphaned wire op) each caught with
+# exactly its own code. Stdlib-only — no CPU guard needed.
+effects-check:
+	bash ci/check_effects.sh
 
 # concurrency race gate: MX006-MX008 clean tree with no baseline, a
 # seeded lock-order inversion caught both statically (MX007) and by

@@ -1,0 +1,111 @@
+#!/usr/bin/env bash
+# Effects + protocol gate (docs/analysis.md, Effects and protocol
+# rules). Two halves, each proven both ways:
+#
+# 1. The tree is clean: the effects pass (MX010 jit purity, MX011
+#    use-after-donate, MX012 digest-path determinism) and the
+#    wire-protocol pass (MX013 sender/handler drift) report ZERO
+#    findings with NO baseline — every true positive in the tree has
+#    been fixed, not grandfathered.
+# 2. The gate gates: one seeded violation PER RULE in scratch files
+#    must be flagged with exactly that rule's code (guards against an
+#    engine edit that silently stops seeing a whole rule — an
+#    analyzer that crashes into "0 findings" would otherwise pass).
+#
+# The seeded fixtures use the in-file opt-ins (MXLINT_DIGEST_PATH,
+# MXLINT_PROTOCOL) — the same hooks a new subsystem uses to declare
+# its digest writers / wire protocol without touching the analyzer.
+# Stdlib-only: mxlint never imports jax or the framework package.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== effects: full tree, MX010-MX013, no baseline"
+python tools/mxlint.py mxnet_tpu tools examples \
+    --select MX010,MX011,MX012,MX013 --no-baseline
+
+scratch="$(mktemp -d)"
+trap 'rm -rf "$scratch"' EXIT
+
+seed_must_fail() {  # <rule> <dir>: mxlint must flag <dir> with <rule>
+    local rule="$1" dir="$2"
+    if python tools/mxlint.py "$dir" --no-baseline --no-cache \
+            --select "$rule" > "$dir/out.txt"; then
+        echo "FAIL: seeded $rule violation not flagged" >&2
+        cat "$dir/out.txt" >&2
+        exit 1
+    fi
+    grep -q "$rule" "$dir/out.txt" \
+        || { echo "FAIL: non-$rule failure:" >&2
+             cat "$dir/out.txt" >&2; exit 1; }
+    echo "ok: seeded violation flagged ($rule)"
+}
+
+echo "== effects: seeded MX010 (impure jitted function)"
+mkdir -p "$scratch/mx010"
+cat > "$scratch/mx010/seeded.py" <<'EOF'
+import jax
+
+LOG = []
+
+
+def step(x):
+    LOG.append(x)      # trace-time effect: fires once, then never
+    return x + 1
+
+
+run = jax.jit(step)
+EOF
+seed_must_fail MX010 "$scratch/mx010"
+
+echo "== effects: seeded MX011 (use after donate)"
+mkdir -p "$scratch/mx011"
+cat > "$scratch/mx011/seeded.py" <<'EOF'
+import jax
+
+
+def _run(params, x):
+    return params, x
+
+
+step = jax.jit(_run, donate_argnums=(0,))
+
+
+def go(params, x):
+    out = step(params, x)
+    return params      # donated buffer read after dispatch
+EOF
+seed_must_fail MX011 "$scratch/mx011"
+
+echo "== effects: seeded MX012 (unordered iteration on digest path)"
+mkdir -p "$scratch/mx012"
+cat > "$scratch/mx012/seeded.py" <<'EOF'
+MXLINT_DIGEST_PATH = "*"
+
+
+def tree_sig(tree):
+    return tuple(k for k in tree.values())   # unspecified order
+EOF
+seed_must_fail MX012 "$scratch/mx012"
+
+echo "== effects: seeded MX013 (wire-protocol drift)"
+mkdir -p "$scratch/mx013"
+cat > "$scratch/mx013/sender.py" <<'EOF'
+MXLINT_PROTOCOL = "seeded"
+
+
+def run(sock):
+    sock.send({"op": "ping", "seq": 1})
+    sock.send({"op": "orphan"})      # no handler matches this op
+EOF
+cat > "$scratch/mx013/handler.py" <<'EOF'
+MXLINT_PROTOCOL = "seeded"
+
+
+def on_message(sock, msg):
+    op = msg.get("op")
+    if op == "ping":
+        return msg["seq"]
+EOF
+seed_must_fail MX013 "$scratch/mx013"
+
+echo "effects-check OK"

@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# mxlint CI gate (docs/analysis.md). Three checks:
+# mxlint CI gate (docs/analysis.md). Four checks:
 #
 # 1. The tree is clean: mxlint over mxnet_tpu/tools/examples reports
 #    zero findings beyond ci/mxlint_baseline.json.
@@ -8,6 +8,11 @@
 # 3. The gate gates: a seeded violation in a scratch file must make
 #    mxlint exit non-zero (guards against a silently broken engine —
 #    an analyzer that crashes into "0 findings" would otherwise pass).
+# 4. The cache pays for itself: a warm run (against a scratch
+#    .mxlint_cache.json written by the cold run) must finish in at
+#    most 50% of the cold run's wall time AND under a pinned absolute
+#    budget, so the gate cannot silently grow unbounded as the tree
+#    and the rule set do.
 #
 # The CLI is stdlib-only (never imports jax/mxnet_tpu), so this script
 # needs no backend guards and runs anywhere python runs.
@@ -31,8 +36,42 @@ try:
 except:
     pass
 EOF
-if python tools/mxlint.py "$scratch" --no-baseline > /dev/null; then
+if python tools/mxlint.py "$scratch" --no-baseline --no-cache \
+        > /dev/null; then
     echo "FAIL: mxlint did not flag the seeded violations" >&2
     exit 1
 fi
 echo "ok: seeded violation rejected"
+
+echo "== mxlint: cache speed (warm <= 50% of cold, warm <= 5s)"
+python - "$scratch" <<'EOF'
+import subprocess
+import sys
+import time
+import os
+
+WARM_BUDGET_S = 5.0  # pinned: a warm CI lint gate must stay this fast
+
+cache = os.path.join(sys.argv[1], "timing_cache.json")
+cmd = [sys.executable, "tools/mxlint.py", "mxnet_tpu", "tools",
+       "examples", "--cache", cache]
+
+
+def timed_run():
+    t0 = time.monotonic()
+    subprocess.run(cmd, check=True, stdout=subprocess.DEVNULL)
+    return time.monotonic() - t0
+
+
+cold = timed_run()   # scratch cache: everything misses
+warm = timed_run()   # same tree, same cache: everything hits
+print(f"cold={cold:.2f}s warm={warm:.2f}s "
+      f"(ratio {warm / cold:.1%})")
+if warm > 0.5 * cold:
+    sys.exit(f"FAIL: warm lint {warm:.2f}s exceeds 50% of "
+             f"cold {cold:.2f}s")
+if warm > WARM_BUDGET_S:
+    sys.exit(f"FAIL: warm lint {warm:.2f}s exceeds the pinned "
+             f"{WARM_BUDGET_S:.0f}s budget")
+print("ok: warm lint within budget")
+EOF

@@ -19,6 +19,12 @@ Two halves (docs/analysis.md):
     records actual acquisition order and raises on a genuine
     lock-order cycle. Wired as the CI race gate
     (ci/check_concurrency.sh).
+  - **effects + protocol analysis** (effects.py + protocol.py):
+    project-scope rules MX010-MX012 (jit purity via call-graph
+    reachability, use-after-donate dataflow, digest-path
+    determinism) and MX013 (wire-protocol sender/handler drift over
+    the fleet and elastic control planes). Wired as the CI effects
+    gate (ci/check_effects.sh).
 """
 from . import rules
 from . import lint
@@ -26,6 +32,8 @@ from . import graph_verify
 from . import callgraph
 from . import concurrency
 from . import lockwitness
+from . import effects
+from . import protocol
 from .graph_verify import (GraphIssue, GraphVerifyError, verify_graph,
                            verify_sharding)
 from .lint import Finding, lint_file, lint_paths
@@ -35,6 +43,7 @@ from .lockwitness import LockOrderViolation
 __all__ = [
     "rules", "lint", "graph_verify",
     "callgraph", "concurrency", "lockwitness",
+    "effects", "protocol",
     "GraphIssue", "GraphVerifyError", "verify_graph",
     "verify_sharding",
     "Finding", "lint_file", "lint_paths",

@@ -132,9 +132,10 @@ class ConcurrencyModel:
     """Lock registry + held-before graph + MX006/7/8 findings over a
     set of parsed files ((relpath, tree) pairs)."""
 
-    def __init__(self, files):
+    def __init__(self, files, graph=None):
         self.files = [(r, t) for r, t in files]
-        self.graph = _cg.CallGraph(self.files)
+        self.graph = graph if graph is not None \
+            else _cg.CallGraph(self.files)
         self.locks = {}          # LockId -> LockInfo
         self._class_locks = {}   # class key -> [LockId]
         self._module_locks = {}  # (relpath, name) -> LockId
@@ -671,7 +672,9 @@ def _bounded(call):
     return True
 
 
-def check_project(files):
+def check_project(files, graph=None):
     """Engine entry point: [(relpath, RawFinding)] for MX006-MX008
-    over the given (relpath, tree) pairs."""
-    return ConcurrencyModel(files).findings()
+    over the given (relpath, tree) pairs. Pass a prebuilt CallGraph
+    to share the (expensive) interprocedural index with the other
+    project passes."""
+    return ConcurrencyModel(files, graph=graph).findings()

@@ -10,6 +10,11 @@ parsed file); this module owns everything around it:
   - the checked-in baseline (grandfathered findings, matched by
     (rule, path, stripped source line) so line-number drift does not
     invalidate entries)
+  - the on-disk result cache (.mxlint_cache.json): per-file findings
+    keyed by content hash, project-scope findings keyed by the hash of
+    the whole scanned tree, both invalidated wholesale when any
+    analysis/*.py source changes (the engine version hash)
+  - optional multi-process file analysis (`--jobs N`)
   - text / JSON output
 
 Stdlib-only by design: `tools/mxlint.py` (and the CI lint gate) run it
@@ -18,6 +23,7 @@ without importing jax or the framework package.
 from __future__ import annotations
 
 import ast
+import hashlib
 import json
 import os
 import re
@@ -105,43 +111,213 @@ def lint_file(path, relpath, registered_envs, select=None, parsed=None):
     return out
 
 
+# -------------------------------------------------------------------- cache
+_ENGINE_VERSION = None
+
+
+def engine_version():
+    """sha256 over every analysis/*.py source. Any edit to the engine,
+    the rules, or a project pass invalidates the whole cache."""
+    global _ENGINE_VERSION
+    if _ENGINE_VERSION is None:
+        here = os.path.dirname(os.path.abspath(__file__))
+        h = hashlib.sha256()
+        for name in sorted(os.listdir(here)):
+            if name.endswith(".py"):
+                h.update(name.encode("utf-8"))
+                with open(os.path.join(here, name), "rb") as f:
+                    h.update(f.read())
+        _ENGINE_VERSION = h.hexdigest()
+    return _ENGINE_VERSION
+
+
+def _load_cache(cache_path, registry_key):
+    try:
+        with open(cache_path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {"files": {}, "project": {}}
+    if (data.get("engine") != engine_version()
+            or data.get("registry") != registry_key):
+        return {"files": {}, "project": {}}
+    return {"files": data.get("files", {}),
+            "project": data.get("project", {})}
+
+
+def _save_cache(cache_path, registry_key, file_entries, project_entry):
+    data = {
+        "comment": "mxlint result cache — machine-written, gitignored.",
+        "engine": engine_version(),
+        "registry": registry_key,
+        "files": file_entries,
+        "project": project_entry,
+    }
+    tmp = cache_path + ".tmp"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(data, f, sort_keys=True)
+        os.replace(tmp, cache_path)
+    except OSError:
+        pass  # a read-only checkout only loses the speedup
+
+
+def _thaw(dicts, select=None):
+    out = [Finding(**d) for d in dicts]
+    if select:
+        out = [f for f in out if f.rule in select]
+    return out
+
+
+def _lint_one(job):
+    """Worker for --jobs: full (unselected) findings as plain dicts,
+    so results are picklable and cacheable."""
+    path, rel, registered = job
+    return rel, [asdict(f) for f in lint_file(path, rel, registered)]
+
+
+def _ensure_parsed(file_list, parsed):
+    """Parse any scanned file not already in `parsed` (cache hits and
+    --jobs workers skip the in-process parse). Files that fail to parse
+    stay out, exactly as lint_file leaves them."""
+    for path, rel, _digest in file_list:
+        if rel in parsed:
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            tree = ast.parse(src, filename=path)
+        except (OSError, SyntaxError):
+            continue
+        parsed[rel] = (tree, src.splitlines())
+
+
 def lint_paths(paths, root=None, select=None, extra_registry_paths=(),
-               concurrency=True):
+               concurrency=True, cache_path=None, jobs=0):
     """Lint every .py file under `paths`.
 
     `root` anchors repo-relative paths (defaults to the common parent);
     the env registry for MX003 is collected from the scanned files plus
     `extra_registry_paths` (canonically mxnet_tpu/utils/__init__.py,
     so linting a subdirectory still sees the full registry).
-    `concurrency` runs the project-scope MX006-MX008 pass (one pass
-    over all parsed files, not per-file)."""
+    `concurrency` runs the project-scope passes (MX006-MX008
+    concurrency, MX010-MX012 effects, MX013 protocol) over all parsed
+    files at once.
+
+    `cache_path` enables the on-disk result cache: per-file findings
+    are keyed by content hash, project-scope findings by the hash of
+    the whole scanned tree, and everything is invalidated when any
+    analysis/*.py source changes. Cached entries always hold the FULL
+    (unselected) finding set — `select` filters on the way out — so a
+    cache written by one invocation is valid for any other.
+
+    `jobs` > 1 analyzes cache-miss files in that many worker
+    processes (the project passes stay in-process)."""
     root = os.path.abspath(root or os.getcwd())
     scan = [os.path.abspath(p) for p in paths]
     registered = _rules.collect_registered_envs(
         scan + [os.path.abspath(p) for p in extra_registry_paths])
-    findings = []
-    parsed = {}
+    registry_key = hashlib.sha256(
+        "\n".join(sorted(registered)).encode("utf-8")).hexdigest()
+
+    file_list = []
     for path in _rules._iter_py(scan):
         rel = os.path.relpath(path, root).replace(os.sep, "/")
-        findings.extend(lint_file(path, rel, registered, select=select,
-                                  parsed=parsed))
+        try:
+            with open(path, "rb") as f:
+                digest = hashlib.sha256(f.read()).hexdigest()
+        except OSError:
+            continue
+        file_list.append((path, rel, digest))
+
+    cache = (_load_cache(cache_path, registry_key) if cache_path
+             else {"files": {}, "project": {}})
+    file_entries = dict(cache["files"])  # keep entries for other scans
+
+    findings = []
+    parsed = {}
+    misses = []
+    for path, rel, digest in file_list:
+        ent = cache["files"].get(rel)
+        if ent and ent.get("hash") == digest:
+            findings.extend(_thaw(ent["findings"], select=select))
+        else:
+            misses.append((path, rel, digest))
+
+    if jobs and jobs > 1 and len(misses) > 1:
+        from concurrent.futures import ProcessPoolExecutor
+        jobs_args = [(path, rel, registered)
+                     for path, rel, _digest in misses]
+        digests = {rel: d for _p, rel, d in misses}
+        results = {}
+        try:
+            with ProcessPoolExecutor(max_workers=jobs) as pool:
+                for rel, dicts in pool.map(_lint_one, jobs_args):
+                    results[rel] = dicts
+        except Exception:
+            results = None  # no fork / broken pool: redo serially
+        if results is not None:
+            for rel, dicts in results.items():
+                findings.extend(_thaw(dicts, select=select))
+                file_entries[rel] = {"hash": digests[rel],
+                                     "findings": dicts}
+            misses = []
+    for path, rel, digest in misses:
+        full = lint_file(path, rel, registered, parsed=parsed)
+        findings.extend(f for f in full
+                        if not select or f.rule in select)
+        file_entries[rel] = {"hash": digest,
+                             "findings": [asdict(f) for f in full]}
+
+    # project cache: {tree_hash: findings}, a few entries so scans of
+    # different path sets (full tree, analyzer-only self-host pass)
+    # stay warm side by side
+    project_map = dict(cache["project"])
     if concurrency and (not select
                         or set(select) & set(_rules.PROJECT_RULES)):
-        findings.extend(_project_findings(parsed, select=select))
+        tree_hash = hashlib.sha256("\n".join(sorted(
+            f"{rel}:{d}" for _p, rel, d in file_list)).encode("utf-8")
+        ).hexdigest()
+        if tree_hash in project_map:
+            dicts = project_map.pop(tree_hash)  # re-insert: LRU order
+            findings.extend(_thaw(dicts, select=select))
+        else:
+            _ensure_parsed(file_list, parsed)
+            full = _project_findings(parsed)
+            findings.extend(f for f in full
+                            if not select or f.rule in select)
+            dicts = [asdict(f) for f in full]
+        project_map[tree_hash] = dicts
+        while len(project_map) > 4:
+            project_map.pop(next(iter(project_map)))
+
+    if cache_path:
+        _save_cache(cache_path, registry_key, file_entries,
+                    project_map)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
 
 def _project_findings(parsed, select=None):
-    """MX006-MX008 over the whole parsed file set, routed through the
-    same inline suppressions as per-file rules (the baseline applies
-    downstream in run(), identically)."""
+    """Project-scope rules (MX006-MX008 concurrency, MX010-MX012
+    effects, MX013 protocol drift) over the whole parsed file set,
+    routed through the same inline suppressions as per-file rules
+    (the baseline applies downstream in run(), identically)."""
     try:  # normal package import
+        from . import callgraph as _callgraph
         from . import concurrency as _conc
+        from . import effects as _eff
+        from . import protocol as _proto
     except ImportError:  # loaded standalone (tools/mxlint.py)
+        import callgraph as _callgraph
         import concurrency as _conc
-    raw_findings = _conc.check_project(
-        [(rel, tree) for rel, (tree, _lines) in sorted(parsed.items())])
+        import effects as _eff
+        import protocol as _proto
+    files = [(rel, tree)
+             for rel, (tree, _lines) in sorted(parsed.items())]
+    graph = _callgraph.CallGraph(files)
+    raw_findings = list(_conc.check_project(files, graph=graph))
+    raw_findings.extend(_eff.check_project(files, graph=graph))
+    raw_findings.extend(_proto.check_project(files))
     supp = {}
     out = []
     for rel, raw in raw_findings:
@@ -239,12 +415,14 @@ def render_json(new, baselined):
 
 
 def run(paths, root=None, baseline_path=None, fmt="text", select=None,
-        show_baselined=False, extra_registry_paths=(), concurrency=True):
+        show_baselined=False, extra_registry_paths=(), concurrency=True,
+        cache_path=None, jobs=0):
     """One full lint pass. Returns (exit_code, report_text):
     exit code 1 iff any non-baselined finding exists."""
     findings = lint_paths(paths, root=root, select=select,
                           extra_registry_paths=extra_registry_paths,
-                          concurrency=concurrency)
+                          concurrency=concurrency,
+                          cache_path=cache_path, jobs=jobs)
     baseline = {}
     if baseline_path and os.path.exists(baseline_path):
         baseline = load_baseline(baseline_path)

@@ -1,0 +1,690 @@
+"""MX013 — wire-protocol drift.
+
+The framework has two hand-rolled wire protocols (length-prefixed JSON
+frames, op-keyed dicts): the fleet control plane (router ⇄ replica ⇄
+admin CLI) and the elastic training plane (coordinator ⇄ agent). No
+compiler relates a sender to its handler, so the two ends drift: an op
+gets renamed on one side, a handler keeps matching an op nobody sends,
+a handler indexes a field the sender stopped providing. This pass
+AST-extracts both ends and reports three kinds of drift:
+
+  - **sent-but-unhandled**: a frame is sent with an op no handler in
+    the protocol group matches — the receiver silently drops it.
+  - **dead handler**: a handler matches an op no sender in the group
+    ever puts on the wire — dead code at best, a renamed-op bug at
+    worst.
+  - **missing field**: a handler *requires* a field (`msg["f"]`
+    subscript — `.get()` is optional by construction) that no sender
+    of that op provides — a KeyError waiting for that frame.
+
+What counts as a send: `<anything>.send(frame)` and
+`send_frame(sock, frame)` where the frame resolves to a dict literal
+carrying a constant (or IfExp-of-constants) `"op"` — directly, via a
+local name assigned the literal (later `name["k"] = v` subscript
+stores count as fields), or via a call into a same-file function that
+builds and returns such a dict. Frames without an op key (the fleet
+token/done/handoff streams, admin replies) are not protocol frames
+and are ignored. Declared `sender_fns` cover senders whose op is a
+parameter (the admin CLI's `admin_call`): each *call site* with a
+constant op contributes, and send sites inside the sender function
+itself are exempt.
+
+What counts as a handler: comparisons of an op-read (`msg.get("op")`,
+`msg["op"]`, or a variable bound from one) against string constants
+(`==` dispatch chains and `!=` guards), `op in ("a", "b")` tuples,
+and declared `await_fns` (the elastic agent's `self._await(("op",))`
+pattern — the tuple's strings are handled ops, and required fields
+are collected from subscripts on the call's result variable plus one
+interprocedural hop when that variable is passed straight into a
+same-file function).
+
+Required-field extraction is deliberately an under-approximation
+(only `==`-branch bodies and await-result flows are attributed, and a
+`"f" in msg` membership guard marks the field optional); sent-field
+extraction is an over-approximation (IfExp ops share the union of
+fields, `.update(...)` marks the frame dynamic and mutes the field
+check for that op). Both biases push toward silence, never toward a
+false alarm.
+
+A file joins a protocol group either through the PROTOCOLS manifest
+below or with a module-level `MXLINT_PROTOCOL = "<group>"` constant —
+the latter is how a new subsystem declares its protocol without
+touching this file (and how the CI gate seeds a violation).
+
+Stdlib-only, like the rest of the analyzer.
+"""
+from __future__ import annotations
+
+import ast
+
+try:  # normal package import
+    from .rules import RawFinding
+except ImportError:  # loaded standalone (tools/mxlint.py)
+    from rules import RawFinding
+
+OP_KEY = "op"
+
+#: protocol groups: name -> {"files": (relpath, ...),
+#:                           "await_fns": (name, ...),
+#:                           "sender_fns": {name: {"op_arg": i,
+#:                                                 "extra_fields": (...)}}}
+PROTOCOLS = {
+    "elastic": {
+        "files": ("mxnet_tpu/elastic/coordinator.py",
+                  "mxnet_tpu/elastic/agent.py"),
+        "await_fns": ("_await",),
+        "sender_fns": {},
+    },
+    "fleet": {
+        "files": ("mxnet_tpu/fleet/router.py",
+                  "mxnet_tpu/fleet/replica.py",
+                  "tools/mx_fleet.py"),
+        "await_fns": (),
+        # admin_call(addr, op, **kw) frames every CLI request: the op
+        # is its 2nd positional, kwargs become frame fields, and the
+        # function itself adds "id"
+        "sender_fns": {"admin_call": {"op_arg": 1,
+                                      "extra_fields": ("id",)}},
+    },
+}
+
+
+def file_protocol(tree):
+    """Module-level `MXLINT_PROTOCOL = "name"`, or None."""
+    for node in getattr(tree, "body", ()):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if (isinstance(tgt, ast.Name)
+                        and tgt.id == "MXLINT_PROTOCOL"
+                        and isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, str)):
+                    return node.value.value
+    return None
+
+
+# --------------------------------------------------------------------------
+# small AST helpers
+# --------------------------------------------------------------------------
+def _const_str(node):
+    return node.value if (isinstance(node, ast.Constant)
+                          and isinstance(node.value, str)) else None
+
+
+def _op_values(node):
+    """Constant op expression -> list of ops ("x", or IfExp of two
+    constants -> both); [] if dynamic."""
+    s = _const_str(node)
+    if s is not None:
+        return [s]
+    if isinstance(node, ast.IfExp):
+        a, b = _const_str(node.body), _const_str(node.orelse)
+        if a is not None and b is not None:
+            return [a, b]
+    return []
+
+
+def _func_leaf(func):
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _all_defs(tree):
+    """[(node, name)] for every def at any depth."""
+    return [(n, n.name) for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def _enclosing_map(tree):
+    """{id(node) -> innermost enclosing def node} for every node —
+    exclusive, so a def maps to its PARENT def (or None), never to
+    itself (the sender_fns chain walk relies on this terminating)."""
+    owner = {}
+
+    def walk(node, fn):
+        for child in ast.iter_child_nodes(node):
+            owner[id(child)] = fn
+            walk(child,
+                 child if isinstance(
+                     child, (ast.FunctionDef, ast.AsyncFunctionDef))
+                 else fn)
+
+    walk(tree, None)
+    return owner
+
+
+def _is_op_read(node, msg_names=None):
+    """True for `X.get("op")` / `X["op"]` (optionally restricted to
+    receivers named in msg_names)."""
+    if (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "get" and node.args
+            and _const_str(node.args[0]) == OP_KEY):
+        recv = node.func.value
+    elif (isinstance(node, ast.Subscript)
+          and _const_str(node.slice) == OP_KEY):
+        recv = node.value
+    else:
+        return False
+    if msg_names is None:
+        return True
+    return isinstance(recv, ast.Name) and recv.id in msg_names
+
+
+def _msg_receiver(node):
+    """The receiver Name of an op-read, or None."""
+    if isinstance(node, ast.Call):
+        recv = node.func.value
+    else:
+        recv = node.value
+    return recv.id if isinstance(recv, ast.Name) else None
+
+
+# --------------------------------------------------------------------------
+# sender side
+# --------------------------------------------------------------------------
+class _Sent:
+    __slots__ = ("op", "fields", "dynamic", "relpath", "line")
+
+    def __init__(self, op, fields, dynamic, relpath, line):
+        self.op, self.fields, self.dynamic = op, set(fields), dynamic
+        self.relpath, self.line = relpath, line
+
+
+def _dict_fields(d):
+    """(fields, dynamic) of a dict literal: None keys (**spread) and
+    non-constant keys make it dynamic."""
+    fields, dynamic = set(), False
+    for k in d.keys:
+        s = _const_str(k)
+        if s is None:
+            dynamic = True
+        else:
+            fields.add(s)
+    return fields, dynamic
+
+
+def _frame_from_dict(d):
+    """(ops, fields, dynamic) from a dict literal, or None if it has
+    no op key (not a protocol frame)."""
+    fields, dynamic = _dict_fields(d)
+    if OP_KEY not in fields:
+        return None
+    for k, v in zip(d.keys, d.values):
+        if _const_str(k) == OP_KEY:
+            ops = _op_values(v)
+            return (ops, fields, dynamic or not ops)
+    return None
+
+
+def _subscript_stores(fn_node, names):
+    """Constant keys stored via `name[key] = ...` / dynamic marker for
+    `name.update(...)` calls, for any name in `names`, anywhere in the
+    function."""
+    fields, dynamic = set(), False
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, ast.Store):
+            if isinstance(node.value, ast.Name) \
+                    and node.value.id in names:
+                s = _const_str(node.slice)
+                if s is None:
+                    dynamic = True
+                else:
+                    fields.add(s)
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr == "update"
+              and isinstance(node.func.value, ast.Name)
+              and node.func.value.id in names):
+            dynamic = True
+    return fields, dynamic
+
+
+def _returned_frame(fn_node):
+    """(ops, fields, dynamic) for a function that builds a dict
+    literal, optionally subscript-extends it, and returns it."""
+    built = {}   # name -> (ops, fields, dynamic)
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Dict):
+            fr = _frame_from_dict(node.value)
+            if fr is None:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    built[t.id] = fr
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Return) and isinstance(
+                node.value, ast.Name) and node.value.id in built:
+            ops, fields, dynamic = built[node.value.id]
+            extra, dyn2 = _subscript_stores(fn_node, {node.value.id})
+            return ops, fields | extra, dynamic or dyn2
+    return None
+
+
+def _resolve_frame(arg, fn_node, defs_by_name):
+    """(ops, fields, dynamic) of a send argument, or None if it is
+    not a protocol frame (no resolvable op key)."""
+    if isinstance(arg, ast.Dict):
+        return _frame_from_dict(arg)
+    if isinstance(arg, ast.Name) and fn_node is not None:
+        # nearest assignment of that name in the enclosing function
+        for node in ast.walk(fn_node):
+            if not (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == arg.id
+                            for t in node.targets)):
+                continue
+            fr = None
+            if isinstance(node.value, ast.Dict):
+                fr = _frame_from_dict(node.value)
+            elif isinstance(node.value, ast.Call):
+                callee = defs_by_name.get(_func_leaf(node.value.func))
+                if callee is not None:
+                    fr = _returned_frame(callee)
+            if fr is not None:
+                ops, fields, dynamic = fr
+                extra, dyn2 = _subscript_stores(fn_node, {arg.id})
+                return ops, fields | extra, dynamic or dyn2
+        return None
+    if isinstance(arg, ast.Call):
+        callee = defs_by_name.get(_func_leaf(arg.func))
+        if callee is not None:
+            return _returned_frame(callee)
+    return None
+
+
+def _collect_sends(relpath, tree, sender_fns):
+    """[_Sent] for one file; send sites inside a declared sender_fn
+    are exempt (the fn's call sites carry the real ops)."""
+    out = []
+    owner = _enclosing_map(tree)
+    defs_by_name = dict((name, node)
+                        for node, name in reversed(_all_defs(tree)))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        leaf = _func_leaf(node.func)
+        fn = owner.get(id(node))
+        in_sender = False
+        cur = fn
+        while cur is not None:
+            if cur.name in sender_fns:
+                in_sender = True
+                break
+            cur = owner.get(id(cur))
+        # declared dynamic sender: each call site with a constant op
+        if leaf in sender_fns and not in_sender:
+            spec = sender_fns[leaf]
+            idx = spec.get("op_arg", 1)
+            if idx < len(node.args):
+                for op in _op_values(node.args[idx]):
+                    fields = {OP_KEY, *spec.get("extra_fields", ())}
+                    fields.update(kw.arg for kw in node.keywords
+                                  if kw.arg)
+                    dyn = any(kw.arg is None for kw in node.keywords)
+                    out.append(_Sent(op, fields, dyn, relpath,
+                                     node.lineno))
+            continue
+        if in_sender:
+            continue
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "send" and node.args:
+            frame_arg = node.args[0]
+        elif leaf == "send_frame" and len(node.args) >= 2:
+            frame_arg = node.args[1]
+        else:
+            continue
+        fr = _resolve_frame(frame_arg, fn, defs_by_name)
+        if fr is None:
+            continue  # op-less stream frame / unresolvable: not ours
+        ops, fields, dynamic = fr
+        if not ops:
+            dynamic = True
+        for op in ops:
+            out.append(_Sent(op, fields, dynamic, relpath,
+                             node.lineno))
+    return out
+
+
+# --------------------------------------------------------------------------
+# handler side
+# --------------------------------------------------------------------------
+class _Handled:
+    __slots__ = ("op", "relpath", "line")
+
+    def __init__(self, op, relpath, line):
+        self.op, self.relpath, self.line = op, relpath, line
+
+
+class _Required:
+    __slots__ = ("op", "field", "relpath", "line")
+
+    def __init__(self, op, field, relpath, line):
+        self.op, self.field = op, field
+        self.relpath, self.line = relpath, line
+
+
+def _param_names(fn_node):
+    a = fn_node.args
+    return [x.arg for x in a.posonlyargs + a.args]
+
+
+def _optional_fields(scope_node, names):
+    """Fields tested with `"f" in name` / read via `.get("f")` inside
+    `scope_node` — reads of these are NOT required."""
+    opt = set()
+    for node in ast.walk(scope_node):
+        if isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                and isinstance(node.ops[0], (ast.In, ast.NotIn)):
+            s = _const_str(node.left)
+            cmp = node.comparators[0]
+            if s is not None and isinstance(cmp, ast.Name) \
+                    and cmp.id in names:
+                opt.add(s)
+    return opt
+
+
+def _required_reads(scope_node, names, extra_alias_from_defaults=True):
+    """[(field, line, col)] for `alias["f"]` Load subscripts inside
+    `scope_node`, where alias ∈ names, following `x = msg` assignments
+    and `def f(m=msg)` default-arg captures."""
+    names = set(names)
+    if extra_alias_from_defaults:
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(scope_node):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Name) \
+                        and node.value.id in names:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) \
+                                and t.id not in names:
+                            names.add(t.id)
+                            changed = True
+                elif isinstance(node,
+                                (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                    args = node.args
+                    pos = args.posonlyargs + args.args
+                    for param, default in zip(
+                            pos[len(pos) - len(args.defaults):],
+                            args.defaults):
+                        if isinstance(default, ast.Name) \
+                                and default.id in names \
+                                and param.arg not in names:
+                            names.add(param.arg)
+                            changed = True
+    opt = _optional_fields(scope_node, names)
+    out = []
+    for node in ast.walk(scope_node):
+        if isinstance(node, ast.Subscript) \
+                and isinstance(node.ctx, ast.Load) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id in names:
+            s = _const_str(node.slice)
+            if s is not None and s != OP_KEY and s not in opt:
+                out.append((s, node.lineno, node.col_offset))
+    return out
+
+
+def _callee_required(call, msg_names, defs_by_name):
+    """One interprocedural hop: msg passed positionally into a
+    same-file def -> that def's required reads on the matching
+    param."""
+    callee = defs_by_name.get(_func_leaf(call.func))
+    if callee is None:
+        return []
+    offset = 0
+    params = _param_names(callee)
+    if params and params[0] == "self" \
+            and isinstance(call.func, ast.Attribute):
+        offset = 1
+    out = []
+    for i, arg in enumerate(call.args):
+        if isinstance(arg, ast.Name) and arg.id in msg_names:
+            pi = i + offset
+            if pi < len(params):
+                out.extend(_required_reads(callee, {params[pi]}))
+    return out
+
+
+def _op_vars(fn_node):
+    """{var name} bound from an op-read (`op = msg.get("op")`) in the
+    function, plus {msg var -> ...} mapping of op-read receivers."""
+    opvars = {}
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Assign) \
+                and _is_op_read(node.value):
+            recv = _msg_receiver(node.value)
+            for t in node.targets:
+                if isinstance(t, ast.Name) and recv:
+                    opvars[t.id] = recv
+    return opvars
+
+
+def _collect_handlers(relpath, tree, await_fns):
+    """([_Handled], [_Required]) for one file."""
+    handled, required = [], []
+    defs_by_name = dict((name, node)
+                        for node, name in reversed(_all_defs(tree)))
+    for fn_node, _name in _all_defs(tree):
+        opvars = _op_vars(fn_node)
+
+        def msg_of(expr):
+            if _is_op_read(expr):
+                return _msg_receiver(expr)
+            if isinstance(expr, ast.Name) and expr.id in opvars:
+                return opvars[expr.id]
+            return None
+
+        # --- comparison dispatch: == branches, != guards, `in` tuples
+        for node in ast.walk(fn_node):
+            if isinstance(node, ast.If):
+                ops, msgvar, eq = _branch_ops(node.test, msg_of)
+                for op in ops:
+                    handled.append(_Handled(op, relpath,
+                                            node.test.lineno))
+                if eq and msgvar:
+                    reads = _required_reads(
+                        _block_wrapper(node.body), {msgvar})
+                    for call in _block_calls(node.body):
+                        reads.extend(_callee_required(
+                            call, {msgvar}, defs_by_name))
+                    for field, line, col in reads:
+                        for op in ops:
+                            required.append(_Required(
+                                op, field, relpath, line))
+            elif isinstance(node, ast.Compare):
+                # bare guards not inside an If test are rare; the If
+                # walk above covers everything we attribute fields to,
+                # and ops found here were already recorded there
+                pass
+
+        # --- await-style: self._await(("op", ...)) tuples
+        for node in ast.walk(fn_node):
+            if not (isinstance(node, ast.Call)
+                    and _func_leaf(node.func) in await_fns
+                    and node.args):
+                continue
+            tup = node.args[0]
+            ops = []
+            if isinstance(tup, (ast.Tuple, ast.List)):
+                ops = [e.value for e in tup.elts
+                       if isinstance(e, ast.Constant)
+                       and isinstance(e.value, str)]
+            for op in ops:
+                handled.append(_Handled(op, relpath, node.lineno))
+            if not ops:
+                continue
+            # result variable: `x = self._await(...)` -> reads on x,
+            # plus one hop when x is passed into a same-file def
+            res = _await_result_var(fn_node, node)
+            if res is None:
+                continue
+            reads = _required_reads(fn_node, {res},
+                                    extra_alias_from_defaults=False)
+            for call in ast.walk(fn_node):
+                if isinstance(call, ast.Call):
+                    reads.extend(_callee_required(
+                        call, {res}, defs_by_name))
+            for field, line, col in reads:
+                for op in ops:
+                    required.append(_Required(op, field, relpath,
+                                              line))
+    return handled, required
+
+
+def _branch_ops(test, msg_of):
+    """(ops, msg var, is_eq_dispatch) for an If test comparing an
+    op-read against constants. `!=` guards and `not in` record the
+    handled ops but attribute no fields (the 'branch' is the rest of
+    the function, which we do not model)."""
+    tests = [test]
+    if isinstance(test, ast.BoolOp):
+        tests = list(test.values)
+    ops, msgvar, eq = [], None, False
+    for t in tests:
+        neg = False
+        while isinstance(t, ast.UnaryOp) and isinstance(
+                t.op, ast.Not):
+            t, neg = t.operand, not neg
+        if not (isinstance(t, ast.Compare) and len(t.ops) == 1):
+            continue
+        left, op_node, right = t.left, t.ops[0], t.comparators[0]
+        mv = msg_of(left)
+        if mv is None:
+            continue
+        if isinstance(op_node, ast.Eq) or (
+                isinstance(op_node, ast.NotEq) and neg):
+            s = _const_str(right)
+            if s is not None:
+                ops.append(s)
+                msgvar, eq = mv, True
+        elif isinstance(op_node, ast.NotEq) or (
+                isinstance(op_node, ast.Eq) and neg):
+            s = _const_str(right)
+            if s is not None:
+                ops.append(s)
+                msgvar = msgvar or mv
+        elif isinstance(op_node, (ast.In, ast.NotIn)) and isinstance(
+                right, (ast.Tuple, ast.List, ast.Set)):
+            vals = [e.value for e in right.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)]
+            ops.extend(vals)
+            if isinstance(op_node, ast.In) and not neg:
+                msgvar, eq = mv, True
+    return ops, msgvar, eq
+
+
+class _Block(ast.AST):
+    _fields = ("body",)
+
+
+def _block_wrapper(stmts):
+    b = _Block()
+    b.body = list(stmts)
+    return b
+
+
+def _block_calls(stmts):
+    for stmt in stmts:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                yield node
+
+
+def _await_result_var(fn_node, call):
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Assign) and node.value is call:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    return t.id
+    return None
+
+
+# --------------------------------------------------------------------------
+# the drift check
+# --------------------------------------------------------------------------
+def check_project(files):
+    """All MX013 findings over the parsed file set:
+    [(relpath, RawFinding)]."""
+    by_rel = dict(files)
+    groups = {}
+    for name, spec in PROTOCOLS.items():
+        groups[name] = {
+            "files": [f for f in spec["files"] if f in by_rel],
+            "await_fns": tuple(spec.get("await_fns", ())),
+            "sender_fns": dict(spec.get("sender_fns", {})),
+        }
+    for relpath, tree in files:
+        pname = file_protocol(tree)
+        if pname is None:
+            continue
+        g = groups.setdefault(pname, {"files": [], "await_fns": (),
+                                      "sender_fns": {}})
+        if relpath not in g["files"]:
+            g["files"].append(relpath)
+
+    findings = []
+    for name, g in sorted(groups.items()):
+        if not g["files"]:
+            continue
+        sends, handlers, required = [], [], []
+        for relpath in g["files"]:
+            tree = by_rel[relpath]
+            sends.extend(_collect_sends(relpath, tree,
+                                        g["sender_fns"]))
+            h, r = _collect_handlers(relpath, tree, g["await_fns"])
+            handlers.extend(h)
+            required.extend(r)
+        sent_ops = {s.op for s in sends}
+        handled_ops = {h.op for h in handlers}
+        dynamic_send = any(s.dynamic and not s.op for s in sends)
+
+        for s in sorted(sends, key=lambda s: (s.relpath, s.line)):
+            if s.op not in handled_ops:
+                findings.append((s.relpath, RawFinding(
+                    "MX013", s.line, 0,
+                    f"protocol '{name}': op '{s.op}' is sent here but "
+                    "no handler in the protocol group matches it — "
+                    "the receiver drops the frame silently")))
+        if not dynamic_send:
+            seen = set()
+            for h in sorted(handlers,
+                            key=lambda h: (h.relpath, h.line)):
+                if h.op in sent_ops or h.op in seen:
+                    continue
+                seen.add(h.op)
+                findings.append((h.relpath, RawFinding(
+                    "MX013", h.line, 0,
+                    f"protocol '{name}': handler matches op "
+                    f"'{h.op}' but no sender in the protocol group "
+                    "ever sends it — dead handler (or a renamed op)")))
+        fields_by_op = {}
+        dyn_ops = set()
+        for s in sends:
+            fields_by_op.setdefault(s.op, set()).update(s.fields)
+            if s.dynamic:
+                dyn_ops.add(s.op)
+        seen = set()
+        for r in sorted(required,
+                        key=lambda r: (r.relpath, r.line, r.field)):
+            if r.op not in fields_by_op or r.op in dyn_ops:
+                continue
+            if r.field in fields_by_op[r.op]:
+                continue
+            k = (r.op, r.field)
+            if k in seen:
+                continue
+            seen.add(k)
+            findings.append((r.relpath, RawFinding(
+                "MX013", r.line, 0,
+                f"protocol '{name}': handler requires field "
+                f"'{r.field}' of op '{r.op}' but no sender of that "
+                "op provides it — KeyError on receipt")))
+    return findings

@@ -670,14 +670,20 @@ ALL_RULES = {
 }
 
 #: project-scope rules — computed once over the whole tree by
-#: analysis.concurrency (they need the interprocedural call graph, not
-#: one file), but registered here so --select/--list-rules see a single
-#: rule namespace. The engine routes their findings through the same
+#: analysis.concurrency (MX006-MX008), analysis.effects (MX010-MX012),
+#: and analysis.protocol (MX013); they need the interprocedural call
+#: graph or cross-file frame matching, not one file, but are
+#: registered here so --select/--list-rules see a single rule
+#: namespace. The engine routes their findings through the same
 #: per-file suppressions and baseline as MX001-MX005.
 PROJECT_RULES = {
     "MX006": "blocking call while holding a lock",
     "MX007": "lock-order inversion (held-before cycle)",
     "MX008": "attribute written both inside and outside its lock",
+    "MX010": "side effect in a function reachable from a jit entry",
+    "MX011": "name read after being donated to a jitted call",
+    "MX012": "unordered iteration / unsorted json on a digest path",
+    "MX013": "wire-protocol drift (sender vs handler mismatch)",
 }
 
 

@@ -100,7 +100,10 @@ def save_sharded(mod, path, data_iter=None):
         import json
 
         with open(os.path.join(path, "mxnet_tpu_meta.json"), "w") as f:
-            json.dump(meta, f)
+            # sort_keys: the meta file must be byte-identical across
+            # hosts/runs (restore tooling diffs it, and the sharding
+            # table is a dict whose insertion order tracks build order)
+            json.dump(meta, f, sort_keys=True)
     if data_iter is not None and hasattr(data_iter, "state_dict"):
         from .data.state import save_state
 

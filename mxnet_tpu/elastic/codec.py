@@ -37,13 +37,17 @@ def decode(obj):
 
 
 def encode_tree(tree):
-    """{name: array} -> {name: encoded}."""
-    return {k: encode(v) for k, v in tree.items()}
+    """{name: array} -> {name: encoded}, in sorted name order so the
+    serialized frame bytes are identical whichever worker builds
+    them (the bit-identity bar covers the wire, not just the
+    arrays)."""
+    return {k: encode(tree[k]) for k in sorted(tree)}
 
 
 def decode_tree(tree):
-    """{name: encoded} -> {name: array}."""
-    return {k: decode(v) for k, v in tree.items()}
+    """{name: encoded} -> {name: array} (sorted for the same
+    frame-determinism as encode_tree)."""
+    return {k: decode(tree[k]) for k in sorted(tree)}
 
 
 def payload_bytes(obj):
@@ -51,7 +55,7 @@ def payload_bytes(obj):
     them — what elasticStats counts as 'moved'."""
     if "d" in obj and "s" in obj:
         return len(obj["d"]) * 3 // 4
-    return sum(payload_bytes(v) for v in obj.values())
+    return sum(payload_bytes(obj[k]) for k in sorted(obj))
 
 
 def digest(tree):

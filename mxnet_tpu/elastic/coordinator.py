@@ -357,8 +357,9 @@ class ElasticCoordinator(object):
                     or int(msg.get("step", -1)) != self._step
                     or m.state != "active"):
                 return
-            for s, tree in msg.get("shards", {}).items():
-                self._grads_buf[int(s)] = codec.decode_tree(tree)
+            shards = msg.get("shards", {})
+            for s in sorted(shards):
+                self._grads_buf[int(s)] = codec.decode_tree(shards[s])
             S = self._spec.logical_shards
             if len(self._grads_buf) < S:
                 return
@@ -366,7 +367,8 @@ class ElasticCoordinator(object):
             self._grads_buf.clear()
             for w in self._actives():
                 rows = {}
-                for name, (lo, hi) in w.bounds.items():
+                for name in sorted(w.bounds):
+                    lo, hi = w.bounds[name]
                     rows[name] = [lo, hi,
                                   codec.encode(combined[name][lo:hi])]
                 w.chan.send({"op": "combined", "gen": self._gen,
@@ -383,7 +385,8 @@ class ElasticCoordinator(object):
                 return
             for tree_name, tree in (("params", msg.get("params", {})),
                                     ("opt", msg.get("opt", {}))):
-                for name, (lo, hi, enc) in tree.items():
+                for name in sorted(tree):
+                    lo, hi, enc = tree[name]
                     self._pending_rows.append(
                         (tree_name, name, int(lo), int(hi),
                          codec.decode(enc)))

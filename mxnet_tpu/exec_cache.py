@@ -257,8 +257,10 @@ def donation_effective():
             with warnings.catch_warnings():
                 warnings.simplefilter("ignore")
                 jax.block_until_ready(f(x))
+            # the use-after-donate IS the probe: whether the donated
+            # buffer reports deleted is exactly what's being measured
             _donation_effective = bool(
-                getattr(x, "is_deleted", lambda: True)())
+                getattr(x, "is_deleted", lambda: True)())  # mxlint: disable=MX011
         except Exception:
             _donation_effective = True  # conservative: copy
     return _donation_effective

@@ -1,7 +1,11 @@
 """mxnet_tpu.analysis: mxlint rules MX001-MX005 (trigger + suppress),
-engine mechanics (suppression forms, baseline multiset), and the
-pre-bind graph verifier (shape/dtype contradictions, duplicate args,
-dead nodes, donation aliasing) on hand-built Symbols."""
+the effects pass MX010-MX012 and protocol-drift pass MX013 (trigger +
+suppress + baseline on synthetic trees), jit-entry reachability on a
+synthetic module, the result cache, engine mechanics (suppression
+forms, baseline multiset), and the pre-bind graph verifier
+(shape/dtype contradictions, duplicate args, dead nodes, donation
+aliasing) on hand-built Symbols."""
+import ast
 import json
 import os
 import textwrap
@@ -12,6 +16,8 @@ import pytest
 import mxnet_tpu as mx
 from mxnet_tpu.analysis import (
     GraphVerifyError,
+    callgraph,
+    effects,
     lint,
     rules,
     verify_graph,
@@ -321,6 +327,346 @@ def test_self_scan_analysis_package_is_clean():
         extra_registry_paths=(
             os.path.join(root, "mxnet_tpu", "utils", "__init__.py"),))
     assert not found, [f.format_text() for f in found]
+
+
+# ===================================================================
+# MX010-MX013 — effects + protocol passes (project scope)
+# ===================================================================
+def _lint_tree(files, tmp_path, select=None):
+    """Write {relpath: src} under tmp_path and run the full engine —
+    per-file rules AND the project-scope passes — over the tree."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return lint.lint_paths([str(tmp_path)], root=str(tmp_path),
+                           select=select)
+
+
+MX010_TRIGGER = """
+    import jax
+
+    LOG = []
+
+    def helper(x):
+        LOG.append(x)
+        return x
+
+    def step(x):
+        print(x)
+        return helper(x) + 1
+
+    run = jax.jit(step)
+    """
+
+
+def test_mx010_impure_jitted_function(tmp_path):
+    found = _lint_tree({"mod.py": MX010_TRIGGER}, tmp_path,
+                       select={"MX010"})
+    assert [f.rule for f in found] == ["MX010", "MX010"]
+    srcs = {f.source for f in found}
+    assert srcs == {"LOG.append(x)", "print(x)"}
+    msgs = " ".join(f.message for f in found)
+    assert "jit entry" in msgs
+
+
+def test_mx010_unreached_effect_and_suppression(tmp_path):
+    # same effects with no jit entry anywhere: out of scope
+    cold = """
+    LOG = []
+
+    def helper(x):
+        LOG.append(x)
+        return x
+    """
+    assert not _lint_tree({"mod.py": cold}, tmp_path,
+                          select={"MX010"})
+    sup = """
+    import jax
+
+    LOG = []
+
+    def step(x):
+        LOG.append(x)  # mxlint: disable=MX010
+        return x
+
+    run = jax.jit(step)
+    """
+    assert not _lint_tree({"mod.py": sup}, tmp_path,
+                          select={"MX010"})
+
+
+def test_jit_reachability_on_synthetic_module():
+    src = textwrap.dedent("""
+    import jax
+
+    def leaf(x):
+        return x + 1
+
+    def mid(x):
+        return leaf(x)
+
+    def top(x):
+        return mid(x)
+
+    def cold(x):
+        return x
+
+    entry = jax.jit(top)
+    """)
+    files = [("mod.py", ast.parse(src))]
+    graph = callgraph.CallGraph(files)
+    entries = effects.jit_entries(graph, files)
+    assert ("mod.py", "top") in entries
+    reach = effects.reachable_from(graph, entries)
+    names = {qn for (_rel, qn) in reach}
+    assert {"top", "mid", "leaf"} <= names
+    assert "cold" not in names
+    # hop counts: entry itself 0, transitive callee 2
+    assert reach[("mod.py", "top")][1] == 0
+    assert reach[("mod.py", "leaf")][1] == 2
+
+
+MX011_TRIGGER = """
+    import jax
+
+    def _run(params, x):
+        return params, x
+
+    step = jax.jit(_run, donate_argnums=(0,))
+
+    def go(params, x):
+        out = step(params, x)
+        return params
+    """
+
+
+def test_mx011_use_after_donate(tmp_path):
+    found = _lint_tree({"mod.py": MX011_TRIGGER}, tmp_path,
+                       select={"MX011"})
+    assert [f.rule for f in found] == ["MX011"]
+    assert found[0].source == "return params"
+    assert "donated" in found[0].message
+
+
+def test_mx011_rebind_kills_and_suppression(tmp_path):
+    rebound = """
+    import jax
+
+    def _run(params, x):
+        return params, x
+
+    step = jax.jit(_run, donate_argnums=(0,))
+
+    def go(params, x):
+        params, aux = step(params, x)
+        return params
+    """
+    assert not _lint_tree({"mod.py": rebound}, tmp_path,
+                          select={"MX011"})
+    sup = MX011_TRIGGER.replace(
+        "return params",
+        "return params  # mxlint: disable=MX011")
+    assert not _lint_tree({"mod.py": sup}, tmp_path,
+                          select={"MX011"})
+
+
+MX012_TRIGGER = """
+    import json
+
+    MXLINT_DIGEST_PATH = "*"
+
+    def digest(tree, f):
+        out = []
+        for k in tree.values():
+            out.append(k)
+        json.dump(out, f)
+        return out
+    """
+
+
+def test_mx012_unordered_iteration_on_digest_path(tmp_path):
+    found = _lint_tree({"mod.py": MX012_TRIGGER}, tmp_path,
+                       select={"MX012"})
+    assert [f.rule for f in found] == ["MX012", "MX012"]
+    msgs = " ".join(f.message for f in found)
+    assert "sort" in msgs
+
+
+def test_mx012_sorted_and_optout_are_clean(tmp_path):
+    clean = """
+    import json
+
+    MXLINT_DIGEST_PATH = "*"
+
+    def digest(tree, f):
+        out = []
+        for k, v in sorted(tree.items()):
+            out.append((k, v))
+        json.dump(out, f, sort_keys=True)
+        return out
+    """
+    assert not _lint_tree({"mod.py": clean}, tmp_path,
+                          select={"MX012"})
+    # tuple form covers only the named qualnames
+    scoped = """
+    MXLINT_DIGEST_PATH = ("digest",)
+
+    def digest(tree):
+        return [k for k in sorted(tree.values())]
+
+    def display(tree):
+        return [k for k in tree.values()]  # not a digest fn: fine
+    """
+    assert not _lint_tree({"mod.py": scoped}, tmp_path,
+                          select={"MX012"})
+
+
+MX013_DRIFT = {
+    "sender.py": """
+    MXLINT_PROTOCOL = "tproto"
+
+    def run(sock):
+        sock.send({"op": "ping", "seq": 1})
+        sock.send({"op": "orphan"})
+    """,
+    "handler.py": """
+    MXLINT_PROTOCOL = "tproto"
+
+    def on_message(sock, msg):
+        op = msg.get("op")
+        if op == "ping":
+            return msg["seq"]
+        if op == "stale":
+            return None
+    """,
+}
+
+
+def test_mx013_orphaned_op_and_dead_handler(tmp_path):
+    found = _lint_tree(dict(MX013_DRIFT), tmp_path, select={"MX013"})
+    assert [f.rule for f in found] == ["MX013", "MX013"]
+    by_path = {f.path: f.message for f in found}
+    assert "orphan" in by_path["sender.py"]      # sent, never handled
+    assert "stale" in by_path["handler.py"]      # handled, never sent
+    # the matched op/field pair raises nothing
+    assert not any("seq" in m for m in by_path.values())
+
+
+def test_mx013_missing_required_field(tmp_path):
+    files = dict(MX013_DRIFT)
+    files["handler.py"] = files["handler.py"].replace(
+        'return msg["seq"]', 'return msg["seq"] + msg["nonce"]')
+    found = _lint_tree(files, tmp_path, select={"MX013"})
+    missing = [f for f in found if "nonce" in f.message]
+    assert len(missing) == 1
+    assert "no sender" in missing[0].message
+
+
+def test_mx013_suppression(tmp_path):
+    files = {
+        "sender.py": MX013_DRIFT["sender.py"].replace(
+            'sock.send({"op": "orphan"})',
+            'sock.send({"op": "orphan"})  # mxlint: disable=MX013'),
+        "handler.py": MX013_DRIFT["handler.py"].replace(
+            'if op == "stale":',
+            '# mxlint: disable-next-line=MX013\n'
+            '    if op == "stale":'),
+    }
+    assert not _lint_tree(files, tmp_path, select={"MX013"})
+
+
+def test_effects_and_protocol_findings_are_baselinable(tmp_path):
+    """Every MX010-MX013 finding routes through the same baseline
+    multiset as the per-file rules."""
+    files = dict(MX013_DRIFT)
+    files["impure.py"] = MX010_TRIGGER
+    files["donate.py"] = MX011_TRIGGER
+    files["digest.py"] = MX012_TRIGGER
+    select = {"MX010", "MX011", "MX012", "MX013"}
+    found = _lint_tree(files, tmp_path, select=select)
+    assert sorted({f.rule for f in found}) == [
+        "MX010", "MX011", "MX012", "MX013"]
+    bl = tmp_path / "baseline.json"
+    lint.write_baseline(found, str(bl))
+    relint = _lint_tree(files, tmp_path, select=select)
+    new, kept = lint.apply_baseline(relint, lint.load_baseline(str(bl)))
+    assert not new and len(kept) == len(found)
+
+
+# ===================================================================
+# result cache + parallel analysis
+# ===================================================================
+CACHED_SRC = 'import os\nx = os.environ.get("MXNET_CACHED_KNOB")\n'
+
+
+def test_cache_roundtrip_and_invalidation(tmp_path):
+    d = tmp_path / "tree"
+    d.mkdir()
+    (d / "mod.py").write_text(CACHED_SRC)
+    cache = str(tmp_path / "cache.json")
+    cold = lint.lint_paths([str(d)], root=str(d), cache_path=cache)
+    assert os.path.exists(cache)
+    assert [f.rule for f in cold] == ["MX003"]
+    warm = lint.lint_paths([str(d)], root=str(d), cache_path=cache)
+    assert [f.__dict__ for f in warm] == [f.__dict__ for f in cold]
+    # a content edit invalidates exactly that file's entry
+    (d / "mod.py").write_text(
+        CACHED_SRC.replace("MXNET_CACHED_KNOB", "MXNET_OTHER_KNOB"))
+    edited = lint.lint_paths([str(d)], root=str(d), cache_path=cache)
+    assert "MXNET_OTHER_KNOB" in edited[0].message
+
+
+def test_cache_stores_full_findings_select_filters(tmp_path):
+    """A select run against a cache written by a full run (and the
+    reverse) must agree with uncached results."""
+    d = tmp_path / "tree"
+    d.mkdir()
+    (d / "mod.py").write_text(CACHED_SRC)
+    cache = str(tmp_path / "cache.json")
+    # warm the cache with a SELECT run; a later full run still sees
+    # everything (entries always hold the unfiltered finding set)
+    sel = lint.lint_paths([str(d)], root=str(d), cache_path=cache,
+                          select={"MX001"})
+    assert sel == []
+    full = lint.lint_paths([str(d)], root=str(d), cache_path=cache)
+    assert [f.rule for f in full] == ["MX003"]
+    sel2 = lint.lint_paths([str(d)], root=str(d), cache_path=cache,
+                           select={"MX003"})
+    assert [f.rule for f in sel2] == ["MX003"]
+
+
+def test_parallel_jobs_match_serial(tmp_path):
+    d = tmp_path / "tree"
+    d.mkdir()
+    (d / "a.py").write_text(CACHED_SRC)
+    (d / "b.py").write_text(
+        CACHED_SRC.replace("MXNET_CACHED_KNOB", "MXNET_B_KNOB"))
+    (d / "c.py").write_text("x = 1\n")
+    serial = lint.lint_paths([str(d)], root=str(d))
+    para = lint.lint_paths([str(d)], root=str(d), jobs=2)
+    assert [f.__dict__ for f in para] == [f.__dict__ for f in serial]
+
+
+def test_engine_version_pins_the_cache(tmp_path):
+    """A cache written under a different engine hash is discarded."""
+    d = tmp_path / "tree"
+    d.mkdir()
+    (d / "mod.py").write_text(CACHED_SRC)
+    cache = tmp_path / "cache.json"
+    lint.lint_paths([str(d)], root=str(d), cache_path=str(cache))
+    data = json.loads(cache.read_text())
+    assert data["engine"] == lint.engine_version()
+    data["engine"] = "stale"
+    # poison every cached finding: if the stale cache were trusted,
+    # the bogus rule would surface
+    for ent in data["files"].values():
+        for f in ent["findings"]:
+            f["rule"] = "MX999"
+    cache.write_text(json.dumps(data))
+    fresh = lint.lint_paths([str(d)], root=str(d),
+                            cache_path=str(cache))
+    assert [f.rule for f in fresh] == ["MX003"]
 
 
 # ===================================================================

@@ -21,6 +21,7 @@ import lint  # noqa: E402
 import rules  # noqa: E402  (re-exported for introspection/tests)
 
 DEFAULT_BASELINE = os.path.join(ROOT, "ci", "mxlint_baseline.json")
+DEFAULT_CACHE = os.path.join(ROOT, ".mxlint_cache.json")
 # MX003 needs the full env registry even when linting a subset of the
 # tree; the canonical declarations live in mxnet_tpu/utils.
 REGISTRY_PATH = os.path.join(ROOT, "mxnet_tpu", "utils", "__init__.py")
@@ -48,10 +49,20 @@ def main(argv=None):
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
     ap.add_argument("--no-concurrency", action="store_true",
-                    help="skip the project-scope MX006-MX008 pass "
-                         "(it builds a call graph over every scanned "
-                         "file; opt out in speed-sensitive hooks)")
+                    help="skip the project-scope passes (MX006-MX008, "
+                         "MX010-MX013 — they build a call graph over "
+                         "every scanned file; opt out in "
+                         "speed-sensitive hooks)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="ignore and do not write .mxlint_cache.json")
+    ap.add_argument("--cache", default=DEFAULT_CACHE,
+                    help="result-cache path "
+                         "(default <repo>/.mxlint_cache.json)")
+    ap.add_argument("--jobs", type=int, default=0, metavar="N",
+                    help="analyze cache-miss files in N worker "
+                         "processes (default: in-process)")
     args = ap.parse_args(argv)
+    cache_path = None if args.no_cache else args.cache
 
     if args.list_rules:
         for code, (_fn, summary) in sorted(rules.ALL_RULES.items()):
@@ -71,7 +82,8 @@ def main(argv=None):
         findings = lint.lint_paths(
             args.paths, root=ROOT,
             select=select, extra_registry_paths=(REGISTRY_PATH,),
-            concurrency=not args.no_concurrency)
+            concurrency=not args.no_concurrency,
+            cache_path=cache_path, jobs=args.jobs)
         lint.write_baseline(findings, args.baseline)
         print(f"mxlint: wrote {len(findings)} finding(s) to "
               f"{args.baseline}")
@@ -83,7 +95,8 @@ def main(argv=None):
         fmt=args.format, select=select,
         show_baselined=args.show_baselined,
         extra_registry_paths=(REGISTRY_PATH,),
-        concurrency=not args.no_concurrency)
+        concurrency=not args.no_concurrency,
+        cache_path=cache_path, jobs=args.jobs)
     print(report)
     return code
 
